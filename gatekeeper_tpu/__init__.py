@@ -1,0 +1,3 @@
+"""gatekeeper_tpu: TPU-native Kubernetes admission/audit policy engine."""
+
+__version__ = "0.1.0"

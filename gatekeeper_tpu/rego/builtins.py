@@ -1,0 +1,255 @@
+"""Builtin functions for the Rego subset.
+
+Coverage is the builtin surface actually exercised by the reference policy
+corpus (SURVEY.md §2.3): sprintf, count, to_number, is_* type checks,
+substring, re_match, startswith/endswith/contains, replace, trim, split,
+concat, min/max/sum, any/all, plus sort/lower/upper/abs for completeness.
+
+Error semantics: a builtin raising BuiltinError makes the enclosing
+expression *undefined* (the literal fails; under `not` it succeeds). This is
+OPA's default non-strict builtin-error behavior that e.g.
+k8scontainerlimits' `not canonify_cpu(cpu_orig)` relies on
+(library/general/containerlimits/src.rego).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from ..utils.values import FrozenDict, format_value, rego_eq, sort_key, type_name
+
+
+class BuiltinError(Exception):
+    pass
+
+
+_REGEX_CACHE: dict[str, "re.Pattern[str]"] = {}
+
+
+def compiled_regex(pattern: str) -> "re.Pattern[str]":
+    pat = _REGEX_CACHE.get(pattern)
+    if pat is None:
+        try:
+            pat = re.compile(pattern)
+        except re.error as e:
+            raise BuiltinError(f"invalid regex {pattern!r}: {e}") from None
+        _REGEX_CACHE[pattern] = pat
+    return pat
+
+
+def _need(v: Any, ty: str, fn: str) -> Any:
+    if type_name(v) != ty:
+        raise BuiltinError(f"{fn}: expected {ty}, got {type_name(v)}")
+    return v
+
+
+def _need_str(v: Any, fn: str) -> str:
+    return _need(v, "string", fn)
+
+
+def _need_num(v: Any, fn: str):
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise BuiltinError(f"{fn}: expected number, got {type_name(v)}")
+    return v
+
+
+def _iterable(v: Any, fn: str):
+    if isinstance(v, (tuple, frozenset)):
+        return list(v)
+    if isinstance(v, FrozenDict):
+        return list(v.values())
+    raise BuiltinError(f"{fn}: expected collection, got {type_name(v)}")
+
+
+def bi_count(v):
+    if isinstance(v, str):
+        return len(v)
+    if isinstance(v, (tuple, frozenset, FrozenDict)):
+        return len(v)
+    raise BuiltinError(f"count: cannot count {type_name(v)}")
+
+
+def bi_to_number(v):
+    if v is None:
+        return 0
+    if isinstance(v, bool):
+        return 1 if v else 0
+    if isinstance(v, (int, float)):
+        return v
+    if isinstance(v, str):
+        try:
+            return int(v)
+        except ValueError:
+            try:
+                f = float(v)
+            except ValueError:
+                raise BuiltinError(f"to_number: invalid number {v!r}") from None
+            return int(f) if f.is_integer() else f
+    raise BuiltinError(f"to_number: cannot convert {type_name(v)}")
+
+
+def bi_substring(s, start, length):
+    s = _need_str(s, "substring")
+    start = int(_need_num(start, "substring"))
+    length = int(_need_num(length, "substring"))
+    if start < 0:
+        raise BuiltinError("substring: negative start")
+    if length < 0:
+        return s[start:]
+    return s[start : start + length]
+
+
+def bi_sprintf(fmt, args):
+    fmt = _need_str(fmt, "sprintf")
+    args = list(_need(args, "array", "sprintf"))
+    out = []
+    i, n = 0, len(fmt)
+    ai = 0
+    while i < n:
+        c = fmt[i]
+        if c != "%":
+            out.append(c)
+            i += 1
+            continue
+        if i + 1 < n and fmt[i + 1] == "%":
+            out.append("%")
+            i += 2
+            continue
+        # parse verb (with optional width/precision, which we pass through to %-style)
+        j = i + 1
+        while j < n and fmt[j] in "+-# 0123456789.":
+            j += 1
+        if j >= n:
+            raise BuiltinError("sprintf: trailing %")
+        verb = fmt[j]
+        spec = fmt[i + 1 : j]
+        if ai >= len(args):
+            raise BuiltinError("sprintf: not enough arguments")
+        arg = args[ai]
+        ai += 1
+        if verb == "v":
+            out.append(format_value(arg, top=True))
+        elif verb == "s":
+            out.append(arg if isinstance(arg, str) else format_value(arg, top=True))
+        elif verb in "dxXob":
+            out.append(("%" + spec + verb) % int(_need_num(arg, "sprintf")))
+        elif verb in "feEgG":
+            out.append(("%" + spec + verb) % float(_need_num(arg, "sprintf")))
+        else:
+            raise BuiltinError(f"sprintf: unsupported verb %{verb}")
+        i = j + 1
+    return "".join(out)
+
+
+def bi_min(coll):
+    items = _iterable(coll, "min")
+    if not items:
+        raise BuiltinError("min: empty collection")
+    return min(items, key=sort_key)
+
+
+def bi_max(coll):
+    items = _iterable(coll, "max")
+    if not items:
+        raise BuiltinError("max: empty collection")
+    return max(items, key=sort_key)
+
+
+def bi_trim(s, cutset):
+    return _need_str(s, "trim").strip(_need_str(cutset, "trim"))
+
+
+def bi_concat(delim, coll):
+    delim = _need_str(delim, "concat")
+    items = coll if isinstance(coll, tuple) else sorted(coll, key=sort_key) if isinstance(coll, frozenset) else None
+    if items is None:
+        raise BuiltinError("concat: expected array or set")
+    for x in items:
+        _need_str(x, "concat")
+    return delim.join(items)
+
+
+def bi_any(coll):
+    items = _iterable(coll, "any")
+    return any(x is True for x in items)
+
+
+def bi_all(coll):
+    items = _iterable(coll, "all")
+    return all(x is True for x in items)
+
+
+BUILTINS: dict[tuple, Any] = {
+    ("count",): bi_count,
+    ("to_number",): bi_to_number,
+    ("substring",): bi_substring,
+    ("sprintf",): bi_sprintf,
+    ("min",): bi_min,
+    ("max",): bi_max,
+    ("sum",): lambda c: sum(_need_num(x, "sum") for x in _iterable(c, "sum")),
+    ("product",): lambda c: __import__("math").prod(
+        _need_num(x, "product") for x in _iterable(c, "product")
+    ),
+    ("any",): bi_any,
+    ("all",): bi_all,
+    ("trim",): bi_trim,
+    ("trim_space",): lambda s: _need_str(s, "trim_space").strip(),
+    ("concat",): bi_concat,
+    ("split",): lambda s, d: tuple(
+        _need_str(s, "split").split(_need_str(d, "split"))
+    ),
+    ("replace",): lambda s, o, nw: _need_str(s, "replace").replace(
+        _need_str(o, "replace"), _need_str(nw, "replace")
+    ),
+    ("startswith",): lambda s, p: _need_str(s, "startswith").startswith(
+        _need_str(p, "startswith")
+    ),
+    ("endswith",): lambda s, p: _need_str(s, "endswith").endswith(
+        _need_str(p, "endswith")
+    ),
+    ("contains",): lambda s, p: _need_str(p, "contains") in _need_str(s, "contains"),
+    ("indexof",): lambda s, p: _need_str(s, "indexof").find(_need_str(p, "indexof")),
+    ("lower",): lambda s: _need_str(s, "lower").lower(),
+    ("upper",): lambda s: _need_str(s, "upper").upper(),
+    ("format_int",): lambda v, b: {2: "{:b}", 8: "{:o}", 10: "{:d}", 16: "{:x}"}[
+        int(_need_num(b, "format_int"))
+    ].format(int(_need_num(v, "format_int"))),
+    ("abs",): lambda v: abs(_need_num(v, "abs")),
+    ("round",): lambda v: int(round(_need_num(v, "round"))),
+    ("sort",): lambda c: tuple(sorted(_iterable(c, "sort"), key=sort_key)),
+    ("to_string",): lambda v: format_value(v, top=True),
+    ("re_match",): lambda p, v: bool(
+        compiled_regex(_need_str(p, "re_match")).search(_need_str(v, "re_match"))
+    ),
+    ("regex", "match"): lambda p, v: bool(
+        compiled_regex(_need_str(p, "regex.match")).search(
+            _need_str(v, "regex.match")
+        )
+    ),
+    ("is_string",): lambda v: isinstance(v, str),
+    ("is_number",): lambda v: not isinstance(v, bool) and isinstance(v, (int, float)),
+    ("is_boolean",): lambda v: isinstance(v, bool),
+    ("is_null",): lambda v: v is None,
+    ("is_array",): lambda v: isinstance(v, tuple),
+    ("is_object",): lambda v: isinstance(v, FrozenDict),
+    ("is_set",): lambda v: isinstance(v, frozenset),
+    ("array", "concat"): lambda a, b: _need(a, "array", "array.concat")
+    + _need(b, "array", "array.concat"),
+    ("array", "slice"): lambda a, i, j: _need(a, "array", "array.slice")[
+        int(_need_num(i, "array.slice")) : int(_need_num(j, "array.slice"))
+    ],
+    ("object", "get"): lambda o, k, d: o.get(k, d)
+    if isinstance(o, FrozenDict)
+    else d,
+    ("equal",): rego_eq,
+    ("neq",): lambda a, b: not rego_eq(a, b),
+    ("cast_array",): lambda v: tuple(v)
+    if isinstance(v, (tuple, frozenset))
+    else (_ for _ in ()).throw(BuiltinError("cast_array")),
+    ("cast_string",): lambda v: _need_str(v, "cast_string"),
+    ("cast_boolean",): lambda v: _need(v, "boolean", "cast_boolean"),
+    # debugging no-ops (OPA topdown/trace.go): always true so bodies continue
+    ("trace",): lambda *a: True,
+    ("print",): lambda *a: True,
+}

@@ -1,0 +1,33 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding
+(parallel/) is exercised without TPU hardware, per the project's testing
+strategy (the driver separately dry-runs the multichip path).
+
+Must run before any jax import — pytest imports conftest first.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+
+import pytest
+
+REFERENCE = pathlib.Path("/root/reference")
+
+
+def reference_available() -> bool:
+    return (REFERENCE / "library").is_dir()
+
+
+requires_reference = pytest.mark.skipif(
+    not reference_available(),
+    reason="reference corpus not mounted at /root/reference",
+)

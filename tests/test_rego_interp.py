@@ -1,0 +1,234 @@
+"""Interpreter unit tests + conformance against the reference policy corpus.
+
+The conformance part runs the reference library's own src_test.rego suites
+(4,027 lines across 23 templates, reference library/**/src_test.rego)
+through our interpreter — the tier-1 test strategy of SURVEY.md §4 without
+needing the opa binary.
+"""
+
+import pathlib
+
+import pytest
+
+from gatekeeper_tpu.rego.interp import UNDEF, Interpreter
+from gatekeeper_tpu.rego.parser import parse_module
+from gatekeeper_tpu.utils.values import freeze, thaw
+
+from .conftest import REFERENCE, requires_reference
+
+
+def run(src: str, rule: str, input_value=None, data=None):
+    m = parse_module(src, "<test>")
+    interp = Interpreter({"m": m})
+    if data:
+        for path, v in data.items():
+            interp.put_data(tuple(path.split("/")), v)
+    return interp.eval_rule(m.package, rule, input_value)
+
+
+def test_complete_rule_and_arith():
+    assert run("package t\nx = 1 + 2 * 3 { true }", "x") == 7
+
+
+def test_undefined_vs_false():
+    src = """
+package t
+a { false }
+b { not missing_thing_ref }
+missing_thing_ref { input.nope }
+c { input.zero == 0 }
+"""
+    assert run(src, "a") is UNDEF
+    assert run(src, "b") is True
+    assert run(src, "c", {"zero": 0}) is True
+
+
+def test_iteration_and_partial_set():
+    src = """
+package t
+hosts[h] { h := input.rules[_].host }
+"""
+    v = run(src, "hosts", {"rules": [{"host": "a"}, {"host": "b"}, {}]})
+    assert thaw(v) == ["a", "b"]
+
+
+def test_set_algebra_and_comprehensions():
+    src = """
+package t
+missing = m {
+  required := {l | l := input.required[_]}
+  provided := {l | input.labels[l]}
+  m := required - provided
+}
+"""
+    v = run(src, "missing", {"required": ["a", "b"], "labels": {"b": "x"}})
+    assert thaw(v) == ["a"]
+
+
+def test_function_clauses_and_builtin_error_undefined():
+    src = """
+package t
+canon(x) = out { is_number(x); out := x * 1000 }
+canon(x) = out { not is_number(x); endswith(x, "m"); out := to_number(replace(x, "m", "")) }
+bad { not canon(input.v) }
+good = canon(input.v) { true }
+"""
+    assert run(src, "good", {"v": "100m"}) == 100
+    assert run(src, "good", {"v": 2}) == 2000
+    assert run(src, "bad", {"v": "xyz"}) is True
+
+
+def test_unification_destructure():
+    src = """
+package t
+gv = [g, v] { [g, v] := split(input.api, "/") }
+"""
+    assert thaw(run(src, "gv", {"api": "apps/v1"})) == ["apps", "v1"]
+
+
+def test_with_input_override():
+    src = """
+package t
+deny[m] { input.bad; m := "bad" }
+check = c { c := count(deny) with input as {"bad": true} }
+"""
+    assert run(src, "check", {"bad": False}) == 1
+
+
+def test_object_key_iteration_binds():
+    src = """
+package t
+keys[k] { input.labels[k] }
+vals[v] { v := input.labels[_] }
+"""
+    assert thaw(run(src, "keys", {"labels": {"a": 1, "b": 2}})) == ["a", "b"]
+    assert thaw(run(src, "vals", {"labels": {"a": 1, "b": 2}})) == [1, 2]
+
+
+def test_data_iteration_with_unbound_vars():
+    src = """
+package t
+pairs[[ns, name]] { data.inv.namespace[ns]["v1"]["Pod"][name] }
+"""
+    v = run(
+        src,
+        "pairs",
+        data={
+            "inv/namespace/default/v1/Pod/p1": {"x": 1},
+            "inv/namespace/kube/v1/Pod/p2": {"x": 2},
+        },
+    )
+    assert thaw(v) == [["default", "p1"], ["kube", "p2"]]
+
+
+def test_default_rule():
+    src = """
+package t
+default allow = false
+allow { input.ok }
+"""
+    assert run(src, "allow", {}) is False
+    assert run(src, "allow", {"ok": True}) is True
+
+
+def test_sprintf_formatting():
+    src = """
+package t
+m = msg { msg := sprintf("missing: %v count %d", [{"a", "b"}, 3]) }
+"""
+    assert run(src, "m") == 'missing: {"a", "b"} count 3'
+
+
+# ---------------------------------------------------------------- conformance
+
+
+def _library_dirs():
+    if not (REFERENCE / "library").is_dir():
+        return []
+    out = []
+    for sub in ("general", "pod-security-policy"):
+        base = REFERENCE / "library" / sub
+        if base.is_dir():
+            for d in sorted(base.iterdir()):
+                if (d / "src.rego").is_file() and (d / "src_test.rego").is_file():
+                    out.append(d)
+    return out
+
+
+# Suites that are red against their own src at the pinned reference commit
+# (none of the library rego suites are wired into the reference's CI — only
+# pod-security-policy/test.sh exists and no Makefile/workflow target runs it).
+# Verified by hand-deriving OPA topdown semantics:
+#  * httpsonly: test helpers build reviews without review.kind, but the
+#    violation rule requires input.review.kind.kind == "Ingress", so the
+#    expected violations can never fire (src_test.rego vs src.rego mismatch).
+#  * selinux: *_in_list tests pass allowedSELinuxOptions as a LIST while
+#    src.rego matches object fields (.level/.role/...) — list support landed
+#    upstream after this pin.
+KNOWN_RED_AT_PIN = {
+    "httpsonly": {
+        "test_boolean_annotation",
+        "test_true_annotation",
+        "test_missing_annotation",
+        "test_empty_tls",
+        "test_missing_tls",
+        "test_missing_all",
+    },
+    "selinux": {
+        "test_input_seLinux_options_allowed_in_list",
+        "test_input_seLinux_options_allowed_in_list_subset",
+        "test_input_seLinux_options_many_allowed_in_list",
+        "test_input_seLinux_options_no_security_context",
+    },
+}
+
+
+@requires_reference
+@pytest.mark.parametrize("libdir", _library_dirs(), ids=lambda d: d.name)
+def test_reference_library_suite(libdir: pathlib.Path):
+    src = (libdir / "src.rego").read_text()
+    test_src = (libdir / "src_test.rego").read_text()
+    m1 = parse_module(src, str(libdir / "src.rego"))
+    m2 = parse_module(test_src, str(libdir / "src_test.rego"))
+    interp = Interpreter({"src": m1, "test": m2})
+    results = interp.run_tests(m2.package)
+    assert results, f"no test_ rules found in {libdir}"
+    failed = set(n for n, ok in results.items() if not ok)
+    expected = KNOWN_RED_AT_PIN.get(libdir.name, set())
+    assert failed == expected, (
+        f"{libdir.name}: failures {sorted(failed)} != expected-at-pin "
+        f"{sorted(expected)} (total {len(results)})"
+    )
+
+
+@requires_reference
+def test_reference_target_matcher_suites():
+    regolib = REFERENCE / "pkg" / "target" / "regolib"
+    src = (regolib / "src.rego").read_text()
+    # the matcher library templates {{.ConstraintsRoot}}/{{.DataRoot}} — mount
+    # them the way the framework does (constraint framework client.go:79-86)
+    src = src.replace('{{.ConstraintsRoot}}', "constraints").replace(
+        '{{.DataRoot}}', "external"
+    )
+    mods = {"target": parse_module(src, "target/src.rego")}
+    for tf in sorted(regolib.glob("*_test.rego")):
+        tsrc = tf.read_text().replace('{{.ConstraintsRoot}}', "constraints").replace(
+            '{{.DataRoot}}', "external"
+        )
+        mods[tf.name] = parse_module(tsrc, tf.name)
+    interp = Interpreter(mods)
+    all_results = {}
+    for name, m in mods.items():
+        if name == "target":
+            continue
+        all_results.update(
+            {f"{name}:{k}": v for k, v in interp.run_tests(m.package).items()}
+        )
+    assert all_results
+    failed = sorted(n for n, ok in all_results.items() if not ok)
+    # test_with_undefined_ns is red at pin: with input.review as {} the three
+    # `not` guards in autoreject_review all succeed (undefined namespace), so
+    # a rejection IS produced while the test expects none. Like the library
+    # suites, the regolib tests are not run by the reference's CI.
+    failed = [n for n in failed if not n.endswith(":test_with_undefined_ns")]
+    assert not failed, f"{len(failed)}/{len(all_results)} matcher tests failed: {failed}"
