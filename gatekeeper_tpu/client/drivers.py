@@ -1,0 +1,368 @@
+"""Driver seam + the interpreter-backed reference driver.
+
+The Driver protocol is the framework's replaceable evaluation backend —
+shape parity with the reference interface
+(vendor/.../constraint/pkg/client/drivers/interface.go:21-39): module CRUD,
+data CRUD at tree paths, Query, Dump. Two implementations exist:
+
+  * RegoDriver (here): modules run in the tree-walking interpreter; the
+    hook join (matching constraints ⋈ template violation rules,
+    reference regolib/src.go:23-62) and the match predicate
+    (pkg/target/regolib/src.rego) are evaluated natively rather than as
+    installed Rego — same results, no meta-interpretation.
+  * TpuDriver (ir/driver.py): compiles templates to vectorized JAX programs
+    and evaluates reviews in batches; falls back to this driver for
+    templates outside the vectorizable subset.
+
+Paths are tuples of segments (not strings), so no URL escaping is needed
+anywhere. Well-known roots (reference client.go:79-86, 493-511):
+  ("constraints", <target>, "cluster", <group>, <Kind>, <name>)
+  ("external", <target>, ...)       synced inventory
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable, Optional, Protocol
+
+from ..rego import ast as A
+from ..rego.interp import UNDEF, Interpreter, RegoError
+from ..target.matcher import constraint_matches, needs_autoreject
+from ..utils.values import FrozenDict, freeze, thaw
+from .templates import CONSTRAINT_GROUP
+from .types import Response, Result
+
+
+class DriverError(Exception):
+    pass
+
+
+class Driver(Protocol):
+    def init(self) -> None: ...
+
+    def put_module(self, name: str, module: A.Module) -> None: ...
+
+    def put_modules(self, prefix: str, modules: Iterable[A.Module]) -> None: ...
+
+    def delete_module(self, name: str) -> bool: ...
+
+    def delete_modules(self, prefix: str) -> int: ...
+
+    def put_data(self, path: tuple, data: Any) -> None: ...
+
+    def delete_data(self, path: tuple) -> bool: ...
+
+    def query(self, path: tuple, input_value: Any = None,
+              tracing: bool = False) -> Response: ...
+
+    def dump(self) -> str: ...
+
+
+def hook_violation_path(target: str) -> tuple:
+    return ("hooks", target, "violation")
+
+
+def hook_audit_path(target: str) -> tuple:
+    return ("hooks", target, "audit")
+
+
+def split_group_version(gv: str) -> tuple[str, str]:
+    group, _, version = gv.rpartition("/")
+    return group, version
+
+
+class RegoDriver:
+    """Interpreter-backed driver with native hook/matcher evaluation."""
+
+    def __init__(self):
+        self._interp = Interpreter()
+        self._module_names: set[str] = set()
+        self._trace_sink: Optional[list] = None
+
+    # ------------------------------------------------------------- modules
+
+    def init(self) -> None:  # hooks are native; nothing to install
+        return None
+
+    def put_module(self, name: str, module: A.Module) -> None:
+        self._interp.put_module(name, module)
+        self._module_names.add(name)
+
+    def put_modules(self, prefix: str, modules: Iterable[A.Module]) -> None:
+        # mirror of PutModules upsert semantics (local.go:124-148): existing
+        # modules under the prefix not in the new set are removed
+        new_names = []
+        mods = list(modules)
+        for i, m in enumerate(mods):
+            new_names.append(f"{prefix}#{i}")
+        for old in sorted(self._module_names):
+            if old.startswith(prefix + "#") and old not in new_names:
+                self._interp.delete_module(old)
+                self._module_names.discard(old)
+        for name, m in zip(new_names, mods):
+            self._interp.put_module(name, m)
+            self._module_names.add(name)
+
+    def delete_module(self, name: str) -> bool:
+        if name not in self._module_names:
+            return False
+        self._interp.delete_module(name)
+        self._module_names.discard(name)
+        return True
+
+    def delete_modules(self, prefix: str) -> int:
+        doomed = [n for n in self._module_names if n.startswith(prefix + "#")]
+        for n in doomed:
+            self._interp.delete_module(n)
+            self._module_names.discard(n)
+        return len(doomed)
+
+    # ---------------------------------------------------------------- data
+
+    def put_data(self, path: tuple, data: Any) -> None:
+        if not path:
+            raise DriverError("cannot put data at the root")
+        self._interp.put_data(tuple(path), data)
+
+    def delete_data(self, path: tuple) -> bool:
+        if not path:
+            raise DriverError("cannot delete the data root")
+        return self._interp.delete_data(tuple(path))
+
+    def get_data(self, path: tuple) -> Any:
+        v = self._interp.get_data(tuple(path))
+        return None if v is UNDEF else v
+
+    # --------------------------------------------------------------- query
+
+    def query(self, path: tuple, input_value: Any = None,
+              tracing: bool = False) -> Response:
+        path = tuple(path)
+        trace: Optional[list] = [] if tracing else None
+        if len(path) == 3 and path[0] == "hooks" and path[2] == "violation":
+            results = self._eval_violation(path[1], input_value or {}, trace)
+        elif len(path) == 3 and path[0] == "hooks" and path[2] == "audit":
+            results = self._eval_audit(path[1], trace)
+        else:
+            results = self._eval_data_path(path, input_value)
+        resp = Response(results=results)
+        if tracing:
+            resp.trace = "\n".join(trace or [])
+            resp.input = json.dumps(thaw(freeze(input_value)), sort_keys=True)
+        return resp
+
+    # hooks["<target>"].violation — the admission path (regolib/src.go:7-41)
+    def _eval_violation(self, target: str, input_value: dict,
+                        trace: Optional[list]) -> list[Result]:
+        review = input_value.get("review") or {}
+        results: list[Result] = []
+        lookup_ns = self._namespace_lookup(target)
+        inventory = self._inventory_tree(target)
+        for constraint in self._constraints(target):
+            spec = constraint.get("spec")
+            spec = spec if isinstance(spec, dict) else {}
+            match = spec.get("match")
+            match = match if isinstance(match, dict) else {}
+            enforcement = spec.get("enforcementAction") or "deny"
+            if needs_autoreject(match, review, lookup_ns):
+                if trace is not None:
+                    trace.append(
+                        f"autoreject {constraint.get('kind')}/"
+                        f"{(constraint.get('metadata') or {}).get('name')}"
+                    )
+                results.append(Result(
+                    msg="Namespace is not cached in OPA.",
+                    metadata={"details": {}},
+                    constraint=thaw(freeze(constraint)),
+                    review=review,
+                    enforcement_action=enforcement,
+                ))
+                continue
+            if not constraint_matches(constraint, review, lookup_ns):
+                continue
+            results.extend(
+                self._eval_template_violations(
+                    target, constraint, review, enforcement, inventory, trace
+                )
+            )
+        return results
+
+    # hooks["<target>"].audit — cached-state sweep (regolib/src.go:45-62)
+    def _eval_audit(self, target: str, trace: Optional[list]) -> list[Result]:
+        results: list[Result] = []
+        lookup_ns = self._namespace_lookup(target)
+        constraints = self._constraints(target)
+        inventory = self._inventory_tree(target)
+        for review in self._inventory_reviews(target):
+            for constraint in constraints:
+                if not constraint_matches(constraint, review, lookup_ns):
+                    continue
+                spec = constraint.get("spec")
+                spec = spec if isinstance(spec, dict) else {}
+                enforcement = spec.get("enforcementAction") or "deny"
+                results.extend(
+                    self._eval_template_violations(
+                        target, constraint, review, enforcement, inventory,
+                        trace
+                    )
+                )
+        return results
+
+    def _eval_template_violations(self, target: str, constraint: dict,
+                                  review: dict, enforcement: str,
+                                  inventory: Any,
+                                  trace: Optional[list]) -> list[Result]:
+        kind = constraint.get("kind")
+        pkg = ("templates", target, kind)
+        if pkg not in self._interp.packages:
+            return []
+        spec = constraint.get("spec")
+        spec = spec if isinstance(spec, dict) else {}
+        parameters = spec.get("parameters")
+        if parameters is None:
+            parameters = {}
+        inp = {"review": review, "parameters": parameters}
+        try:
+            out = self._interp.eval_rule(
+                pkg, "violation", inp, overrides={("inventory",): inventory}
+            )
+        except RegoError as e:
+            raise DriverError(
+                f"evaluating {kind} violation: {e}"
+            ) from e
+        results = []
+        if out is UNDEF:
+            return results
+        constraint_plain = thaw(freeze(constraint))
+        for r in sorted(out, key=lambda v: json.dumps(thaw(v), sort_keys=True)):
+            if not isinstance(r, FrozenDict) or "msg" not in r:
+                raise DriverError(
+                    f"template {kind}: violation output must be an object "
+                    f"with msg, got {thaw(r)!r}"
+                )
+            msg = r["msg"]
+            if not isinstance(msg, str):
+                raise DriverError(f"template {kind}: msg must be a string")
+            details = thaw(r["details"]) if "details" in r else {}
+            if trace is not None:
+                trace.append(f"violation {kind}: {msg}")
+            results.append(Result(
+                msg=msg,
+                metadata={"details": details},
+                constraint=constraint_plain,
+                review=review,
+                enforcement_action=enforcement,
+            ))
+        return results
+
+    # ---------------------------------------------------------- store views
+
+    def _constraints(self, target: str) -> list[dict]:
+        root = self._interp.get_data(("constraints", target, "cluster",
+                                      CONSTRAINT_GROUP))
+        if root is UNDEF or not isinstance(root, dict):
+            return []
+        out = []
+        for kind in sorted(root):
+            by_name = root[kind]
+            if isinstance(by_name, dict):
+                for name in sorted(by_name):
+                    if isinstance(by_name[name], dict):
+                        out.append(by_name[name])
+        return out
+
+    def _namespace_lookup(self, target: str):
+        def lookup(name: str):
+            v = self._interp.get_data(
+                ("external", target, "cluster", "v1", "Namespace", name)
+            )
+            return None if v is UNDEF or not isinstance(v, dict) else v
+        return lookup
+
+    def _inventory_tree(self, target: str) -> Any:
+        v = self._interp.get_data(("external", target))
+        if v is UNDEF:
+            return {}
+        return freeze(_deep_plain(v))
+
+    def _inventory_reviews(self, target: str) -> list[dict]:
+        """Flatten the inventory into make_review-shaped dicts
+        (reference regolib src.rego:40-61)."""
+        reviews: list[dict] = []
+        root = self._interp.get_data(("external", target))
+        if root is UNDEF or not isinstance(root, dict):
+            return reviews
+        cluster = root.get("cluster")
+        if isinstance(cluster, dict):
+            for gv in sorted(cluster):
+                by_kind = cluster[gv]
+                if not isinstance(by_kind, dict):
+                    continue
+                group, version = split_group_version(gv)
+                for kind in sorted(by_kind):
+                    by_name = by_kind[kind]
+                    if not isinstance(by_name, dict):
+                        continue
+                    for name in sorted(by_name):
+                        reviews.append({
+                            "kind": {"group": group, "version": version,
+                                     "kind": kind},
+                            "name": name,
+                            "object": by_name[name],
+                        })
+        namespaced = root.get("namespace")
+        if isinstance(namespaced, dict):
+            for ns in sorted(namespaced):
+                by_gv = namespaced[ns]
+                if not isinstance(by_gv, dict):
+                    continue
+                for gv in sorted(by_gv):
+                    by_kind = by_gv[gv]
+                    if not isinstance(by_kind, dict):
+                        continue
+                    group, version = split_group_version(gv)
+                    for kind in sorted(by_kind):
+                        by_name = by_kind[kind]
+                        if not isinstance(by_name, dict):
+                            continue
+                        for name in sorted(by_name):
+                            reviews.append({
+                                "kind": {"group": group, "version": version,
+                                         "kind": kind},
+                                "name": name,
+                                "namespace": ns,
+                                "object": by_name[name],
+                            })
+        return reviews
+
+    def _eval_data_path(self, path: tuple, input_value: Any) -> list[Result]:
+        """Generic data query: wrap each value at `path` as a bare Result
+        (used by tests and Dump; the reference local driver's
+        data.<path>[result] shape, local.go:302-324)."""
+        if len(path) >= 2:
+            pkg, name = tuple(path[:-1]), path[-1]
+            if pkg in self._interp.packages and name in self._interp.packages[pkg]:
+                v = self._interp.eval_rule(pkg, name, input_value)
+                if v is UNDEF:
+                    return []
+                return [Result(msg="", metadata={"value": thaw(v)})]
+        v = self._interp.get_data(path)
+        if v is UNDEF:
+            return []
+        return [Result(msg="", metadata={"value": thaw(freeze(_deep_plain(v)))})]
+
+    # ---------------------------------------------------------------- dump
+
+    def dump(self) -> str:
+        data = thaw(freeze(_deep_plain(self._interp.data)))
+        return json.dumps({
+            "modules": sorted(self._module_names),
+            "data": data,
+        }, indent=2, sort_keys=True)
+
+
+def _deep_plain(v: Any) -> Any:
+    """Make a store subtree JSON-able (mutable dict shells + frozen leaves)."""
+    if isinstance(v, dict):
+        return {k: _deep_plain(x) for k, x in v.items()}
+    return thaw(v)

@@ -190,9 +190,19 @@ class Interpreter:
 
     # ------------------------------------------------------------ queries
 
-    def eval_rule(self, pkg: tuple, name: str, input_value: Any = None):
-        """Evaluate a rule to its document. Returns a frozen value or UNDEF."""
+    def eval_rule(self, pkg: tuple, name: str, input_value: Any = None,
+                  overrides: Optional[dict] = None):
+        """Evaluate a rule to its document. Returns a frozen value or UNDEF.
+
+        `overrides` mounts values into the data document for the duration of
+        the query, keyed by path tuple — the driver uses it to bind
+        `data.inventory` the way the reference hook does with
+        `with data.inventory as inv` (regolib/src.go:30-31)."""
         ctx = Ctx(self, freeze(input_value))
+        if overrides:
+            ctx.data_overrides[0] = {
+                tuple(path): freeze(v) for path, v in overrides.items()
+            }
         return self._rule_value(pkg, name, ctx)
 
     def run_tests(self, pkg: tuple) -> dict[str, bool]:

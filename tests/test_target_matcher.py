@@ -1,0 +1,210 @@
+"""Differential test: native matcher vs the reference Rego matcher.
+
+The native matcher (gatekeeper_tpu/target/matcher.py) re-implements the
+semantics of the reference's generated Rego library
+(pkg/target/regolib/src.rego). This test runs that exact Rego through our
+interpreter (the semantic oracle validated against the reference's own
+regolib test suites) and checks the native predicate agrees on a grid of
+constraint × review shapes covering the library's edge cases.
+"""
+
+import itertools
+
+import pytest
+
+from gatekeeper_tpu.rego.interp import UNDEF, Interpreter
+from gatekeeper_tpu.rego.parser import parse_module
+from gatekeeper_tpu.target.matcher import constraint_matches, needs_autoreject
+from gatekeeper_tpu.utils.values import thaw
+
+from .conftest import REFERENCE, requires_reference
+
+NS_OBJECTS = {
+    "prod": {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": "prod", "labels": {"env": "prod"}}},
+    "dev": {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "dev", "labels": {"env": "dev"}}},
+}
+
+
+def _constraints():
+    """One constraint per interesting match shape."""
+    matches = {
+        "no-match-field": None,
+        "empty-match": {},
+        "null-match": {"kinds": None},
+        "kinds-pod": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+        "kinds-star": {"kinds": [{"apiGroups": ["*"], "kinds": ["*"]}]},
+        "kinds-no-apigroups": {"kinds": [{"kinds": ["Pod"]}]},
+        "kinds-null-groups": {"kinds": [{"apiGroups": None, "kinds": ["Pod"]}]},
+        "kinds-empty-list": {"kinds": []},
+        "kinds-apps": {"kinds": [{"apiGroups": ["apps"], "kinds": ["Deployment"]}]},
+        "ns-prod": {"namespaces": ["prod"]},
+        "ns-null": {"namespaces": None},
+        "ns-excluded-prod": {"excludedNamespaces": ["prod"]},
+        "ns-excluded-null": {"excludedNamespaces": None},
+        "label-eq": {"labelSelector": {"matchLabels": {"app": "web"}}},
+        "label-in": {"labelSelector": {"matchExpressions": [
+            {"key": "app", "operator": "In", "values": ["web", "api"]}]}},
+        "label-in-empty": {"labelSelector": {"matchExpressions": [
+            {"key": "app", "operator": "In", "values": []}]}},
+        "label-notin": {"labelSelector": {"matchExpressions": [
+            {"key": "app", "operator": "NotIn", "values": ["web"]}]}},
+        "label-exists": {"labelSelector": {"matchExpressions": [
+            {"key": "app", "operator": "Exists"}]}},
+        "label-doesnotexist": {"labelSelector": {"matchExpressions": [
+            {"key": "app", "operator": "DoesNotExist"}]}},
+        "label-unknown-op": {"labelSelector": {"matchExpressions": [
+            {"key": "app", "operator": "Mystery", "values": ["x"]}]}},
+        "label-null-selector": {"labelSelector": None},
+        "nssel-prod": {"namespaceSelector": {"matchLabels": {"env": "prod"}}},
+        "nssel-null": {"namespaceSelector": None},
+        "nssel-and-kinds": {
+            "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+            "namespaceSelector": {"matchExpressions": [
+                {"key": "env", "operator": "In", "values": ["prod", "dev"]}]},
+        },
+        "everything": {
+            "kinds": [{"apiGroups": ["", "apps"], "kinds": ["Pod", "Deployment"]}],
+            "namespaces": ["prod", "dev"],
+            "excludedNamespaces": ["staging"],
+            "labelSelector": {"matchLabels": {"app": "web"}},
+            "namespaceSelector": {"matchLabels": {"env": "prod"}},
+        },
+    }
+    out = {}
+    for name, m in matches.items():
+        spec = {}
+        if m is not None:
+            spec["match"] = m
+        out[name] = {
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "TestKind",
+            "metadata": {"name": name},
+            "spec": spec,
+        }
+    return out
+
+
+def _reviews():
+    def pod(name, ns=None, labels=None):
+        o = {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": name}}
+        if ns:
+            o["metadata"]["namespace"] = ns
+        if labels is not None:
+            o["metadata"]["labels"] = labels
+        return o
+
+    web = {"app": "web"}
+    rs = {
+        "pod-plain": {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+                      "object": pod("a"), "name": "a"},
+        "pod-prod": {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+                     "namespace": "prod", "object": pod("a", "prod", web)},
+        "pod-prod-sideloaded": {
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "namespace": "prod", "object": pod("a", "prod", web),
+            "_unstable": {"namespace": NS_OBJECTS["prod"]}},
+        "pod-unknown-ns": {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+                           "namespace": "nowhere", "object": pod("a", "nowhere")},
+        "pod-empty-ns-string": {
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "namespace": "", "object": pod("a")},
+        "pod-dev-labeled": {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+                            "namespace": "dev",
+                            "object": pod("a", "dev", {"app": "api"})},
+        "deployment": {"kind": {"group": "apps", "version": "v1", "kind": "Deployment"},
+                       "namespace": "prod",
+                       "object": {"apiVersion": "apps/v1", "kind": "Deployment",
+                                  "metadata": {"name": "d", "namespace": "prod",
+                                               "labels": web}}},
+        "namespace-obj": {"kind": {"group": "", "version": "v1", "kind": "Namespace"},
+                          "object": NS_OBJECTS["prod"], "name": "prod"},
+        "delete-oldobject-only": {
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "namespace": "prod", "operation": "DELETE",
+            "oldObject": pod("a", "prod", web)},
+        "update-both-objects": {
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "namespace": "prod",
+            "object": pod("a", "prod", {"app": "api"}),
+            "oldObject": pod("a", "prod", web)},
+        "no-objects": {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+                       "namespace": "prod"},
+        "null-labels": {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+                        "namespace": "prod",
+                        "object": pod("a", "prod", None)},
+    }
+    return rs
+
+
+@requires_reference
+def test_native_matcher_agrees_with_reference_rego():
+    src = (REFERENCE / "pkg" / "target" / "regolib" / "src.rego").read_text()
+    src = src.replace("{{.ConstraintsRoot}}", "constraints")
+    src = src.replace("{{.DataRoot}}", "external")
+    interp = Interpreter({"target": parse_module(src, "regolib/src.rego")})
+    constraints = _constraints()
+    for name, c in constraints.items():
+        interp.put_data(("constraints", "TestKind", name), c)
+    for ns, obj in NS_OBJECTS.items():
+        interp.put_data(("external", "cluster", "v1", "Namespace", ns), obj)
+
+    def lookup(ns_name):
+        return NS_OBJECTS.get(ns_name)
+
+    mismatches = []
+    for rname, review in _reviews().items():
+        out = interp.eval_rule(("target",), "matching_constraints",
+                               {"review": review})
+        rego_matched = set()
+        if out is not UNDEF:
+            for c in out:
+                rego_matched.add(c["metadata"]["name"])
+        native_matched = {
+            cname for cname, c in constraints.items()
+            if constraint_matches(c, review, lookup)
+        }
+        if rego_matched != native_matched:
+            mismatches.append(
+                (rname, sorted(rego_matched ^ native_matched),
+                 sorted(rego_matched), sorted(native_matched))
+            )
+    assert not mismatches, f"matcher disagreements: {mismatches}"
+
+
+@requires_reference
+def test_native_autoreject_agrees_with_reference_rego():
+    src = (REFERENCE / "pkg" / "target" / "regolib" / "src.rego").read_text()
+    src = src.replace("{{.ConstraintsRoot}}", "constraints")
+    src = src.replace("{{.DataRoot}}", "external")
+    interp = Interpreter({"target": parse_module(src, "regolib/src.rego")})
+    constraints = _constraints()
+    for name, c in constraints.items():
+        interp.put_data(("constraints", "TestKind", name), c)
+    for ns, obj in NS_OBJECTS.items():
+        interp.put_data(("external", "cluster", "v1", "Namespace", ns), obj)
+
+    def lookup(ns_name):
+        return NS_OBJECTS.get(ns_name)
+
+    mismatches = []
+    for rname, review in _reviews().items():
+        out = interp.eval_rule(("target",), "autoreject_review",
+                               {"review": review})
+        rego_rejected = set()
+        if out is not UNDEF:
+            for rejection in out:
+                rego_rejected.add(rejection["constraint"]["metadata"]["name"])
+        native_rejected = set()
+        for cname, c in constraints.items():
+            spec = c.get("spec") or {}
+            match = spec.get("match")
+            match = match if isinstance(match, dict) else {}
+            if needs_autoreject(match, review, lookup):
+                native_rejected.add(cname)
+        if rego_rejected != native_rejected:
+            mismatches.append(
+                (rname, sorted(rego_rejected ^ native_rejected))
+            )
+    assert not mismatches, f"autoreject disagreements: {mismatches}"
