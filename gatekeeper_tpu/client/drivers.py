@@ -177,7 +177,9 @@ class RegoDriver:
                     review=review,
                     enforcement_action=enforcement,
                 ))
-                continue
+                # no `continue`: the reference hook UNIONS autoreject with
+                # matching_constraints results (regolib/src.go rules 1+2) —
+                # a Namespace-kind review can still match via its own labels
             if not constraint_matches(constraint, review, lookup_ns):
                 continue
             results.extend(

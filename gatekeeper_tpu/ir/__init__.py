@@ -1,1 +1,7 @@
+from .compile import Uncompilable, compile_template
+from .driver import TpuDriver
+from .evaljax import CompiledTemplate
+from .prog import Program
 
+__all__ = ["CompiledTemplate", "Program", "TpuDriver", "Uncompilable",
+           "compile_template"]

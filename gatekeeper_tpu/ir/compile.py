@@ -1,0 +1,774 @@
+"""Template compiler: Rego AST → vectorized Program.
+
+Compiles the guard structure of each violation clause into the tensor IR
+(ir/prog.py). Bindings that only feed the violation head (msg/details
+construction — sprintf, get_message-style helpers) are NOT compiled: the
+device program decides which (object, constraint) pairs fire, and the host
+interpreter materializes exact messages for those pairs. The compiled
+filter may over-fire (host re-check is authoritative) but must never
+under-fire; anything outside the subset raises Uncompilable and the
+template runs on the interpreter driver instead.
+
+Supported subset (grown corpus-first, SURVEY.md §7 P0):
+  * scalar guards over input.review.* / input.parameters.* paths
+  * iteration over object lists/maps and parameter lists (up to 2 axes
+    per slot), including `v := obj.labels[k]` map-entry iteration
+  * set comprehensions over object keys/values and parameter values;
+    set difference + count(s) {>,!=,==,<=} 0 patterns
+  * string predicates startswith/endswith/contains/re_match with the
+    pattern from parameters or constants (match-table rows)
+  * array comprehensions of booleans + any() (allowedrepos pattern)
+  * boolean helper functions (single package), inlined; `not` with
+    locally-bound axes reduced inside the negation
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional, Union
+
+from ..rego import ast as A
+from .prog import (
+    And,
+    Axis,
+    Clause,
+    Cmp,
+    Const,
+    Exists,
+    Expr,
+    Guard,
+    MatchLookup,
+    Not,
+    Or,
+    OrReduce,
+    OVal,
+    ObjSlotSpec,
+    ParamSlotSpec,
+    Program,
+    PVal,
+    Seg,
+    SumReduce,
+    Truthy,
+)
+
+_MATCH_OPS = {"startswith": "startswith", "endswith": "endswith",
+              "contains": "contains", "re_match": "re_match"}
+_MAX_INLINE_DEPTH = 8
+_MAX_SLOT_AXES = 2
+
+
+class Uncompilable(Exception):
+    pass
+
+
+# ---------------------------------------------------------------- symbolics
+
+
+@dataclass(frozen=True)
+class SPath:
+    """A path into the review ("object"/"oldObject"/"review" roots) or the
+    parameters document ("params" root). segs is a tuple of Seg."""
+
+    root: str
+    segs: tuple
+
+
+@dataclass(frozen=True)
+class SKey:
+    """The key bound by a map-iteration bracket."""
+
+    axis: str
+    kind: str  # "obj" | "param"
+
+
+@dataclass(frozen=True)
+class SSet:
+    """A set of scalars: object map keys, object list/map values, or
+    parameter list values."""
+
+    source: str  # "objkeys" | "objvals" | "paramvals"
+    path: SPath  # path whose final seg is the iteration
+
+
+@dataclass(frozen=True)
+class SSetDiff:
+    left: Union[SSet, "SSetDiff"]
+    right: SSet
+
+
+@dataclass(frozen=True)
+class SBoolList:
+    """[b | <param iteration>; b = pred] — axes local to the comprehension."""
+
+    axes: tuple
+    expr: Expr
+
+
+@dataclass(frozen=True)
+class SConst:
+    value: Any
+
+
+@dataclass(frozen=True)
+class SExpr:
+    expr: Expr
+    # set-derived counts may double-count duplicates; they are only valid in
+    # comparisons that reduce to emptiness tests (see _check_zero_only)
+    zero_only: bool = False
+
+
+Symbolic = Union[SPath, SKey, SSet, SSetDiff, SBoolList, SConst, SExpr]
+
+
+class _Ctx:
+    """Mutable compile state shared across a template's clauses."""
+
+    def __init__(self, module: A.Module):
+        self.module = module
+        self.rules: dict[str, list[A.Rule]] = {}
+        for r in module.rules:
+            self.rules.setdefault(r.name, []).append(r)
+        self.obj_slots: dict[tuple, ObjSlotRec] = {}
+        self.param_slots: dict[tuple, ParamSlotRec] = {}
+        self.axis_n = 0
+        self.axes: dict[str, Axis] = {}
+
+    def new_axis(self, kind: str) -> str:
+        name = f"a{self.axis_n}"
+        self.axis_n += 1
+        return name
+
+
+@dataclass
+class ObjSlotRec:
+    slot: int
+    root: str
+    segs: tuple
+    mode: str
+
+
+@dataclass
+class ParamSlotRec:
+    slot: int
+    segs: tuple
+    mode: str
+    pattern_ops: set = field(default_factory=set)
+
+
+def compile_template(module: A.Module, kind: str) -> Program:
+    """Compile the (already rewritten) entry module of a template."""
+    ctx = _Ctx(module)
+    vio = ctx.rules.get("violation")
+    if not vio:
+        raise Uncompilable("no violation rule")
+    clauses = []
+    for rule in vio:
+        clauses.append(_compile_clause(ctx, rule))
+    obj_slots = tuple(
+        ObjSlotSpec(slot=r.slot, root=r.root, segs=r.segs, mode=r.mode)
+        for r in sorted(ctx.obj_slots.values(), key=lambda r: r.slot)
+    )
+    param_slots = tuple(
+        ParamSlotSpec(slot=r.slot, segs=r.segs, mode=r.mode,
+                      pattern_ops=tuple(sorted(r.pattern_ops)))
+        for r in sorted(ctx.param_slots.values(), key=lambda r: r.slot)
+    )
+    return Program(kind=kind, obj_slots=obj_slots, param_slots=param_slots,
+                   clauses=tuple(clauses),
+                   axes=tuple(ctx.axes.values()))
+
+
+# ------------------------------------------------------------------ clauses
+
+
+def _head_vars(rule: A.Rule) -> set:
+    out: set = set()
+    if rule.key is not None:
+        _collect_vars(rule.key, out)
+    if rule.value is not None:
+        _collect_vars(rule.value, out)
+    return out
+
+
+def _collect_vars(t, out: set) -> None:
+    if isinstance(t, A.Var):
+        out.add(t.name)
+    elif isinstance(t, A.Ref):
+        _collect_vars(t.base, out)
+        for a in t.args:
+            _collect_vars(a, out)
+    elif isinstance(t, A.Call):
+        for a in t.args:
+            _collect_vars(a, out)
+    elif isinstance(t, A.BinOp):
+        _collect_vars(t.lhs, out)
+        _collect_vars(t.rhs, out)
+    elif isinstance(t, A.UnaryMinus):
+        _collect_vars(t.term, out)
+    elif isinstance(t, A.ArrayLit) or isinstance(t, A.SetLit):
+        for x in t.items:
+            _collect_vars(x, out)
+    elif isinstance(t, A.ObjectLit):
+        for k, v in t.items:
+            _collect_vars(k, out)
+            _collect_vars(v, out)
+    elif isinstance(t, (A.ArrayCompr, A.SetCompr)):
+        _collect_vars(t.head, out)
+        for l in t.body:
+            _collect_vars(l.expr, out)
+    elif isinstance(t, A.ObjectCompr):
+        _collect_vars(t.key, out)
+        _collect_vars(t.value, out)
+        for l in t.body:
+            _collect_vars(l.expr, out)
+    elif isinstance(t, (A.Assign, A.Unify)):
+        _collect_vars(t.lhs, out)
+        _collect_vars(t.rhs, out)
+
+
+def _needed_vars(rule: A.Rule) -> set:
+    """Vars needed by guard literals (directly or through needed bindings).
+    Head-only bindings are skipped — the host re-derives them."""
+    binds: list[tuple[str, set]] = []  # (bound var, refs)
+    guard_refs: set = set()
+    for lit in rule.body:
+        e = lit.expr
+        if isinstance(e, (A.Assign, A.Unify)) and isinstance(e.lhs, A.Var):
+            refs: set = set()
+            _collect_vars(e.rhs, refs)
+            binds.append((e.lhs.name, refs))
+        elif isinstance(e, A.SomeDecl):
+            continue
+        else:
+            _collect_vars(e, guard_refs)
+    needed = set(guard_refs)
+    changed = True
+    while changed:
+        changed = False
+        for var, refs in binds:
+            if var in needed and not refs <= needed:
+                needed |= refs
+                changed = True
+    return needed
+
+
+def _compile_clause(ctx: _Ctx, rule: A.Rule) -> Clause:
+    if rule.kind != "partial_set":
+        raise Uncompilable("violation must be a partial-set rule")
+    comp = _ClauseCompiler(ctx, _needed_vars(rule))
+    for lit in rule.body:
+        comp.literal(lit)
+    return Clause(axes=tuple(comp.clause_axes), guards=tuple(comp.guards))
+
+
+class _ClauseCompiler:
+    def __init__(self, ctx: _Ctx, needed: set, env: Optional[dict] = None,
+                 depth: int = 0):
+        self.ctx = ctx
+        self.needed = needed
+        self.env: dict[str, Symbolic] = env if env is not None else {}
+        self.clause_axes: list[Axis] = []
+        self.guards: list[Guard] = []
+        self.depth = depth
+
+    # -------------------------------------------------------------- literals
+
+    def literal(self, lit: A.Literal) -> None:
+        if lit.withs:
+            raise Uncompilable("with modifiers are not vectorizable")
+        e = lit.expr
+        if isinstance(e, A.SomeDecl):
+            return
+        if not lit.negated and isinstance(e, (A.Assign, A.Unify)) and \
+                isinstance(e.lhs, A.Var):
+            name = e.lhs.name
+            if name not in self.needed and not name.startswith("$wc"):
+                return  # head-only binding: host materializes
+            self.env[name] = self.bind_rhs(e.rhs)
+            return
+        if not lit.negated and isinstance(e, (A.Assign, A.Unify)):
+            raise Uncompilable(f"unsupported binding pattern {e!r}")
+        # guard literal
+        new_axes_start = len(self.clause_axes)
+        expr = self.bool_expr(e)
+        if lit.negated:
+            local = tuple(a.name for a in self.clause_axes[new_axes_start:])
+            del self.clause_axes[new_axes_start:]
+            self.guards.append(Guard(expr=Not(expr, local_axes=local)))
+        else:
+            self.guards.append(Guard(expr=expr))
+
+    # -------------------------------------------------------------- bindings
+
+    def bind_rhs(self, t) -> Symbolic:
+        if isinstance(t, A.Scalar):
+            return SConst(t.value)
+        if isinstance(t, A.Ref) or isinstance(t, A.Var):
+            return self.resolve_ref(t)
+        if isinstance(t, A.SetCompr):
+            return self.set_compr(t)
+        if isinstance(t, A.ArrayCompr):
+            return self.bool_list_compr(t)
+        if isinstance(t, A.BinOp) and t.op == "-":
+            l = self.bind_rhs(t.lhs)
+            r = self.bind_rhs(t.rhs)
+            if isinstance(l, (SSet, SSetDiff)) and isinstance(r, SSet):
+                return SSetDiff(l, r)
+            raise Uncompilable("only set difference is supported for '-' bindings")
+        if isinstance(t, A.Call):
+            if tuple(t.fn) == ("count",):
+                return self.count_symbolic(t.args[0])
+            return SExpr(self.call_expr(t))
+        raise Uncompilable(f"unsupported binding rhs {type(t).__name__}")
+
+    # ------------------------------------------------------------------ refs
+
+    def resolve_ref(self, t) -> Symbolic:
+        """Resolve a Var/Ref term to a symbolic path/element."""
+        if isinstance(t, A.Var):
+            if t.name == "input":
+                raise Uncompilable("bare input reference")
+            if t.name in self.env:
+                return self.env[t.name]
+            raise Uncompilable(f"unbound var {t.name}")
+        if not isinstance(t, A.Ref):
+            raise Uncompilable(f"not a ref: {type(t).__name__}")
+        if isinstance(t.base, A.Var) and t.base.name == "input":
+            sym = None
+            args = t.args
+            if not args or not isinstance(args[0], A.Scalar):
+                raise Uncompilable("dynamic input root")
+            root0 = args[0].value
+            if root0 == "review":
+                if len(args) > 1 and isinstance(args[1], A.Scalar) and \
+                        args[1].value in ("object", "oldObject"):
+                    sym = SPath(root=args[1].value, segs=())
+                    rest = args[2:]
+                else:
+                    sym = SPath(root="review", segs=())
+                    rest = args[1:]
+            elif root0 == "parameters":
+                sym = SPath(root="params", segs=())
+                rest = args[1:]
+            else:
+                raise Uncompilable(f"unsupported input root {root0!r}")
+        else:
+            sym = self.resolve_ref(t.base) if isinstance(t.base, A.Ref) else \
+                self.resolve_var_base(t.base)
+            rest = t.args
+        return self.walk_segments(sym, rest)
+
+    def resolve_var_base(self, base) -> Symbolic:
+        if isinstance(base, A.Var):
+            if base.name in self.env:
+                return self.env[base.name]
+            raise Uncompilable(f"unbound base var {base.name}")
+        raise Uncompilable(f"unsupported ref base {type(base).__name__}")
+
+    def walk_segments(self, sym: Symbolic, args: tuple) -> Symbolic:
+        for arg in args:
+            if not isinstance(sym, SPath):
+                raise Uncompilable("cannot descend into non-path symbolic")
+            if isinstance(arg, A.Scalar):
+                if not isinstance(arg.value, str):
+                    raise Uncompilable("non-string static bracket")
+                sym = replace(sym, segs=sym.segs + (Seg("field", name=arg.value),))
+            elif isinstance(arg, A.Var):
+                name = arg.name
+                if name in self.env:
+                    bound = self.env[name]
+                    if isinstance(bound, SKey):
+                        raise Uncompilable(
+                            "indexing by a previously-bound key is not supported"
+                        )
+                    raise Uncompilable("indexing by bound var")
+                # fresh var or wildcard -> iteration axis
+                axis = self.ctx.new_axis("obj")
+                is_param = sym.root == "params"
+                kind = "param" if is_param else "obj"
+                prior_iters = any(s.kind == "iter" for s in sym.segs)
+                sym = replace(sym, segs=sym.segs + (Seg("iter", axis=axis),))
+                self._register_axis(axis, kind, sym)
+                if not name.startswith("$wc"):
+                    if prior_iters:
+                        # extraction records keys for the innermost axis only
+                        raise Uncompilable(
+                            "key binding on an outer axis of a nested iteration"
+                        )
+                    self.env[name] = SKey(axis=axis, kind=kind)
+            else:
+                raise Uncompilable("composite bracket pattern")
+        return sym
+
+    def _register_axis(self, axis: str, kind: str, sym: SPath) -> None:
+        """Axis presence is owned by the slot of the iterated collection."""
+        if kind == "obj":
+            rec = self._obj_slot(sym, mode="entries")
+        else:
+            rec = self._param_slot(sym, mode="list")
+        ax = Axis(name=axis, kind=kind, slot=rec.slot)
+        self.ctx.axes[axis] = ax
+        self.clause_axes.append(ax)
+
+    # ----------------------------------------------------------------- slots
+
+    def _obj_slot(self, sym: SPath, mode: str) -> ObjSlotRec:
+        n_axes = sum(1 for s in sym.segs if s.kind == "iter")
+        if n_axes > _MAX_SLOT_AXES:
+            raise Uncompilable("too many iteration axes in one path")
+        key = (sym.root, sym.segs, mode)
+        rec = self.ctx.obj_slots.get(key)
+        if rec is None:
+            rec = ObjSlotRec(slot=len(self.ctx.obj_slots) +
+                             len(self.ctx.param_slots),
+                             root=sym.root, segs=sym.segs, mode=mode)
+            self.ctx.obj_slots[key] = rec
+        return rec
+
+    def _param_slot(self, sym: SPath, mode: str) -> ParamSlotRec:
+        key = (sym.segs, mode)
+        rec = self.ctx.param_slots.get(key)
+        if rec is None:
+            rec = ParamSlotRec(slot=len(self.ctx.obj_slots) +
+                               len(self.ctx.param_slots),
+                               segs=sym.segs, mode=mode)
+            self.ctx.param_slots[key] = rec
+        return rec
+
+    # -------------------------------------------------------- comprehensions
+
+    def set_compr(self, t: A.SetCompr) -> SSet:
+        if not isinstance(t.head, A.Var):
+            raise Uncompilable("set comprehension head must be a var")
+        head = t.head.name
+        if len(t.body) != 1:
+            raise Uncompilable("multi-literal set comprehension")
+        e = t.body[0].expr
+        if t.body[0].negated:
+            raise Uncompilable("negated comprehension body")
+        sub = _ClauseCompiler(self.ctx, self.needed | {head},
+                              env=dict(self.env), depth=self.depth)
+        if isinstance(e, (A.Assign, A.Unify)) and isinstance(e.lhs, A.Var) \
+                and e.lhs.name == head:
+            sym = sub.resolve_ref(e.rhs)
+            if not isinstance(sym, SPath):
+                raise Uncompilable("comprehension rhs must be a path")
+            if not sym.segs or not any(s.kind == "iter" for s in sym.segs):
+                raise Uncompilable("comprehension must iterate")
+            source = "paramvals" if sym.root == "params" else "objvals"
+            return SSet(source=source, path=sym)
+        if isinstance(e, A.Ref):
+            # {k | obj.labels[k]} — key-set form
+            sym = sub.resolve_ref(e)
+            bound = sub.env.get(head)
+            if isinstance(bound, SKey) and isinstance(sym, SPath):
+                source = "paramvals" if sym.root == "params" else "objkeys"
+                if source == "objkeys":
+                    # path up to (and including) the iteration seg
+                    return SSet(source="objkeys", path=sym)
+                raise Uncompilable("param key-set comprehension")
+            raise Uncompilable("unrecognized set comprehension form")
+        raise Uncompilable("unsupported set comprehension body")
+
+    def bool_list_compr(self, t: A.ArrayCompr) -> SBoolList:
+        """[b | x = params.list[_]; ...guards...; b = pred(x)]"""
+        if not isinstance(t.head, A.Var):
+            raise Uncompilable("array comprehension head must be a var")
+        head = t.head.name
+        sub = _ClauseCompiler(self.ctx, self.needed | {head} | _body_vars(t.body),
+                              env=dict(self.env), depth=self.depth)
+        start_axes = len(sub.clause_axes)
+        pred: Optional[Expr] = None
+        for lit in t.body:
+            e = lit.expr
+            if not lit.negated and isinstance(e, (A.Assign, A.Unify)) and \
+                    isinstance(e.lhs, A.Var) and e.lhs.name == head:
+                pred = sub.bool_expr(e.rhs)
+            else:
+                sub.literal(lit)
+        if pred is None:
+            raise Uncompilable("array comprehension without boolean head binding")
+        axes = tuple(a.name for a in sub.clause_axes[start_axes:])
+        guards = [g.expr if not g.negated else Not(g.expr)
+                  for g in sub.guards]
+        expr = And(tuple(guards + [pred])) if guards else pred
+        # comprehension axes do not escape into the clause
+        for a in sub.clause_axes[start_axes:]:
+            pass
+        return SBoolList(axes=axes, expr=expr)
+
+    # ----------------------------------------------------------- guard exprs
+
+    def bool_expr(self, e) -> Expr:
+        if isinstance(e, A.BinOp):
+            return self.cmp_expr(e)
+        if isinstance(e, A.Call):
+            return self.call_expr(e)
+        if isinstance(e, (A.Ref, A.Var)):
+            return Truthy(self.value_expr(self.to_symbolic(e)))
+        if isinstance(e, A.Scalar):
+            # any scalar except `false` succeeds as a body literal (null too)
+            return Const("bool", e.value is not False)
+        if isinstance(e, (A.Assign, A.Unify)):
+            # expression-position unification under `not`; only equality of
+            # two compilable values is supported
+            lhs = self.to_symbolic(e.lhs)
+            rhs = self.to_symbolic(e.rhs)
+            _check_zero_only(lhs, rhs, "eq")
+            return self.eq_expr(lhs, rhs)
+        raise Uncompilable(f"unsupported guard {type(e).__name__}")
+
+    def to_symbolic(self, t) -> Symbolic:
+        if isinstance(t, A.Var) and t.name in self.env:
+            return self.env[t.name]
+        if isinstance(t, A.Call):
+            if tuple(t.fn) == ("count",):
+                return self.count_symbolic(t.args[0])
+            return SExpr(self.call_expr(t))
+        return self.bind_rhs(t)
+
+    def cmp_expr(self, e: A.BinOp) -> Expr:
+        op_map = {"==": "eq", "!=": "ne", "<": "lt", "<=": "le",
+                  ">": "gt", ">=": "ge"}
+        if e.op not in op_map:
+            raise Uncompilable(f"unsupported operator {e.op}")
+        op = op_map[e.op]
+        lhs = self.term_for_cmp(e.lhs)
+        rhs = self.term_for_cmp(e.rhs)
+        _check_zero_only(lhs, rhs, op)
+        if op in ("eq", "ne"):
+            # `a != b` is undefined (not true) when a side is undefined, so
+            # it is its own comparison op rather than Not(eq)
+            return self.eq_expr(lhs, rhs, op)
+        lexpr = self.num_expr(lhs)
+        rexpr = self.num_expr(rhs)
+        return Cmp(op, lexpr, rexpr, dtype="num")
+
+    def term_for_cmp(self, t) -> Symbolic:
+        if isinstance(t, A.Call) and tuple(t.fn) == ("count",):
+            return self.count_symbolic(t.args[0])
+        return self.to_symbolic(t)
+
+    def count_symbolic(self, arg) -> SExpr:
+        sym = self.to_symbolic(arg)
+        zero_only = isinstance(sym, (SSet, SSetDiff))
+        return SExpr(self.count_of(sym), zero_only=zero_only)
+
+    def eq_expr(self, lhs: Symbolic, rhs: Symbolic, op: str = "eq") -> Expr:
+        if isinstance(lhs, SExpr) or isinstance(rhs, SExpr):
+            l = self.num_expr(lhs)
+            r = self.num_expr(rhs)
+            return Cmp(op, l, r, dtype="num")
+        return Cmp(op, self.value_expr(lhs), self.value_expr(rhs),
+                   dtype="auto")
+
+    def num_expr(self, sym: Symbolic) -> Expr:
+        if isinstance(sym, SExpr):
+            return sym.expr
+        if isinstance(sym, SConst):
+            if isinstance(sym.value, bool) or not isinstance(sym.value, (int, float)):
+                raise Uncompilable("numeric comparison with non-number")
+            return Const("num", float(sym.value))
+        return self.value_expr(sym)
+
+    def value_expr(self, sym: Symbolic) -> Expr:
+        """Leaf device expr for a scalar symbolic value."""
+        if isinstance(sym, SConst):
+            v = sym.value
+            if isinstance(v, bool):
+                return Const("bool", v)
+            if isinstance(v, (int, float)):
+                return Const("num", float(v))
+            if isinstance(v, str):
+                return Const("str", v)
+            raise Uncompilable(f"unsupported constant {v!r}")
+        if isinstance(sym, SKey):
+            if sym.kind == "param":
+                ax = self.ctx.axes[sym.axis]
+                return PVal(ax.slot, f="key", axis=sym.axis)
+            ax = self.ctx.axes[sym.axis]
+            return OVal(ax.slot, f="key", axis=sym.axis)
+        if isinstance(sym, SExpr):
+            return sym.expr
+        if isinstance(sym, SPath):
+            axes = [s.axis for s in sym.segs if s.kind == "iter"]
+            axis = axes[-1] if axes else None
+            if sym.root == "params":
+                mode = "list" if axes else "scalar"
+                rec = self._param_slot(sym, mode=mode)
+                return PVal(rec.slot, f="val", axis=axis)
+            mode = "entries" if axes else "scalar"
+            rec = self._obj_slot(sym, mode=mode)
+            return OVal(rec.slot, f="val", axis=axis)
+        raise Uncompilable(f"cannot make a scalar of {type(sym).__name__}")
+
+    # ----------------------------------------------------------------- calls
+
+    def call_expr(self, e: A.Call) -> Expr:
+        fn = tuple(e.fn)
+        if fn == ("any",):
+            sym = self.to_symbolic(e.args[0])
+            if isinstance(sym, SBoolList):
+                out = sym.expr
+                for ax in reversed(sym.axes):
+                    out = OrReduce(ax, out)
+                return out
+            raise Uncompilable("any() over non-comprehension")
+        if fn == ("count",):
+            raise Uncompilable("bare count() guard")
+        if len(fn) == 1 and fn[0] in _MATCH_OPS:
+            return self.match_call(_MATCH_OPS[fn[0]], e.args)
+        if fn == ("glob", "match"):
+            # glob.match(pattern, delimiters, value)
+            if len(e.args) != 3:
+                raise Uncompilable("glob.match arity")
+            return self.match_call("glob", (e.args[0], e.args[2]))
+        if len(fn) == 1 and fn[0] in self.ctx.rules:
+            return self.inline_helper(fn[0], e.args)
+        raise Uncompilable(f"unsupported call {'.'.join(fn)}")
+
+    def match_call(self, op: str, args: tuple) -> Expr:
+        """startswith(value, pattern) / re_match(pattern, value) etc."""
+        if op in ("re_match", "glob"):
+            pattern_t, value_t = args[0], args[1]
+        else:
+            value_t, pattern_t = args[0], args[1]
+        value = self.to_symbolic(value_t)
+        vexpr = self.value_expr(value)
+        pattern = self.to_symbolic(pattern_t)
+        if isinstance(pattern, SConst):
+            if not isinstance(pattern.value, str):
+                raise Uncompilable("pattern must be a string")
+            row = Const("row", (op, pattern.value))
+        elif isinstance(pattern, SPath) and pattern.root == "params":
+            axes = [s.axis for s in pattern.segs if s.kind == "iter"]
+            mode = "list" if axes else "scalar"
+            rec = self._param_slot(pattern, mode=mode)
+            rec.pattern_ops.add(op)
+            row = PVal(rec.slot, f=f"row:{op}", axis=axes[-1] if axes else None)
+        elif isinstance(pattern, SKey) and pattern.kind == "param":
+            raise Uncompilable("param key as pattern")
+        else:
+            raise Uncompilable("pattern must come from parameters or constants")
+        return MatchLookup(row=row, sid=vexpr)
+
+    def count_of(self, sym: Symbolic) -> Expr:
+        if isinstance(sym, SSetDiff):
+            return self.setdiff_count(sym)
+        if isinstance(sym, SSet):
+            # |set comprehension| as an existence sum — dedup makes this
+            # valid only for emptiness comparisons (zero_only enforced by
+            # the caller via count_symbolic)
+            if sym.source == "paramvals":
+                return PVal(self._set_slot(sym), f="count")
+            axis = self.ctx.new_axis("iter")
+            elem = self._set_elem(sym, axis)
+            return SumReduce(axis, Exists(elem))
+        if isinstance(sym, SPath):
+            # count(path): defined only when the collection exists
+            if sym.root == "params":
+                rec = self._param_slot(sym, mode="count")
+                return PVal(rec.slot, f="count")
+            rec = self._obj_slot(sym, mode="count")
+            return OVal(rec.slot, f="count")
+        raise Uncompilable("unsupported count() argument")
+
+    def count_expr(self, arg) -> Expr:
+        return self.count_symbolic(arg).expr
+
+    def _set_slot(self, s: SSet) -> int:
+        if s.source == "paramvals":
+            return self._param_slot(s.path, mode="list").slot
+        return self._obj_slot(s.path, mode="entries").slot
+
+    def setdiff_count(self, sd: SSetDiff) -> Expr:
+        """|A - B| as a device expr, valid for comparisons against 0 (set
+        dedup does not change emptiness)."""
+        if not isinstance(sd.left, SSet):
+            raise Uncompilable("nested set difference")
+        left, right = sd.left, sd.right
+        l_axis = self.ctx.new_axis("iter")
+        r_axis = self.ctx.new_axis("iter")
+        lv = self._set_elem(left, l_axis)
+        rv = self._set_elem(right, r_axis)
+        member = OrReduce(r_axis, Cmp("eq", lv, rv, dtype="auto"))
+        return SumReduce(l_axis, Not(member))
+
+    def _set_elem(self, s: SSet, axis: str) -> Expr:
+        slot = self._set_slot(s)
+        rec_kind = "param" if s.source == "paramvals" else "obj"
+        self.ctx.axes[axis] = Axis(name=axis, kind=rec_kind, slot=slot)
+        if s.source == "paramvals":
+            return PVal(slot, f="val", axis=axis)
+        if s.source == "objkeys":
+            return OVal(slot, f="key", axis=axis)
+        return OVal(slot, f="val", axis=axis)
+
+    # --------------------------------------------------------------- helpers
+
+    def inline_helper(self, name: str, args: tuple) -> Expr:
+        if self.depth >= _MAX_INLINE_DEPTH:
+            raise Uncompilable(f"helper inline depth exceeded at {name}")
+        rules = self.ctx.rules[name]
+        actuals = [self.to_symbolic(a) for a in args]
+        alts: list[Expr] = []
+        for r in rules:
+            if r.kind != "function":
+                raise Uncompilable(f"{name} is not a function")
+            if r.value is not None and not (
+                isinstance(r.value, A.Scalar) and r.value.value is True
+            ):
+                raise Uncompilable(f"{name} is not a boolean helper")
+            if len(r.args) != len(actuals):
+                continue
+            env = {}
+            ok = True
+            for formal, actual in zip(r.args, actuals):
+                if not isinstance(formal, A.Var):
+                    ok = False
+                    break
+                env[formal.name] = actual
+            if not ok:
+                raise Uncompilable(f"{name}: non-var formal args")
+            sub = _ClauseCompiler(self.ctx, _body_vars(r.body) | self.needed,
+                                  env=env, depth=self.depth + 1)
+            for lit in r.body:
+                sub.literal(lit)
+            exprs = [g.expr if not g.negated else Not(g.expr)
+                     for g in sub.guards]
+            body = And(tuple(exprs)) if len(exprs) != 1 else exprs[0]
+            # axes bound inside the helper are existential at its boundary
+            for ax in sub.clause_axes:
+                body = OrReduce(ax.name, body)
+            alts.append(body)
+        if not alts:
+            raise Uncompilable(f"{name}: no applicable clauses")
+        return Or(tuple(alts)) if len(alts) > 1 else alts[0]
+
+
+# comparisons whose truth is unchanged by duplicate counting (emptiness
+# tests); (op, const) with the count on the LEFT side
+_ZERO_SAFE = {("gt", 0), ("ne", 0), ("eq", 0), ("le", 0), ("ge", 1), ("lt", 1)}
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
+
+
+def _check_zero_only(lhs: "Symbolic", rhs: "Symbolic", op: str) -> None:
+    """Reject comparisons where a dedup-sensitive count could change the
+    outcome (the never-under-fire invariant)."""
+    for count_side, other, eff_op in ((lhs, rhs, op), (rhs, lhs, _FLIP[op])):
+        if isinstance(count_side, SExpr) and count_side.zero_only:
+            if not (isinstance(other, SConst) and
+                    isinstance(other.value, (int, float)) and
+                    not isinstance(other.value, bool) and
+                    (eff_op, other.value) in _ZERO_SAFE):
+                raise Uncompilable(
+                    "set-derived counts may only be compared for emptiness "
+                    "(e.g. count(x) > 0)"
+                )
+
+
+def _body_vars(body: tuple) -> set:
+    out: set = set()
+    for lit in body:
+        _collect_vars(lit.expr, out)
+    return out

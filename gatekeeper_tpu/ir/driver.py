@@ -1,0 +1,288 @@
+"""TpuDriver: the vectorized evaluation backend.
+
+Drop-in Driver (same seam as client/drivers.py) that compiles templates to
+device programs at PutModules time and evaluates Review/Audit queries as
+batched tensor sweeps:
+
+    reviews ──extract──▶ feature tensors ─┐
+    constraints ─encode─▶ param tensors  ─┤─▶ fires[N, C]  (device)
+    match masks (host, grouped)          ─┘        │
+                                        firing pairs ──▶ interpreter
+                                                         (exact msgs)
+
+Templates outside the compilable subset (ir/compile.py) keep the inherited
+interpreter path per-template; both kinds of template coexist in one audit.
+The device filter may over-fire; the host materialization re-check is
+authoritative, so results are identical to the interpreter driver's
+(differential tests in tests/test_ir_compile.py assert exactly that).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from ..client.drivers import RegoDriver
+from ..client.types import Result
+from ..ops.strtab import MatchTables, StringTable
+from ..rego import ast as A
+from ..target.batch import match_masks
+from .compile import Uncompilable, compile_template
+from .evaljax import CompiledTemplate, EvalError
+from .features import extract_batch
+from .params import ParamEncodeError, encode_params
+
+_PREFIX_RE = re.compile(r'^templates\["([^"]+)"\]\["([^"]+)"\]$')
+
+
+class TpuDriver(RegoDriver):
+    def __init__(self):
+        super().__init__()
+        self.strtab = StringTable()
+        self.match_tables = MatchTables(self.strtab)
+        self._compiled: dict[str, Optional[CompiledTemplate]] = {}
+        self._programs: dict[str, Any] = {}
+        # generation counters for cache invalidation
+        self._constraint_gen = 0
+        self._data_gen = 0
+        # per-kind caches: {kind: {key: value}} so template updates can
+        # invalidate with the bare kind
+        self._param_cache: dict[str, dict] = {}
+        self._feat_cache: dict[str, dict] = {}
+
+    # ------------------------------------------------------------- modules
+
+    def put_modules(self, prefix: str, modules: Iterable[A.Module]) -> None:
+        mods = list(modules)
+        super().put_modules(prefix, mods)
+        m = _PREFIX_RE.match(prefix)
+        if not m:
+            return
+        kind = m.group(2)
+        self._compiled.pop(kind, None)
+        self._programs.pop(kind, None)
+        self._param_cache.pop(kind, None)
+        self._feat_cache.pop(kind, None)
+        if len(mods) != 1:
+            self._compiled[kind] = None  # libs: interpreter path for now
+            return
+        try:
+            self._programs[kind] = compile_template(mods[0], kind)
+        except Uncompilable:
+            self._compiled[kind] = None
+
+    def delete_modules(self, prefix: str) -> int:
+        n = super().delete_modules(prefix)
+        m = _PREFIX_RE.match(prefix)
+        if m:
+            self._compiled.pop(m.group(2), None)
+            self._programs.pop(m.group(2), None)
+        return n
+
+    def compiled_for(self, kind: str) -> Optional[CompiledTemplate]:
+        """Lazily wrap the Program in a device evaluator."""
+        if kind in self._compiled:
+            return self._compiled[kind]
+        prog = self._programs.get(kind)
+        if prog is None:
+            self._compiled[kind] = None
+            return None
+        try:
+            ct = CompiledTemplate(prog, self.strtab, self.match_tables)
+        except Exception:
+            ct = None
+        self._compiled[kind] = ct
+        return ct
+
+    def compiled_kinds(self) -> list[str]:
+        return sorted(k for k in self._programs)
+
+    # ---------------------------------------------------------------- data
+
+    def put_data(self, path: tuple, data: Any) -> None:
+        super().put_data(path, data)
+        self._bump(path)
+
+    def delete_data(self, path: tuple) -> bool:
+        out = super().delete_data(path)
+        self._bump(path)
+        return out
+
+    def _bump(self, path: tuple) -> None:
+        if path and path[0] == "constraints":
+            self._constraint_gen += 1
+            self._param_cache.clear()
+        else:
+            self._data_gen += 1
+            self._feat_cache.clear()
+
+    # --------------------------------------------------------------- audit
+
+    def _eval_audit(self, target: str, trace: Optional[list]) -> list[Result]:
+        constraints = self._constraints(target)
+        if not constraints:
+            return []
+        lookup_ns = self._namespace_lookup(target)
+        inventory = self._inventory_tree(target)
+        reviews = self._inventory_reviews(target)
+        by_kind: dict[str, list[dict]] = {}
+        for c in constraints:
+            by_kind.setdefault(c.get("kind"), []).append(c)
+        results: list[Result] = []
+        for kind in sorted(by_kind):
+            cons = by_kind[kind]
+            ct = self.compiled_for(kind)
+            if ct is None:
+                results.extend(self._audit_interp(target, kind, cons, reviews,
+                                                  lookup_ns, inventory, trace))
+            else:
+                results.extend(self._audit_compiled(target, kind, ct, cons,
+                                                    reviews, lookup_ns,
+                                                    inventory, trace))
+        return results
+
+    def _audit_interp(self, target, kind, cons, reviews, lookup_ns,
+                      inventory, trace) -> list[Result]:
+        out: list[Result] = []
+        mask = match_masks(cons, reviews, lookup_ns)
+        for r, review in enumerate(reviews):
+            for c, constraint in enumerate(cons):
+                if not mask[r, c]:
+                    continue
+                spec = constraint.get("spec")
+                spec = spec if isinstance(spec, dict) else {}
+                enforcement = spec.get("enforcementAction") or "deny"
+                out.extend(self._eval_template_violations(
+                    target, constraint, review, enforcement, inventory, trace))
+        return out
+
+    def _audit_compiled(self, target, kind, ct: CompiledTemplate, cons,
+                        reviews, lookup_ns, inventory, trace) -> list[Result]:
+        mask = match_masks(cons, reviews, lookup_ns)
+        cand = np.flatnonzero(mask.any(axis=1))
+        if cand.size == 0:
+            return []
+        cand_reviews = [reviews[int(i)] for i in cand]
+        feat_key = (self._data_gen, len(cand_reviews), tuple(cand[:8]))
+        try:
+            fires = self.eval_compiled(ct, kind, cand_reviews, cons,
+                                       feat_key=feat_key)
+        except Exception:
+            # eval-time failures (shapes/ops outside the evaluator's
+            # envelope) demote the template to the interpreter path
+            self._compiled[kind] = None
+            return self._audit_interp(target, kind, cons, reviews,
+                                      lookup_ns, inventory, trace)
+        hits = np.logical_and(fires, mask[cand])
+        out: list[Result] = []
+        for ri, ci in zip(*np.nonzero(hits)):
+            review = cand_reviews[int(ri)]
+            constraint = cons[int(ci)]
+            spec = constraint.get("spec")
+            spec = spec if isinstance(spec, dict) else {}
+            enforcement = spec.get("enforcementAction") or "deny"
+            out.extend(self._eval_template_violations(
+                target, constraint, review, enforcement, inventory, trace))
+        return out
+
+    # ------------------------------------------------------- compiled eval
+
+    def eval_compiled(self, ct: CompiledTemplate, kind: str,
+                      reviews: list[dict], cons: list[dict],
+                      feat_key=None) -> np.ndarray:
+        """fires[len(reviews), len(cons)] via the device program.
+        feat_key, when given, caches extraction until inventory changes."""
+        params_key = (self._constraint_gen,
+                      tuple((c.get("metadata") or {}).get("name", "")
+                            for c in cons))
+        kind_cache = self._param_cache.setdefault(kind, {})
+        enc = kind_cache.get(params_key)
+        if enc is None:
+            param_dicts = []
+            for c in cons:
+                spec = c.get("spec")
+                spec = spec if isinstance(spec, dict) else {}
+                p = spec.get("parameters")
+                param_dicts.append(p if p is not None else {})
+            enc = encode_params(ct.program, param_dicts, self.strtab,
+                                self.match_tables)
+            kind_cache.clear()
+            kind_cache[params_key] = enc
+        feats = None
+        if feat_key is not None:
+            fcache = self._feat_cache.setdefault(kind, {})
+            feats = fcache.get(feat_key)
+        if feats is None:
+            feats, _, _ = extract_batch(ct.program, self.strtab, reviews)
+            if feat_key is not None:
+                fcache.clear()
+                fcache[feat_key] = feats
+        table = self.match_tables.materialize()
+        fires = ct.fires(feats, enc, table)
+        return fires[: len(reviews)]
+
+    # ----------------------------------------------------- batched reviews
+
+    def review_batch(self, target: str, reviews: list[dict]
+                     ) -> list[list[Result]]:
+        """Evaluate many admission reviews at once (the webhook
+        micro-batcher's entry point). Compiled kinds go through the device;
+        the rest through the interpreter per review."""
+        constraints = self._constraints(target)
+        lookup_ns = self._namespace_lookup(target)
+        inventory = self._inventory_tree(target)
+        out: list[list[Result]] = [[] for _ in reviews]
+        if not constraints:
+            return out
+        # autoreject applies per review before matching (regolib/src.go:7-20)
+        from ..target.matcher import needs_autoreject
+        from ..utils.values import freeze, thaw
+        by_kind: dict[str, list[dict]] = {}
+        for c in constraints:
+            by_kind.setdefault(c.get("kind"), []).append(c)
+        for r, review in enumerate(reviews):
+            for c in constraints:
+                spec = c.get("spec")
+                spec = spec if isinstance(spec, dict) else {}
+                match = spec.get("match")
+                match = match if isinstance(match, dict) else {}
+                if needs_autoreject(match, review, lookup_ns):
+                    out[r].append(Result(
+                        msg="Namespace is not cached in OPA.",
+                        metadata={"details": {}},
+                        constraint=thaw(freeze(c)),
+                        review=review,
+                        enforcement_action=spec.get("enforcementAction")
+                        or "deny",
+                    ))
+        for kind in sorted(by_kind):
+            cons = by_kind[kind]
+            mask = match_masks(cons, reviews, lookup_ns)
+            # autorejected pairs must not also evaluate; the matcher already
+            # fails them (unresolvable namespaceSelector), so no extra work
+            ct = self.compiled_for(kind)
+            pairs = None
+            if ct is not None and mask.any():
+                cand = np.flatnonzero(mask.any(axis=1))
+                cand_reviews = [reviews[int(i)] for i in cand]
+                try:
+                    fires = self.eval_compiled(ct, kind, cand_reviews, cons)
+                    hits = np.logical_and(fires, mask[cand])
+                    pairs = [(int(cand[ri]), int(ci))
+                             for ri, ci in zip(*np.nonzero(hits))]
+                except Exception:
+                    self._compiled[kind] = None
+            if pairs is None:
+                pairs = [(r, c) for r in range(len(reviews))
+                         for c in range(len(cons)) if mask[r, c]]
+            for r, ci in pairs:
+                constraint = cons[ci]
+                spec = constraint.get("spec")
+                spec = spec if isinstance(spec, dict) else {}
+                enforcement = spec.get("enforcementAction") or "deny"
+                out[r].extend(self._eval_template_violations(
+                    target, constraint, reviews[r], enforcement, inventory,
+                    None))
+        return out

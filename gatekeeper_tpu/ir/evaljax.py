@@ -1,0 +1,415 @@
+"""JAX evaluation of compiled Programs.
+
+One traced function per template answers `fires[N, C]` — whether any
+violation clause fires for each (object, constraint) pair. Everything is
+static-shape, elementwise + reduce over small iteration axes, so XLA fuses
+the whole clause into a handful of kernels; the N axis is the data-parallel
+dimension sharded across the device mesh (parallel/), and the C axis rides
+along broadcast.
+
+Tri-state semantics (undefined vs false) are carried as (value, defined)
+pairs collapsed into literal "success" exactly where Rego collapses them
+(body-literal boundaries); `!=`/comparison definedness mirrors OPA topdown.
+The filter may over-fire — unknown-comparable kinds (arrays/objects)
+compare as "maybe" — because the host re-check of firing pairs is
+authoritative; it must never under-fire.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.strtab import MatchTables, StringTable
+from .prog import (
+    And,
+    Axis,
+    Cmp,
+    Const,
+    Expr,
+    K_ABSENT,
+    K_FALSE,
+    K_NUM,
+    K_STR,
+    MatchLookup,
+    Not,
+    Or,
+    OrReduce,
+    OVal,
+    Program,
+    PVal,
+    SumReduce,
+    Truthy,
+    Exists,
+)
+
+
+class Cell(NamedTuple):
+    sid: Any  # int32 string ids
+    num: Any  # f32 (approximate; ordering comparisons only)
+    nid: Any  # int32 interned canonical-number ids (exact equality)
+    kind: Any  # int8
+
+
+class EvalError(Exception):
+    pass
+
+
+def resolve_consts(program: Program, table: StringTable,
+                   match: MatchTables) -> Program:
+    """Replace string/pattern constants by interned ids / match rows.
+    Must run before the match table is materialized."""
+    from dataclasses import replace as dc_replace
+
+    from ..ops.strtab import canon_num
+
+    def fix(e):
+        if isinstance(e, Const):
+            if e.kind == "str":
+                return Const("id", table.intern(e.value))
+            if e.kind == "row":
+                op, pattern = e.value
+                return Const("rowidx", match.row(op, pattern))
+            if e.kind == "num":
+                return Const("numc",
+                             (float(e.value), table.intern(canon_num(e.value))))
+            return e
+        if isinstance(e, Cmp):
+            return Cmp(e.op, fix(e.lhs), fix(e.rhs), e.dtype)
+        if isinstance(e, MatchLookup):
+            return MatchLookup(fix(e.row), fix(e.sid))
+        if isinstance(e, Truthy):
+            return Truthy(fix(e.e))
+        if isinstance(e, Exists):
+            return Exists(fix(e.e))
+        if isinstance(e, And):
+            return And(tuple(fix(x) for x in e.items))
+        if isinstance(e, Or):
+            return Or(tuple(fix(x) for x in e.items))
+        if isinstance(e, Not):
+            return Not(fix(e.e), e.local_axes)
+        if isinstance(e, OrReduce):
+            return OrReduce(e.axis, fix(e.e))
+        if isinstance(e, SumReduce):
+            return SumReduce(e.axis, fix(e.e))
+        return e
+
+    clauses = tuple(
+        dc_replace(c, guards=tuple(
+            dc_replace(g, expr=fix(g.expr)) for g in c.guards))
+        for c in program.clauses
+    )
+    return Program(kind=program.kind, obj_slots=program.obj_slots,
+                   param_slots=program.param_slots, clauses=clauses,
+                   axes=program.axes)
+
+
+def _collect_axes(e: Expr, out: set) -> None:
+    if isinstance(e, (OVal, PVal)):
+        if e.axis:
+            out.add(e.axis)
+    elif isinstance(e, Cmp):
+        _collect_axes(e.lhs, out)
+        _collect_axes(e.rhs, out)
+    elif isinstance(e, MatchLookup):
+        _collect_axes(e.row, out)
+        _collect_axes(e.sid, out)
+    elif isinstance(e, (Truthy, Exists)):
+        _collect_axes(e.e, out)
+    elif isinstance(e, (And, Or)):
+        for x in e.items:
+            _collect_axes(x, out)
+    elif isinstance(e, Not):
+        _collect_axes(e.e, out)
+        out.update(e.local_axes)
+    elif isinstance(e, (OrReduce, SumReduce)):
+        _collect_axes(e.e, out)
+        out.add(e.axis)
+
+
+class _ClausePlan:
+    """Static layout for one clause: [N, C, ax0, ax1, ...]."""
+
+    def __init__(self, program: Program, clause):
+        axes: set = set(a.name for a in clause.axes)
+        for g in clause.guards:
+            _collect_axes(g.expr, axes)
+        self.axis_order = sorted(axes)
+        self.axpos = {a: 2 + i for i, a in enumerate(self.axis_order)}
+        self.rank = 2 + len(self.axis_order)
+        self.clause = clause
+        self.program = program
+        self.axis_table = program.axis_table()
+        self.slot_specs = {s.slot: s for s in program.obj_slots}
+        self.pslot_specs = {s.slot: s for s in program.param_slots}
+
+    # ---------------------------------------------------------- placement
+
+    def _slot_axes(self, slot: int, is_param: bool, leaf_axis) -> list[str]:
+        spec = self.pslot_specs[slot] if is_param else self.slot_specs[slot]
+        seg_axes = [s.axis for s in spec.segs if s.kind == "iter"]
+        if leaf_axis and (not seg_axes or seg_axes[-1] != leaf_axis):
+            if len(seg_axes) > 1:
+                raise EvalError("axis remap on multi-axis slot")
+            seg_axes = [leaf_axis]
+        return seg_axes
+
+    def place_obj(self, arr, slot: int, leaf_axis) -> Any:
+        """arr [N, K...] -> broadcastable [N, 1, ...dims...]."""
+        seg_axes = self._slot_axes(slot, False, leaf_axis)
+        shape = [arr.shape[0], 1] + [1] * (self.rank - 2)
+        src_dims = list(arr.shape[1:])
+        for ax, k in zip(seg_axes, src_dims):
+            pos = self.axpos.get(ax)
+            if pos is None:
+                raise EvalError(f"axis {ax} not in clause layout")
+            shape[pos] = k
+        # arr dims are already in seg order == sorted insertion order is NOT
+        # guaranteed; reshape works only if target positions are ascending
+        pos_list = [self.axpos[a] for a in seg_axes]
+        if pos_list != sorted(pos_list):
+            order = np.argsort(pos_list)
+            arr = jnp.transpose(arr, axes=[0] + [1 + int(i) for i in order])
+        return jnp.reshape(arr, shape)
+
+    def place_param(self, arr, slot: int, leaf_axis) -> Any:
+        """arr [C] or [C, P] -> [1, C, ...dims...]."""
+        shape = [1, arr.shape[0]] + [1] * (self.rank - 2)
+        if arr.ndim == 2:
+            seg_axes = self._slot_axes(slot, True, leaf_axis)
+            if not seg_axes:
+                raise EvalError("param array has P dim but no axis")
+            shape[self.axpos[seg_axes[-1]]] = arr.shape[1]
+        return jnp.reshape(arr, shape)
+
+    def presence(self, axis: str, feats: dict, params: dict) -> Any:
+        ax = self.axis_table[axis]
+        if ax.kind == "param":
+            kinds = params[ax.slot]["kind"]
+            return self.place_param(kinds, ax.slot, axis) != K_ABSENT
+        kinds = feats[ax.slot]["kind"]
+        return self.place_obj(kinds, ax.slot, axis) != K_ABSENT
+
+
+def _eval_cell(plan: _ClausePlan, e: Expr, feats, params) -> Cell:
+    if isinstance(e, OVal):
+        arrs = feats[e.slot]
+        if e.f == "key":
+            sid = plan.place_obj(arrs["key_id"], e.slot, e.axis)
+            num = plan.place_obj(arrs["key_num"], e.slot, e.axis)
+            kind = jnp.where(sid > 0, K_STR,
+                             jnp.where(jnp.isnan(num), K_ABSENT, K_NUM)
+                             ).astype(jnp.int8)
+            return Cell(sid, num, plan.place_obj(arrs["key_nid"], e.slot,
+                                                 e.axis), kind)
+        return Cell(
+            plan.place_obj(arrs["id"], e.slot, e.axis),
+            plan.place_obj(arrs["num"], e.slot, e.axis),
+            plan.place_obj(arrs["nid"], e.slot, e.axis),
+            plan.place_obj(arrs["kind"], e.slot, e.axis),
+        )
+    if isinstance(e, PVal):
+        arrs = params[e.slot]
+        if e.f.startswith("row:"):
+            return Cell(plan.place_param(arrs[e.f], e.slot, e.axis),
+                        jnp.float32(0), jnp.int32(0), jnp.int8(0))
+        if e.f == "key":
+            sid = plan.place_param(arrs["key_id"], e.slot, e.axis)
+            num = plan.place_param(arrs["key_num"], e.slot, e.axis)
+            kind = jnp.where(sid > 0, K_STR,
+                             jnp.where(jnp.isnan(num), K_ABSENT, K_NUM)
+                             ).astype(jnp.int8)
+            return Cell(sid, num, plan.place_param(arrs["key_nid"], e.slot,
+                                                   e.axis), kind)
+        return Cell(
+            plan.place_param(arrs["id"], e.slot, e.axis),
+            plan.place_param(arrs["num"], e.slot, e.axis),
+            plan.place_param(arrs["nid"], e.slot, e.axis),
+            plan.place_param(arrs["kind"], e.slot, e.axis),
+        )
+    if isinstance(e, Const):
+        if e.kind == "id":
+            return Cell(jnp.int32(e.value), jnp.float32(jnp.nan),
+                        jnp.int32(0), jnp.int8(K_STR))
+        if e.kind == "numc":
+            num, nid = e.value
+            return Cell(jnp.int32(0), jnp.float32(num), jnp.int32(nid),
+                        jnp.int8(K_NUM))
+        if e.kind == "bool":
+            from .prog import K_TRUE
+            return Cell(jnp.int32(0), jnp.float32(1.0 if e.value else 0.0),
+                        jnp.int32(0),
+                        jnp.int8(K_TRUE if e.value else K_FALSE))
+        if e.kind == "rowidx":
+            return Cell(jnp.int32(e.value), jnp.float32(0), jnp.int32(0),
+                        jnp.int8(0))
+        raise EvalError(f"unresolved const {e.kind}")
+    raise EvalError(f"not a value expr: {type(e).__name__}")
+
+
+def _eval_num(plan: _ClausePlan, e: Expr, feats, params, table):
+    """-> (num value, defined)."""
+    if isinstance(e, SumReduce):
+        inner = _eval_bool(plan, e.e, feats, params, table)
+        pres = plan.presence(e.axis, feats, params)
+        pos = plan.axpos[e.axis]
+        s = jnp.sum(jnp.where(jnp.logical_and(inner, pres), 1.0, 0.0),
+                    axis=pos, keepdims=True)
+        return s, jnp.bool_(True)
+    if isinstance(e, OVal) and e.f in ("count", "countz"):
+        arrs = feats[e.slot]
+        val = plan.place_obj(arrs["count"], e.slot, None)
+        if e.f == "countz":
+            return val, jnp.bool_(True)
+        kinds = plan.place_obj(arrs["kind"], e.slot, None)
+        return val, kinds != K_ABSENT
+    if isinstance(e, PVal) and e.f == "count":
+        arrs = params[e.slot]
+        val = plan.place_param(arrs["count"], e.slot, None)
+        return val, jnp.bool_(True)
+    cell = _eval_cell(plan, e, feats, params)
+    return cell.num, cell.kind == K_NUM
+
+
+def _cell_eq(l: Cell, r: Cell):
+    """(eq-ish value, defined). Arrays/objects compare as 'maybe' (True) —
+    over-fire bias; host re-check is authoritative."""
+    from .prog import K_ARR, K_OBJ
+
+    defined = jnp.logical_and(l.kind != K_ABSENT, r.kind != K_ABSENT)
+    same_kind = l.kind == r.kind
+    str_eq = jnp.logical_and(l.kind == K_STR, l.sid == r.sid)
+    num_eq = jnp.logical_and(l.kind == K_NUM, l.nid == r.nid)
+    lit_eq = jnp.logical_and(same_kind,
+                             jnp.logical_or(
+                                 jnp.logical_or(str_eq, num_eq),
+                                 jnp.logical_and(l.kind != K_STR,
+                                                 l.kind != K_NUM)))
+    maybe = jnp.logical_and(
+        same_kind, jnp.logical_or(l.kind == K_ARR, l.kind == K_OBJ))
+    return jnp.logical_or(lit_eq, maybe), defined, maybe
+
+
+def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table):
+    """-> literal success (bool array, broadcastable to the clause rank)."""
+    if isinstance(e, Cmp):
+        if e.dtype == "auto":
+            l = _eval_cell(plan, e.lhs, feats, params)
+            r = _eval_cell(plan, e.rhs, feats, params)
+            eq, defined, maybe = _cell_eq(l, r)
+            if e.op == "eq":
+                return jnp.logical_and(defined, eq)
+            if e.op == "ne":
+                # maybe-equal composites also succeed on != (over-fire bias)
+                return jnp.logical_and(defined,
+                                       jnp.logical_or(~eq, maybe))
+            raise EvalError(f"auto cmp op {e.op}")
+        lv, ld = _eval_num(plan, e.lhs, feats, params, table)
+        rv, rd = _eval_num(plan, e.rhs, feats, params, table)
+        ops = {"eq": jnp.equal, "ne": jnp.not_equal, "lt": jnp.less,
+               "le": jnp.less_equal, "gt": jnp.greater,
+               "ge": jnp.greater_equal}
+        return jnp.logical_and(jnp.logical_and(ld, rd), ops[e.op](lv, rv))
+    if isinstance(e, MatchLookup):
+        row = _eval_cell(plan, e.row, feats, params).sid
+        sv = _eval_cell(plan, e.sid, feats, params)
+        defined = jnp.logical_and(row >= 0, sv.kind == K_STR)
+        r = jnp.clip(row, 0, table.shape[0] - 1)
+        s = jnp.clip(sv.sid, 0, table.shape[1] - 1)
+        hit = table[r, s]
+        return jnp.logical_and(defined, hit)
+    if isinstance(e, Truthy):
+        c = _eval_cell(plan, e.e, feats, params)
+        return jnp.logical_and(c.kind != K_ABSENT, c.kind != K_FALSE)
+    if isinstance(e, Exists):
+        c = _eval_cell(plan, e.e, feats, params)
+        return c.kind != K_ABSENT
+    if isinstance(e, And):
+        out = None
+        for x in e.items:
+            v = _eval_bool(plan, x, feats, params, table)
+            out = v if out is None else jnp.logical_and(out, v)
+        return out if out is not None else jnp.bool_(True)
+    if isinstance(e, Or):
+        out = None
+        for x in e.items:
+            v = _eval_bool(plan, x, feats, params, table)
+            out = v if out is None else jnp.logical_or(out, v)
+        return out if out is not None else jnp.bool_(False)
+    if isinstance(e, Not):
+        inner = _eval_bool(plan, e.e, feats, params, table)
+        for ax in e.local_axes:
+            pres = plan.presence(ax, feats, params)
+            inner = jnp.any(jnp.logical_and(inner, pres),
+                            axis=plan.axpos[ax], keepdims=True)
+        return jnp.logical_not(inner)
+    if isinstance(e, OrReduce):
+        inner = _eval_bool(plan, e.e, feats, params, table)
+        pres = plan.presence(e.axis, feats, params)
+        return jnp.any(jnp.logical_and(inner, pres),
+                       axis=plan.axpos[e.axis], keepdims=True)
+    if isinstance(e, SumReduce):
+        v, _ = _eval_num(plan, e, feats, params, table)
+        return v != 0
+    if isinstance(e, Const):
+        if e.kind == "bool":
+            return jnp.bool_(bool(e.value))
+        return jnp.bool_(True)  # any non-false scalar literal succeeds
+    raise EvalError(f"unsupported expr {type(e).__name__}")
+
+
+def _eval_clause(plan: _ClausePlan, feats, params, table):
+    success = None
+    for g in plan.clause.guards:
+        v = _eval_bool(plan, g.expr, feats, params, table)
+        if g.negated:  # guards are pre-wrapped in Not by the compiler
+            v = jnp.logical_not(v)
+        success = v if success is None else jnp.logical_and(success, v)
+    if success is None:
+        success = jnp.bool_(True)
+    for a in plan.clause.axes:
+        success = jnp.logical_and(success,
+                                  plan.presence(a.name, feats, params))
+    # broadcast to full rank before reducing (success may be size-1 dims)
+    n = 1
+    c = 1
+    for slot_arrs in feats.values():
+        for arr in slot_arrs.values():
+            n = max(n, arr.shape[0])
+    for slot_arrs in params.values():
+        for arr in slot_arrs.values():
+            c = max(c, arr.shape[0])
+    target = [n, c] + [1] * (plan.rank - 2)
+    shaped = jnp.broadcast_to(success, jnp.broadcast_shapes(
+        tuple(target), success.shape))
+    axes = tuple(range(2, shaped.ndim))
+    return jnp.any(shaped, axis=axes) if axes else shaped
+
+
+class CompiledTemplate:
+    """Device-evaluable filter for one template."""
+
+    def __init__(self, program: Program, table: StringTable,
+                 match: MatchTables):
+        self.table = table
+        self.match = match
+        self.program = resolve_consts(program, table, match)
+        self.plans = [_ClausePlan(self.program, c)
+                      for c in self.program.clauses]
+        self._fn = jax.jit(self._eval)
+
+    def _eval(self, feats, params, table):
+        out = None
+        for plan in self.plans:
+            v = _eval_clause(plan, feats, params, table)
+            out = v if out is None else jnp.logical_or(out, v)
+        return out
+
+    def fires(self, feats: dict, params: dict,
+              match_table: np.ndarray) -> np.ndarray:
+        """-> bool [N, C]."""
+        return np.asarray(self._fn(feats, params, match_table))

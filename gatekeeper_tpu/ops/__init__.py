@@ -1,1 +1,3 @@
+from .strtab import MatchTables, StringTable
 
+__all__ = ["MatchTables", "StringTable"]

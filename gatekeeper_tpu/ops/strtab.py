@@ -1,0 +1,154 @@
+"""String intern table + pattern match tables.
+
+The vectorized evaluator never touches raw strings on device: every string
+in objects, parameters, and templates is interned to an int32 id, and
+string predicates (startswith/endswith/contains/re_match/equality against
+patterns) become boolean lookup tables `table[pattern_row, string_id]`
+computed once per (pattern set, vocab epoch) and gathered on device.
+
+This mirrors how the reference's hot loop spends its time — the OPA
+topdown evaluator re-running string builtins per object per constraint
+(vendor/.../opa/topdown, e.g. re_match at topdown/regex.go) — except the
+work is hoisted out of the cross-product entirely: string predicates cost
+O(vocab × patterns) once, then O(1) gathers inside the [objects ×
+constraints] sweep.
+
+Tables are built host-side with numpy here; ops/regex_nfa.py provides the
+device path (byte-NFA bitmask scan over the packed vocab bytes) used when
+the vocab is large enough to matter.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, Optional
+
+import numpy as np
+
+PAD_ID = 0  # id 0 is reserved: "absent"; real strings start at 1
+
+
+def canon_num(v) -> str:
+    """Canonical string form of a number, interned so numeric equality on
+    device is exact (f32 cells are approximate past 2^24)."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 2**53:
+        return "\x01n" + str(int(f))
+    return "\x01n" + repr(f)
+
+
+class StringTable:
+    """Append-only intern table. Ids are stable for the life of the table;
+    `epoch` increments on growth so cached match tables know to extend."""
+
+    def __init__(self):
+        self._ids: dict[str, int] = {}
+        self._strs: list[str] = ["\x00<pad>"]  # id 0 placeholder
+        self.epoch = 0
+
+    def __len__(self) -> int:
+        return len(self._strs)
+
+    def intern(self, s: str) -> int:
+        i = self._ids.get(s)
+        if i is None:
+            i = len(self._strs)
+            self._ids[s] = i
+            self._strs.append(s)
+            self.epoch += 1
+        return i
+
+    def intern_many(self, ss: Iterable[str]) -> list[int]:
+        return [self.intern(s) for s in ss]
+
+    def lookup(self, s: str) -> int:
+        """Id of s, or PAD_ID if never interned (≠ any real string)."""
+        return self._ids.get(s, PAD_ID)
+
+    def string(self, i: int) -> str:
+        return self._strs[i]
+
+    def bytes_tensor(self, max_len: int = 128) -> np.ndarray:
+        """[V, max_len] uint8, zero-padded — the device-side vocab for
+        NFA scans (ops/regex_nfa.py)."""
+        out = np.zeros((len(self._strs), max_len), dtype=np.uint8)
+        for i, s in enumerate(self._strs):
+            if i == 0:
+                continue
+            b = s.encode("utf-8")[:max_len]
+            out[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        return out
+
+
+class MatchTables:
+    """Cache of boolean match vectors over the vocab, one row per
+    (op, pattern) pair. Rows extend lazily as the vocab grows."""
+
+    def __init__(self, table: StringTable):
+        self.table = table
+        self._rows: dict[tuple[str, str], int] = {}
+        self._patterns: list[tuple[str, str]] = []
+        self._data: list[np.ndarray] = []  # per row, bool[V_at_build]
+        self._built_len: list[int] = []
+
+    def row(self, op: str, pattern: str) -> int:
+        """Row index for (op, pattern); builds the vector on first use."""
+        key = (op, pattern)
+        r = self._rows.get(key)
+        if r is None:
+            r = len(self._patterns)
+            self._rows[key] = r
+            self._patterns.append(key)
+            self._data.append(np.zeros(0, dtype=bool))
+            self._built_len.append(0)
+        return r
+
+    def _eval(self, op: str, pattern: str, strings: list[str]) -> np.ndarray:
+        if op == "startswith":
+            return np.fromiter((s.startswith(pattern) for s in strings),
+                               dtype=bool, count=len(strings))
+        if op == "endswith":
+            return np.fromiter((s.endswith(pattern) for s in strings),
+                               dtype=bool, count=len(strings))
+        if op == "contains":
+            return np.fromiter((pattern in s for s in strings),
+                               dtype=bool, count=len(strings))
+        if op == "eq":
+            return np.fromiter((s == pattern for s in strings),
+                               dtype=bool, count=len(strings))
+        if op == "re_match":
+            try:
+                rx = re.compile(pattern)
+            except re.error:
+                return np.zeros(len(strings), dtype=bool)
+            return np.fromiter((rx.search(s) is not None for s in strings),
+                               dtype=bool, count=len(strings))
+        if op == "glob":  # image-ref style glob: '*' wildcard only
+            rx = re.compile(
+                "^" + ".*".join(re.escape(p) for p in pattern.split("*")) + "$"
+            )
+            return np.fromiter((rx.search(s) is not None for s in strings),
+                               dtype=bool, count=len(strings))
+        raise ValueError(f"unknown match op {op!r}")
+
+    def materialize(self) -> np.ndarray:
+        """[R, V] bool — all rows, padded/extended to the current vocab.
+
+        OPA semantics note: re_match is anchored like Go's regexp.MatchString
+        (unanchored search), mirrored by using re.search above.
+        """
+        V = len(self.table)
+        R = max(1, len(self._patterns))
+        out = np.zeros((R, V), dtype=bool)
+        for r, (op, pattern) in enumerate(self._patterns):
+            built = self._built_len[r]
+            if built < V:
+                new = self._eval(op, pattern,
+                                 [self.table.string(i) for i in range(built, V)])
+                if built == 0:
+                    # row 0 of the vocab is the pad entry: never matches
+                    new[0] = False
+                self._data[r] = np.concatenate([self._data[r], new])
+                self._built_len[r] = V
+            out[r, : self._built_len[r]] = self._data[r]
+        return out

@@ -342,10 +342,16 @@ class Interpreter:
 
     def _solve_literal(self, lit: A.Literal, env: dict, ctx: Ctx) -> Iterator[None]:
         if lit.withs:
+            # The override must cover ONLY this literal's evaluation. A lazy
+            # `yield from` would leave the override active while subsequent
+            # literals run (generator suspended inside the with scope), so
+            # solutions are materialized eagerly — state restored — then
+            # their bindings replayed.
             saved_frame = ctx.frame
             pushed_input = 0
             pushed_data = 0
             mark = ctx.mark()
+            solutions: list[dict] = []
             try:
                 for w in lit.withs:
                     vals = list(self._iter_term(w.value, env, ctx))
@@ -371,11 +377,12 @@ class Interpreter:
                         raise RegoError(f"with target {w.target!r} unsupported")
                 ctx.frame = ctx.next_frame
                 ctx.next_frame += 1
-                yield from self._solve_literal(
+                for _ in self._solve_literal(
                     A.Literal(expr=lit.expr, negated=lit.negated, line=lit.line),
                     env,
                     ctx,
-                )
+                ):
+                    solutions.append(dict(env))
             finally:
                 ctx.undo(mark)
                 ctx.frame = saved_frame
@@ -383,6 +390,15 @@ class Interpreter:
                     ctx.input_stack.pop()
                 for _ in range(pushed_data):
                     ctx.data_overrides.pop()
+            for snap in solutions:
+                mark2 = ctx.mark()
+                try:
+                    for k, v in snap.items():
+                        if k not in env or env[k] is not v:
+                            ctx.bind(env, k, v)
+                    yield
+                finally:
+                    ctx.undo(mark2)
             return
 
         expr = lit.expr

@@ -1,0 +1,68 @@
+"""Batched constraint matching: match masks for the audit cross-product.
+
+Computes mask[R, C] (review × constraint) without R×C Python calls: match
+depends only on (group, kind, namespace[, Namespace-object identity]) for
+constraints without label selectors, so reviews are grouped by that
+signature and each (group-signature, constraint) decided once. Only
+label-selector constraints (and Namespace-kind reviews, whose own labels
+feed namespaceSelector) fall back to per-review checks.
+
+Semantics delegate to the differentially-tested predicate in matcher.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .matcher import NamespaceLookup, constraint_matches
+
+
+def _has_label_selector(constraint: dict) -> bool:
+    spec = constraint.get("spec")
+    spec = spec if isinstance(spec, dict) else {}
+    match = spec.get("match")
+    match = match if isinstance(match, dict) else {}
+    return "labelSelector" in match
+
+
+def _signature(review: dict) -> Optional[tuple]:
+    """Grouping key, or None if the review needs per-object matching."""
+    kind = review.get("kind")
+    kind = kind if isinstance(kind, dict) else {}
+    if kind.get("group", "") in ("", None) and kind.get("kind") == "Namespace":
+        return None  # object labels/name feed the match; keep per-object
+    if "_unstable" in review:
+        return None  # sideloaded namespace object varies per review
+    ns = review.get("namespace") if "namespace" in review else "\x00absent"
+    return (kind.get("group"), kind.get("kind"), ns)
+
+
+def match_masks(constraints: list[dict], reviews: list[dict],
+                lookup_ns: NamespaceLookup) -> np.ndarray:
+    R, C = len(reviews), len(constraints)
+    mask = np.zeros((R, C), dtype=bool)
+    label_dep = [_has_label_selector(c) for c in constraints]
+
+    group_cache: dict[tuple, dict[int, bool]] = {}
+    for r, review in enumerate(reviews):
+        sig = _signature(review)
+        if sig is None:
+            for c, constraint in enumerate(constraints):
+                mask[r, c] = constraint_matches(constraint, review, lookup_ns)
+            continue
+        cached = group_cache.get(sig)
+        if cached is None:
+            cached = {}
+            group_cache[sig] = cached
+        for c, constraint in enumerate(constraints):
+            if label_dep[c]:
+                mask[r, c] = constraint_matches(constraint, review, lookup_ns)
+                continue
+            hit = cached.get(c)
+            if hit is None:
+                hit = constraint_matches(constraint, review, lookup_ns)
+                cached[c] = hit
+            mask[r, c] = hit
+    return mask

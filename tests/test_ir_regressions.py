@@ -1,0 +1,184 @@
+"""Regressions for compiler/driver defects found in review.
+
+Each case pins an under-fire or crash scenario: duplicate-sensitive set
+counts, count of set comprehensions, bare scalar guards, large-integer
+equality, autoreject union semantics, and template-update cache staleness.
+"""
+
+import pytest
+
+from gatekeeper_tpu.client import Backend, RegoDriver
+from gatekeeper_tpu.ir import TpuDriver
+from gatekeeper_tpu.target import AugmentedUnstructured, K8sValidationTarget
+
+
+def mk(template_rego, kind="K8sTest", name=None):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": name or kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                         "rego": template_rego}],
+        },
+    }
+
+
+def constraint(kind, name, params=None):
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": kind, "metadata": {"name": name},
+        "spec": {"parameters": params or {}},
+    }
+
+
+def both_clients(template, constraints, objects):
+    clients = []
+    drivers = []
+    for cls in (RegoDriver, TpuDriver):
+        d = cls()
+        c = Backend(d).new_client([K8sValidationTarget()])
+        c.add_template(template)
+        for con in constraints:
+            c.add_constraint(con)
+        for o in objects:
+            c.add_data(o)
+        drivers.append(d)
+        clients.append(c)
+    return drivers, clients
+
+
+def names(results):
+    return sorted(r.resource["metadata"]["name"] for r in results)
+
+
+def test_dup_sensitive_count_never_underfires():
+    """count(required - provided) == 1 with duplicated required values must
+    not be compiled with a duplicate-counting sum."""
+    rego = """
+package k8stest
+violation[{"msg": "exactly one missing"}] {
+  required := {l | l := input.parameters.labels[_]}
+  provided := {l | input.review.object.metadata.labels[l]}
+  missing := required - provided
+  count(missing) == 1
+}
+"""
+    objs = [{"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": "n0"}}]
+    (rd, td), (rc, tc) = both_clients(
+        mk(rego), [constraint("K8sTest", "c", {"labels": ["a", "a"]})], objs)
+    assert names(rc.audit().results()) == names(tc.audit().results()) == ["n0"]
+
+
+def test_count_of_set_comprehension():
+    rego = """
+package k8stest
+violation[{"msg": "no labels"}] {
+  provided := {l | input.review.object.metadata.labels[l]}
+  count(provided) == 0
+}
+"""
+    objs = [
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "bare"}},
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": "labeled", "labels": {"x": "y"}}},
+    ]
+    (rd, td), (rc, tc) = both_clients(mk(rego), [constraint("K8sTest", "c")],
+                                      objs)
+    assert names(rc.audit().results()) == names(tc.audit().results()) == ["bare"]
+
+
+def test_bare_scalar_guard():
+    rego = """
+package k8stest
+violation[{"msg": "hit"}] {
+  true
+  input.review.object.metadata.name == "target"
+}
+"""
+    objs = [{"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "target", "namespace": "d"}},
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "other", "namespace": "d"}}]
+    (rd, td), (rc, tc) = both_clients(mk(rego), [constraint("K8sTest", "c")],
+                                      objs)
+    assert names(rc.audit().results()) == names(tc.audit().results()) == \
+        ["target"]
+
+
+def test_large_integer_equality_exact():
+    rego = """
+package k8stest
+violation[{"msg": "uid mismatch"}] {
+  input.review.object.spec.uid != input.parameters.uid
+}
+"""
+    objs = [{"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "big", "namespace": "d"},
+             "spec": {"uid": 16777216}},
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "same", "namespace": "d"},
+             "spec": {"uid": 16777217}}]
+    (rd, td), (rc, tc) = both_clients(
+        mk(rego), [constraint("K8sTest", "c", {"uid": 16777217})], objs)
+    assert names(rc.audit().results()) == names(tc.audit().results()) == \
+        ["big"]
+
+
+def test_autoreject_unions_with_matching():
+    """For a Namespace-kind review, autoreject AND template violations both
+    surface (reference hook rules 1+2 union) on every driver path."""
+    rego = """
+package k8stest
+violation[{"msg": "always"}] { input.review.object.metadata.name }
+"""
+    con = {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sTest", "metadata": {"name": "c"},
+        "spec": {"match": {"namespaceSelector": {
+            "matchLabels": {"team": "a"}}}},
+    }
+    ns = {"apiVersion": "v1", "kind": "Namespace",
+          "metadata": {"name": "n", "labels": {"team": "a"}}}
+    for cls in (RegoDriver, TpuDriver):
+        d = cls()
+        c = Backend(d).new_client([K8sValidationTarget()])
+        c.add_template(mk(rego))
+        c.add_constraint(con)
+        msgs = sorted(r.msg for r in c.review(AugmentedUnstructured(ns)).results())
+        assert msgs == ["Namespace is not cached in OPA.", "always"], \
+            f"{cls.__name__}: {msgs}"
+
+
+def test_template_update_invalidates_param_cache():
+    """Updating a template's rego with unchanged constraints must re-encode
+    parameters for the new program."""
+    rego_a = """
+package k8stest
+violation[{"msg": "a"}] {
+  c := input.review.object.spec.containers[_]
+  startswith(c.image, input.parameters.prefix)
+}
+"""
+    rego_b = """
+package k8stest
+violation[{"msg": "b"}] {
+  input.review.object.metadata.name == input.parameters.name
+}
+"""
+    d = TpuDriver()
+    c = Backend(d).new_client([K8sValidationTarget()])
+    c.add_template(mk(rego_a))
+    c.add_constraint(constraint("K8sTest", "c",
+                                {"prefix": "evil/", "name": "p2"}))
+    c.add_data({"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "p1", "namespace": "d"},
+                "spec": {"containers": [{"image": "evil/x"}]}})
+    c.add_data({"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "p2", "namespace": "d"},
+                "spec": {"containers": [{"image": "good/x"}]}})
+    assert names(c.audit().results()) == ["p1"]
+    c.add_template(mk(rego_b))
+    assert names(c.audit().results()) == ["p2"]
