@@ -165,7 +165,10 @@ class TpuDriver(RegoDriver):
         if cand.size == 0:
             return []
         cand_reviews = [reviews[int(i)] for i in cand]
-        feat_key = (self._data_gen, len(cand_reviews), tuple(cand[:8]))
+        # key must pin the exact candidate set: constraint updates can shift
+        # membership without changing _data_gen or the count
+        feat_key = (self._data_gen, self._constraint_gen,
+                    hash(cand.tobytes()))
         try:
             fires = self.eval_compiled(ct, kind, cand_reviews, cons,
                                        feat_key=feat_key)
@@ -219,7 +222,7 @@ class TpuDriver(RegoDriver):
             if feat_key is not None:
                 fcache.clear()
                 fcache[feat_key] = feats
-        table = self.match_tables.materialize()
+        table = self.match_tables.materialize_packed()
         fires = ct.fires(feats, enc, table)
         return fires[: len(reviews)]
 

@@ -132,15 +132,21 @@ def _collect_axes(e: Expr, out: set) -> None:
 
 
 class _ClausePlan:
-    """Static layout for one clause: [N, C, ax0, ax1, ...]."""
+    """Static layout for one clause: [N, ax0, ax1, ..., C].
+
+    C (constraints, typically hundreds) sits in the minor-most dim so TPU
+    (8,128) tiling pads it by <3%; small iteration axes live in the middle
+    where padding is cheap. Putting axes minor-most instead costs up to
+    32x in both memory and VPU lanes."""
 
     def __init__(self, program: Program, clause):
         axes: set = set(a.name for a in clause.axes)
         for g in clause.guards:
             _collect_axes(g.expr, axes)
         self.axis_order = sorted(axes)
-        self.axpos = {a: 2 + i for i, a in enumerate(self.axis_order)}
+        self.axpos = {a: 1 + i for i, a in enumerate(self.axis_order)}
         self.rank = 2 + len(self.axis_order)
+        self.cpos = self.rank - 1
         self.clause = clause
         self.program = program
         self.axis_table = program.axis_table()
@@ -159,9 +165,9 @@ class _ClausePlan:
         return seg_axes
 
     def place_obj(self, arr, slot: int, leaf_axis) -> Any:
-        """arr [N, K...] -> broadcastable [N, 1, ...dims...]."""
+        """arr [N, K...] -> broadcastable [N, ...dims..., 1]."""
         seg_axes = self._slot_axes(slot, False, leaf_axis)
-        shape = [arr.shape[0], 1] + [1] * (self.rank - 2)
+        shape = [arr.shape[0]] + [1] * (self.rank - 1)
         src_dims = list(arr.shape[1:])
         for ax, k in zip(seg_axes, src_dims):
             pos = self.axpos.get(ax)
@@ -177,13 +183,14 @@ class _ClausePlan:
         return jnp.reshape(arr, shape)
 
     def place_param(self, arr, slot: int, leaf_axis) -> Any:
-        """arr [C] or [C, P] -> [1, C, ...dims...]."""
-        shape = [1, arr.shape[0]] + [1] * (self.rank - 2)
+        """arr [C] or [C, P] -> [1, ...dims..., C]."""
+        shape = [1] * (self.rank - 1) + [arr.shape[0]]
         if arr.ndim == 2:
             seg_axes = self._slot_axes(slot, True, leaf_axis)
             if not seg_axes:
                 raise EvalError("param array has P dim but no axis")
             shape[self.axpos[seg_axes[-1]]] = arr.shape[1]
+            arr = jnp.moveaxis(arr, 0, -1)  # [P, C]
         return jnp.reshape(arr, shape)
 
     def presence(self, axis: str, feats: dict, params: dict) -> Any:
@@ -315,12 +322,26 @@ def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table):
                "ge": jnp.greater_equal}
         return jnp.logical_and(jnp.logical_and(ld, rd), ops[e.op](lv, rv))
     if isinstance(e, MatchLookup):
+        # table is bit-packed [V, W] uint32 (strtab.materialize_packed):
+        # gather the string's row-bitmask words (1-D gather) and test the
+        # pattern row's bit — a single fused int32 AND per (obj, constraint)
+        # cell, no extra broadcast dim and no 2-D fancy-index tuples.
         row = _eval_cell(plan, e.row, feats, params).sid
         sv = _eval_cell(plan, e.sid, feats, params)
         defined = jnp.logical_and(row >= 0, sv.kind == K_STR)
-        r = jnp.clip(row, 0, table.shape[0] - 1)
-        s = jnp.clip(sv.sid, 0, table.shape[1] - 1)
-        hit = table[r, s]
+        V, W = table.shape
+        r = jnp.clip(row, 0, W * 32 - 1)
+        s = jnp.clip(sv.sid, 0, V - 1)
+        per_string = jnp.take(table, s, axis=0)  # [..., W]
+        if W == 1:
+            word = per_string[..., 0]
+        else:
+            word_idx = (r >> 5)[..., None]
+            sel = word_idx == jnp.arange(W)
+            word = jnp.sum(jnp.where(sel, per_string, 0), axis=-1,
+                           dtype=jnp.uint32)
+        rbit = (jnp.uint32(1) << (r & 31).astype(jnp.uint32))
+        hit = (word & rbit) != 0
         return jnp.logical_and(defined, hit)
     if isinstance(e, Truthy):
         c = _eval_cell(plan, e.e, feats, params)
@@ -383,11 +404,15 @@ def _eval_clause(plan: _ClausePlan, feats, params, table):
     for slot_arrs in params.values():
         for arr in slot_arrs.values():
             c = max(c, arr.shape[0])
-    target = [n, c] + [1] * (plan.rank - 2)
-    shaped = jnp.broadcast_to(success, jnp.broadcast_shapes(
-        tuple(target), success.shape))
-    axes = tuple(range(2, shaped.ndim))
-    return jnp.any(shaped, axis=axes) if axes else shaped
+    # reduce FIRST, broadcast last: materializing the full-rank success
+    # tensor would carry tiny minor dims that TPU layouts pad to (8,128)
+    # tiles — reducing lets XLA fuse the whole clause into the reduction.
+    # layout is [N, axes..., C]; reduce the middle dims.
+    if success.ndim > 2:
+        success = jnp.any(success, axis=tuple(range(1, success.ndim - 1)))
+    if success.ndim == 1:
+        success = success[None, :]
+    return jnp.broadcast_to(success, (n, c))
 
 
 class CompiledTemplate:
@@ -401,6 +426,7 @@ class CompiledTemplate:
         self.plans = [_ClausePlan(self.program, c)
                       for c in self.program.clauses]
         self._fn = jax.jit(self._eval)
+        self._scan_cache: dict[int, Any] = {}
 
     def _eval(self, feats, params, table):
         out = None
@@ -413,3 +439,63 @@ class CompiledTemplate:
               match_table: np.ndarray) -> np.ndarray:
         """-> bool [N, C]."""
         return np.asarray(self._fn(feats, params, match_table))
+
+    def fires_chunked(self, feats: dict, params: dict,
+                      match_table: np.ndarray,
+                      chunk: int = 8192) -> np.ndarray:
+        """Chunk the N axis so [N, C, K...] intermediates stay bounded.
+
+        Single dispatch: inputs live on device whole, the chunk loop is a
+        lax.map inside the jitted fn (no per-chunk host→device transfers —
+        they dominate when the chip is reached over a network tunnel)."""
+        n = next(iter(next(iter(feats.values())).values())).shape[0]
+        if n <= chunk:
+            return self.fires(feats, params, match_table)
+        if n % chunk:
+            pad_n = ((n + chunk - 1) // chunk) * chunk
+            feats = jax.tree_util.tree_map(
+                lambda a: jnp.pad(a, [(0, pad_n - n)] + [(0, 0)] *
+                                  (a.ndim - 1)), feats)
+        out = self._fn_scan(feats, params, match_table, chunk)
+        # slice the bit-unpack padding back to the true C: the first param
+        # array's leading dim, or 1 when the program has no parameters
+        # (_eval_clause broadcasts C=1 then)
+        c = 1
+        for arrs in params.values():
+            for a in arrs.values():
+                c = a.shape[0]
+                break
+            break
+        return np.asarray(out)[:n, :c]
+
+    def _fn_scan(self, feats, params, match_table, chunk: int):
+        """Verdicts return bit-packed over C (32x smaller device→host
+        transfer — decisive when the chip sits behind a network tunnel)."""
+        fn = self._scan_cache.get(chunk)
+        if fn is None:
+            def run(feats, params, table):
+                def reshape(a):
+                    return a.reshape((-1, chunk) + a.shape[1:])
+                chunked = jax.tree_util.tree_map(reshape, feats)
+
+                def body(ch):
+                    fires = self._eval(ch, params, table)  # [chunk, C]
+                    c = fires.shape[-1]
+                    w = (c + 31) // 32
+                    pad = w * 32 - c
+                    if pad:
+                        fires = jnp.pad(fires, ((0, 0), (0, pad)))
+                    bits = fires.reshape(fires.shape[0], w, 32)
+                    weights = (jnp.uint32(1) << jnp.arange(32,
+                                                           dtype=jnp.uint32))
+                    return jnp.sum(
+                        jnp.where(bits, weights, jnp.uint32(0)), axis=-1,
+                        dtype=jnp.uint32)
+                outs = jax.lax.map(body, chunked)
+                return outs.reshape((-1,) + outs.shape[2:])
+            fn = jax.jit(run)
+            self._scan_cache[chunk] = fn
+        packed = np.asarray(fn(feats, params, match_table))
+        # unpack on host (vectorized)
+        bits = (packed[..., None] >> np.arange(32, dtype=np.uint32)) & 1
+        return bits.reshape(packed.shape[0], -1).astype(bool)

@@ -45,12 +45,12 @@ K_OBJ = 7
 
 @dataclass(frozen=True)
 class Seg:
-    """One path segment: a fixed field, a list-iteration axis, or a
-    map-iteration axis."""
+    """One path segment: a fixed field or an iteration axis (iteration
+    covers both arrays — integer keys — and objects — string keys)."""
 
-    kind: str  # "field" | "list" | "map"
+    kind: str  # "field" | "iter"
     name: str = ""  # field name for "field"
-    axis: str = ""  # axis id for "list"/"map"
+    axis: str = ""  # axis id for "iter"
 
 
 @dataclass(frozen=True)

@@ -90,6 +90,8 @@ class MatchTables:
         self._patterns: list[tuple[str, str]] = []
         self._data: list[np.ndarray] = []  # per row, bool[V_at_build]
         self._built_len: list[int] = []
+        self._packed_cache: np.ndarray | None = None
+        self._packed_key: tuple | None = None
 
     def row(self, op: str, pattern: str) -> int:
         """Row index for (op, pattern); builds the vector on first use."""
@@ -130,6 +132,28 @@ class MatchTables:
             return np.fromiter((rx.search(s) is not None for s in strings),
                                dtype=bool, count=len(strings))
         raise ValueError(f"unknown match op {op!r}")
+
+    def materialize_packed(self) -> np.ndarray:
+        """[V, W] uint32 — bit r of word w set iff pattern row (w*32+r)
+        matches the string. The device predicate is then a single fused
+        int32 AND against a per-row bitmask (no extra broadcast dim).
+
+        Cached until the vocab or pattern set grows, so steady-state audits
+        reuse the same ndarray (and JAX skips re-uploading the buffer)."""
+        key = (self.table.epoch, len(self._patterns))
+        if self._packed_cache is not None and self._packed_key == key:
+            return self._packed_cache
+        table = self.materialize()  # [R, V]
+        R, V = table.shape
+        W = max(1, (R + 31) // 32)
+        bits = np.zeros((V, W * 32), dtype=bool)
+        bits[:, :R] = table.T
+        weights = (1 << np.arange(32, dtype=np.uint64)).astype(np.uint64)
+        words = (bits.reshape(V, W, 32).astype(np.uint64) * weights).sum(
+            axis=-1).astype(np.uint32)
+        self._packed_cache = words
+        self._packed_key = key
+        return words
 
     def materialize(self) -> np.ndarray:
         """[R, V] bool — all rows, padded/extended to the current vocab.

@@ -1,0 +1,62 @@
+"""Explicit-collective audit aggregation (shard_map over the mesh).
+
+Where mesh.py lets XLA infer collectives from shardings, this module spells
+them out with shard_map for the steps whose communication pattern we want
+pinned down (and for the multi-chip dry-run to exercise real collectives):
+
+  * per-constraint violation counts: local partial sums on each data shard,
+    then psum over "data" (rides ICI within a slice);
+  * verdict gather: each data shard's firing pairs all-gathered so the host
+    materializes messages once.
+
+This is the TPU-native replacement for the reference's single-goroutine
+audit aggregation (pkg/audit/manager.go:337-385 getUpdateListsFromAudit...).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def make_audit_step(eval_fn, mesh: Mesh):
+    """Build the sharded audit step: feats sharded on data, params sharded
+    on model, returns (fires[N, C] fully addressable, counts[C] replicated).
+
+    eval_fn(feats, params, table) -> fires[N_local, C_local] must be pure.
+    """
+
+    fspec = lambda a: P("data", *([None] * (a.ndim - 1)))
+    pspec = lambda a: P("model", *([None] * (a.ndim - 1)))
+
+    n_data = mesh.shape["data"]
+
+    def step(feats, params, table, n_valid):
+        def local(feats_l, params_l, table_l, n_valid_l):
+            fires = eval_fn(feats_l, params_l, table_l)  # [n_loc, c_loc]
+            # mask padding rows: this shard covers global rows
+            # [idx*n_loc, (idx+1)*n_loc)
+            idx = jax.lax.axis_index("data")
+            n_loc = fires.shape[0]
+            row = idx * n_loc + jnp.arange(n_loc)
+            fires = jnp.logical_and(fires, (row < n_valid_l)[:, None])
+            # per-constraint totals: partial on this shard, summed over the
+            # data axis (ICI psum), replicated over data
+            counts = jax.lax.psum(
+                jnp.sum(fires, axis=0, dtype=jnp.int32), "data")
+            return fires, counts
+
+        feats_specs = jax.tree_util.tree_map(fspec, feats)
+        params_specs = jax.tree_util.tree_map(pspec, params)
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(feats_specs, params_specs, P(None, None), P()),
+            out_specs=(P("data", "model"), P("model")),
+            check_rep=False,
+        )(feats, params, table, n_valid)
+
+    return jax.jit(step)
