@@ -1,0 +1,391 @@
+"""Kubernetes API access: protocol, in-memory fake, REST client.
+
+The control plane (controllers/audit/webhook/certs) talks to this seam
+only. `FakeKube` is the test double standing in for envtest (SURVEY.md §4
+tier 3: the reference boots etcd+apiserver; here an in-memory apiserver
+model with watch streams gives the same reconciler-level coverage without
+binaries). `RestKubeClient` is the production path (kubeconfig/in-cluster
+service account against the real API server).
+
+Objects are unstructured dicts. GVKs are (group, version, kind) tuples;
+resources are addressed by (gvk, namespace, name).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import ssl
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+GVK = tuple  # (group, version, kind)
+
+
+class KubeError(Exception):
+    pass
+
+
+class Conflict(KubeError):
+    pass
+
+
+class NotFound(KubeError):
+    pass
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: dict
+
+
+def gvk_of(obj: dict) -> GVK:
+    api_version = obj.get("apiVersion") or ""
+    group, _, version = api_version.rpartition("/")
+    return (group, version, obj.get("kind") or "")
+
+
+def _key(obj: dict) -> tuple:
+    meta = obj.get("metadata") or {}
+    return (meta.get("namespace") or "", meta.get("name") or "")
+
+
+class FakeKube:
+    """In-memory cluster: CRUD + watch streams + discovery.
+
+    Thread-safe; watch subscribers get events through callback queues the
+    watch manager drains. Maintains resourceVersion counters and performs
+    conflict detection on update, mirroring apiserver semantics the
+    reconcilers rely on (retry loops, status subresource).
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: dict[GVK, dict[tuple, dict]] = {}
+        self._rv = 0
+        self._watchers: dict[GVK, list[Callable[[WatchEvent], None]]] = {}
+        # discovery: gvk -> {"namespaced": bool, "verbs": [...]}
+        self._discovery: dict[GVK, dict] = {}
+
+    # ------------------------------------------------------------ discovery
+
+    def register_kind(self, gvk: GVK, namespaced: bool = True,
+                      listable: bool = True) -> None:
+        with self._lock:
+            verbs = ["get", "create", "update", "delete", "watch"]
+            if listable:
+                verbs.append("list")
+            self._discovery[gvk] = {"namespaced": namespaced, "verbs": verbs}
+
+    def server_preferred_resources(self) -> list[dict]:
+        """Discovery listing (reference audit manager.go:195-229)."""
+        with self._lock:
+            out = []
+            for (g, v, k), info in self._discovery.items():
+                out.append({"group": g, "version": v, "kind": k,
+                            "namespaced": info["namespaced"],
+                            "verbs": list(info["verbs"])})
+            return out
+
+    # ---------------------------------------------------------------- CRUD
+
+    def _bump(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def create(self, obj: dict) -> dict:
+        with self._lock:
+            gvk = gvk_of(obj)
+            bucket = self._store.setdefault(gvk, {})
+            key = _key(obj)
+            if key in bucket:
+                raise Conflict(f"{gvk} {key} already exists")
+            stored = copy.deepcopy(obj)
+            stored.setdefault("metadata", {})["resourceVersion"] = self._bump()
+            bucket[key] = stored
+        # notify OUTSIDE the lock: subscribers (watch manager fan-out) take
+        # their own locks and may call back into this client — holding our
+        # lock here is a lock-order inversion with WatchManager._lock
+        self._notify(gvk, WatchEvent("ADDED", copy.deepcopy(stored)))
+        return copy.deepcopy(stored)
+
+    def get(self, gvk: GVK, name: str, namespace: str = "") -> dict:
+        with self._lock:
+            obj = self._store.get(tuple(gvk), {}).get((namespace, name))
+            if obj is None:
+                raise NotFound(f"{gvk} {namespace}/{name}")
+            return copy.deepcopy(obj)
+
+    def update(self, obj: dict, subresource: str = "") -> dict:
+        with self._lock:
+            gvk = gvk_of(obj)
+            bucket = self._store.setdefault(gvk, {})
+            key = _key(obj)
+            cur = bucket.get(key)
+            if cur is None:
+                raise NotFound(f"{gvk} {key}")
+            rv = (obj.get("metadata") or {}).get("resourceVersion")
+            cur_rv = (cur.get("metadata") or {}).get("resourceVersion")
+            if rv is not None and rv != cur_rv:
+                raise Conflict(f"{gvk} {key}: resourceVersion {rv} != {cur_rv}")
+            stored = copy.deepcopy(obj)
+            if subresource == "status":
+                # status updates only touch .status
+                merged = copy.deepcopy(cur)
+                merged["status"] = copy.deepcopy(obj.get("status"))
+                stored = merged
+            stored.setdefault("metadata", {})["resourceVersion"] = self._bump()
+            bucket[key] = stored
+        self._notify(gvk, WatchEvent("MODIFIED", copy.deepcopy(stored)))
+        return copy.deepcopy(stored)
+
+    def apply(self, obj: dict) -> dict:
+        """create-or-update convenience."""
+        try:
+            return self.create(obj)
+        except Conflict:
+            meta = obj.setdefault("metadata", {})
+            cur = self.get(gvk_of(obj), meta.get("name") or "",
+                           meta.get("namespace") or "")
+            meta["resourceVersion"] = cur["metadata"]["resourceVersion"]
+            return self.update(obj)
+
+    def delete(self, gvk: GVK, name: str, namespace: str = "") -> None:
+        with self._lock:
+            bucket = self._store.get(tuple(gvk), {})
+            obj = bucket.pop((namespace, name), None)
+            if obj is None:
+                raise NotFound(f"{gvk} {namespace}/{name}")
+        self._notify(tuple(gvk), WatchEvent("DELETED", copy.deepcopy(obj)))
+
+    def list(self, gvk: GVK, namespace: Optional[str] = None) -> list[dict]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in sorted(self._store.get(tuple(gvk), {}).items()):
+                if namespace is None or ns == namespace:
+                    out.append(copy.deepcopy(obj))
+            return out
+
+    # --------------------------------------------------------------- watch
+
+    def watch(self, gvk: GVK, callback: Callable[[WatchEvent], None],
+              send_initial: bool = True) -> Callable[[], None]:
+        """Subscribe; returns an unsubscribe fn. With send_initial, current
+        objects are delivered as ADDED first (informer list+watch)."""
+        initial = self.list(gvk) if send_initial else []
+        with self._lock:
+            self._watchers.setdefault(tuple(gvk), []).append(callback)
+        for obj in initial:
+            callback(WatchEvent("ADDED", obj))
+
+        def cancel():
+            with self._lock:
+                subs = self._watchers.get(tuple(gvk), [])
+                if callback in subs:
+                    subs.remove(callback)
+
+        return cancel
+
+    def _notify(self, gvk: GVK, event: WatchEvent) -> None:
+        with self._lock:  # snapshot only; callbacks run outside the lock
+            subs = list(self._watchers.get(tuple(gvk), []))
+        for cb in subs:
+            cb(event)
+
+
+# --------------------------------------------------------------- REST client
+
+
+def _plural(kind: str) -> str:
+    lower = kind.lower()
+    if lower.endswith("s") or lower.endswith("x") or lower.endswith("ch"):
+        return lower + "es"
+    if lower.endswith("y"):
+        return lower[:-1] + "ies"
+    return lower + "s"
+
+
+class RestKubeClient:
+    """Minimal apiserver REST client (in-cluster or kubeconfig-less;
+    production deployments run in-cluster with the mounted service
+    account). Same surface as FakeKube minus watch streaming — the watch
+    manager polls list+resourceVersion for this client.
+
+    Reference counterpart: controller-runtime's client + discovery
+    (vendored k8s client-go in the reference tree).
+    """
+
+    SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def __init__(self, base_url: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None):
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.base_url = base_url or (f"https://{host}:{port}" if host else
+                                     "https://kubernetes.default.svc")
+        if token is None and os.path.exists(f"{self.SA_DIR}/token"):
+            with open(f"{self.SA_DIR}/token") as f:
+                token = f.read().strip()
+        self.token = token
+        ctx = ssl.create_default_context()
+        ca = ca_file or f"{self.SA_DIR}/ca.crt"
+        if os.path.exists(ca):
+            ctx.load_verify_locations(ca)
+        else:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        self._ssl = ctx
+        self._plurals: dict[GVK, tuple[str, bool]] = {}
+
+    def _request(self, method: str, path: str, body: Any = None) -> Any:
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ssl,
+                                        timeout=30) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise NotFound(path) from None
+            if e.code == 409:
+                raise Conflict(path) from None
+            raise KubeError(f"{method} {path}: HTTP {e.code}") from None
+
+    def _resource_path(self, gvk: GVK, namespace: str = "") -> str:
+        group, version, kind = gvk
+        info = self._plurals.get(tuple(gvk))
+        if info is None:
+            plural, namespaced = self._discover(gvk)
+        else:
+            plural, namespaced = info
+        prefix = f"/api/{version}" if not group else f"/apis/{group}/{version}"
+        if namespaced and namespace:
+            return f"{prefix}/namespaces/{namespace}/{plural}"
+        return f"{prefix}/{plural}"
+
+    def _discover(self, gvk: GVK) -> tuple[str, bool]:
+        group, version, kind = gvk
+        path = f"/api/{version}" if not group else f"/apis/{group}/{version}"
+        try:
+            rl = self._request("GET", path)
+            for r in rl.get("resources", []):
+                if r.get("kind") == kind and "/" not in r.get("name", ""):
+                    out = (r["name"], bool(r.get("namespaced")))
+                    self._plurals[tuple(gvk)] = out
+                    return out
+        except KubeError:
+            pass
+        out = (_plural(kind), True)
+        self._plurals[tuple(gvk)] = out
+        return out
+
+    def server_preferred_resources(self) -> list[dict]:
+        out = []
+        groups = self._request("GET", "/apis").get("groups", [])
+        versions = [("", "v1", "/api/v1")]
+        for g in groups:
+            pv = (g.get("preferredVersion") or {}).get("groupVersion")
+            if pv:
+                versions.append((g["name"], pv.split("/")[-1], f"/apis/{pv}"))
+        for group, version, path in versions:
+            try:
+                rl = self._request("GET", path)
+            except KubeError:
+                continue
+            for r in rl.get("resources", []):
+                if "/" in r.get("name", ""):
+                    continue  # subresources
+                out.append({"group": group, "version": version,
+                            "kind": r.get("kind"),
+                            "namespaced": bool(r.get("namespaced")),
+                            "verbs": r.get("verbs") or []})
+        return out
+
+    def create(self, obj: dict) -> dict:
+        meta = obj.get("metadata") or {}
+        path = self._resource_path(gvk_of(obj), meta.get("namespace") or "")
+        return self._request("POST", path, obj)
+
+    def get(self, gvk: GVK, name: str, namespace: str = "") -> dict:
+        return self._request(
+            "GET", f"{self._resource_path(gvk, namespace)}/{name}")
+
+    def update(self, obj: dict, subresource: str = "") -> dict:
+        meta = obj.get("metadata") or {}
+        path = (f"{self._resource_path(gvk_of(obj), meta.get('namespace') or '')}"
+                f"/{meta.get('name')}")
+        if subresource:
+            path += f"/{subresource}"
+        return self._request("PUT", path, obj)
+
+    def apply(self, obj: dict) -> dict:
+        try:
+            return self.create(obj)
+        except Conflict:
+            meta = obj.setdefault("metadata", {})
+            cur = self.get(gvk_of(obj), meta.get("name") or "",
+                           meta.get("namespace") or "")
+            meta["resourceVersion"] = cur["metadata"]["resourceVersion"]
+            return self.update(obj)
+
+    def delete(self, gvk: GVK, name: str, namespace: str = "") -> None:
+        self._request(
+            "DELETE", f"{self._resource_path(gvk, namespace)}/{name}")
+
+    def list(self, gvk: GVK, namespace: Optional[str] = None) -> list[dict]:
+        rl = self._request("GET", self._resource_path(gvk, namespace or ""))
+        items = rl.get("items") or []
+        group, version, kind = gvk
+        api_version = version if not group else f"{group}/{version}"
+        for it in items:
+            it.setdefault("apiVersion", api_version)
+            it.setdefault("kind", kind)
+        return items
+
+    def watch(self, gvk: GVK, callback, send_initial: bool = True):
+        """Poll-based watch fallback: list on an interval and diff.
+        Real streaming watch is a future optimization."""
+        stop = threading.Event()
+
+        def loop():
+            # key -> (resourceVersion, last object) so DELETED events carry
+            # the full identity (reconcilers read kind/apiVersion from it)
+            known: dict[tuple, tuple] = {}
+            first = True
+            while not stop.is_set():
+                try:
+                    objs = self.list(gvk)
+                except KubeError:
+                    time.sleep(2)
+                    continue
+                seen = {}
+                for o in objs:
+                    k = _key(o)
+                    rv = (o.get("metadata") or {}).get("resourceVersion")
+                    seen[k] = (rv, o)
+                    if k not in known:
+                        if not first or send_initial:
+                            callback(WatchEvent("ADDED", o))
+                    elif known[k][0] != rv:
+                        callback(WatchEvent("MODIFIED", o))
+                for k in set(known) - set(seen):
+                    callback(WatchEvent("DELETED", known[k][1]))
+                known = seen
+                first = False
+                stop.wait(2.0)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return stop.set

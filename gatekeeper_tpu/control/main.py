@@ -1,0 +1,187 @@
+"""Process entrypoint.
+
+Counterpart of the reference main.go:99-252: flag surface, logging setup,
+client construction over the TPU driver, controller/watch/webhook/audit/
+upgrade/metrics wiring, graceful teardown. The same process serves either
+or both operations (--operation webhook / audit; both when unset,
+main.go:114-118).
+
+Run:  python -m gatekeeper_tpu.control.main --operation audit ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from ..client import Backend
+from ..ir import TpuDriver
+from ..target import K8sValidationTarget
+from . import logging as glog
+from . import metrics
+from .audit import (
+    DEFAULT_AUDIT_INTERVAL,
+    DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT,
+    AuditManager,
+)
+from .certs import CertRotator
+from .controllers import ControllerManager
+from .kube import FakeKube, RestKubeClient
+from .upgrade import UpgradeManager
+from .webhook import (
+    MicroBatcher,
+    NamespaceLabelHandler,
+    ValidationHandler,
+    WebhookServer,
+)
+
+log = glog.logger("main")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gatekeeper-tpu",
+        description="TPU-native Kubernetes admission/audit policy engine",
+    )
+    # flag parity with the reference (SURVEY.md §5 config/flag system)
+    p.add_argument("--operation", action="append", default=None,
+                   choices=["webhook", "audit"],
+                   help="operations to run; repeatable; all when unset")
+    p.add_argument("--port", type=int, default=8443)
+    p.add_argument("--cert-dir", default="/certs")
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--metrics-backend", default="prometheus")
+    p.add_argument("--prometheus-port", type=int, default=8888)
+    p.add_argument("--health-addr", default=":9090")
+    p.add_argument("--audit-interval", type=float,
+                   default=DEFAULT_AUDIT_INTERVAL)
+    p.add_argument("--constraint-violations-limit", type=int,
+                   default=DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT)
+    p.add_argument("--audit-from-cache", default="false")
+    p.add_argument("--log-denies", action="store_true")
+    p.add_argument("--disable-cert-rotation", action="store_true")
+    p.add_argument("--disable-enforcementaction-validation",
+                   action="store_true")
+    p.add_argument("--exempt-namespace", action="append", default=[])
+    p.add_argument("--fake-kube", action="store_true",
+                   help="in-memory cluster (development/testing)")
+    return p
+
+
+class Runtime:
+    """Everything main() builds, exposed for tests and embedding."""
+
+    def __init__(self, args, kube=None):
+        self.args = args
+        operations = set(args.operation or ["webhook", "audit"])
+        self.operations = operations
+        self.kube = kube if kube is not None else (
+            FakeKube() if args.fake_kube else RestKubeClient())
+        if isinstance(self.kube, FakeKube):
+            self._register_builtin_kinds()
+        driver = TpuDriver()
+        self.opa = Backend(driver).new_client([K8sValidationTarget()])
+        self.manager = ControllerManager(
+            self.kube, self.opa,
+            validate_actions=not args.disable_enforcementaction_validation)
+        self.audit = None
+        if "audit" in operations:
+            self.audit = AuditManager(
+                self.kube, self.opa, interval=args.audit_interval,
+                constraint_violations_limit=args.constraint_violations_limit,
+                audit_from_cache=str(args.audit_from_cache).lower() == "true")
+        self.webhook = None
+        self.cert_rotator = None
+        if "webhook" in operations:
+            batcher = MicroBatcher(self.opa)
+            validation = ValidationHandler(
+                self.opa, kube=self.kube, batcher=batcher,
+                log_denies=args.log_denies,
+                validate_enforcement=not
+                args.disable_enforcementaction_validation,
+                traces_provider=lambda: self.manager.config_ctrl.traces)
+            ns_label = NamespaceLabelHandler(tuple(args.exempt_namespace))
+            certfile = keyfile = None
+            if not args.disable_cert_rotation:
+                self.cert_rotator = CertRotator(self.kube, args.cert_dir)
+                try:
+                    self.cert_rotator.refresh_certs()
+                    certfile = f"{args.cert_dir}/tls.crt"
+                    keyfile = f"{args.cert_dir}/tls.key"
+                except Exception as e:
+                    log.warning("cert bootstrap failed; serving plaintext",
+                                details=str(e))
+            self.webhook = WebhookServer(validation, ns_label,
+                                         port=args.port, certfile=certfile,
+                                         keyfile=keyfile)
+        self.upgrade = UpgradeManager(self.kube)
+        self.metrics_server = None
+
+    def _register_builtin_kinds(self) -> None:
+        for gvk, namespaced in [
+            (("", "v1", "Namespace"), False),
+            (("", "v1", "Pod"), True),
+            (("", "v1", "Service"), True),
+            (("", "v1", "Secret"), True),
+            (("apps", "v1", "Deployment"), True),
+            (("templates.gatekeeper.sh", "v1beta1", "ConstraintTemplate"),
+             False),
+            (("config.gatekeeper.sh", "v1alpha1", "Config"), True),
+            (("apiextensions.k8s.io", "v1beta1",
+              "CustomResourceDefinition"), False),
+            (("admissionregistration.k8s.io", "v1beta1",
+              "ValidatingWebhookConfiguration"), False),
+        ]:
+            self.kube.register_kind(gvk, namespaced=namespaced)
+
+    def start(self) -> None:
+        if self.args.metrics_backend == "prometheus":
+            try:
+                self.metrics_server = metrics.serve(self.args.prometheus_port)
+            except OSError as e:
+                log.warning("metrics port unavailable", details=str(e))
+        self.upgrade.upgrade()
+        self.manager.start()
+        if self.audit:
+            self.audit.start()
+        if self.cert_rotator:
+            self.cert_rotator.start()
+        if self.webhook:
+            self.webhook.start()
+        log.info("gatekeeper-tpu started",
+                 details={"operations": sorted(self.operations)})
+
+    def stop(self) -> None:
+        if self.webhook:
+            self.webhook.stop()
+        if self.audit:
+            self.audit.stop()
+        if self.cert_rotator:
+            self.cert_rotator.stop()
+        self.manager.stop()
+        if self.metrics_server:
+            self.metrics_server.shutdown()
+        log.info("gatekeeper-tpu stopped")
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    glog.setup(args.log_level)
+    runtime = Runtime(args)
+    stop = threading.Event()
+
+    def handle_signal(*_):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, handle_signal)
+    signal.signal(signal.SIGINT, handle_signal)
+    runtime.start()
+    stop.wait()
+    runtime.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

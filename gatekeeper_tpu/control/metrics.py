@@ -1,0 +1,226 @@
+"""Metrics registry + Prometheus text exposition.
+
+Metric-name parity with the reference's per-package stats reporters
+(SURVEY.md §2.1): violations, audit_duration_seconds, audit_last_run_time
+(pkg/audit/stats_reporter.go), request_count / request_duration_seconds
+(pkg/webhook), constraints (constraint controller), constraint_templates +
+ingestion duration (constrainttemplate controller), sync* (sync
+controller), watch_manager_* (watch). Exported on --prometheus-port in the
+text format (reference pkg/metrics/prometheus_exporter.go:17-43).
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind  # counter | gauge | histogram
+        self.lock = threading.Lock()
+        self.values: dict[tuple, float] = defaultdict(float)
+        self.label_names: tuple = ()
+        # histogram state
+        self.buckets: tuple = ()
+        self.bucket_counts: dict[tuple, list] = {}
+        self.sums: dict[tuple, float] = defaultdict(float)
+        self.counts: dict[tuple, int] = defaultdict(int)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, help_: str, kind: str, labels: tuple) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = _Metric(name, help_, kind)
+                m.label_names = labels
+                self._metrics[name] = m
+            return m
+
+    # ------------------------------------------------------------ recorders
+
+    def counter_add(self, name: str, help_: str, value: float = 1.0,
+                    **labels) -> None:
+        m = self._get(name, help_, "counter", tuple(sorted(labels)))
+        with m.lock:
+            m.values[_lv(labels)] += value
+
+    def gauge_set(self, name: str, help_: str, value: float, **labels) -> None:
+        m = self._get(name, help_, "gauge", tuple(sorted(labels)))
+        with m.lock:
+            m.values[_lv(labels)] = value
+
+    def observe(self, name: str, help_: str, value: float,
+                buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 60,
+                         300), **labels) -> None:
+        m = self._get(name, help_, "histogram", tuple(sorted(labels)))
+        with m.lock:
+            m.buckets = tuple(buckets)
+            key = _lv(labels)
+            if key not in m.bucket_counts:
+                m.bucket_counts[key] = [0] * (len(buckets) + 1)
+            counts = m.bucket_counts[key]
+            for i, b in enumerate(buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            m.sums[key] += value
+            m.counts[key] += 1
+
+    # ------------------------------------------------------------- render
+
+    def render(self) -> str:
+        out = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            with m.lock:
+                if m.kind in ("counter", "gauge"):
+                    for key, v in sorted(m.values.items()):
+                        out.append(f"{m.name}{_fmt(m.label_names, key)} {_num(v)}")
+                else:
+                    for key in sorted(m.bucket_counts):
+                        cum = 0
+                        for i, b in enumerate(m.buckets):
+                            cum += m.bucket_counts[key][i]
+                            out.append(
+                                f"{m.name}_bucket"
+                                f"{_fmt(m.label_names, key, le=_num(b))} {cum}")
+                        cum += m.bucket_counts[key][-1]
+                        out.append(
+                            f"{m.name}_bucket"
+                            f"{_fmt(m.label_names, key, le='+Inf')} {cum}")
+                        out.append(
+                            f"{m.name}_sum{_fmt(m.label_names, key)} "
+                            f"{_num(m.sums[key])}")
+                        out.append(
+                            f"{m.name}_count{_fmt(m.label_names, key)} "
+                            f"{m.counts[key]}")
+        return "\n".join(out) + "\n"
+
+
+def _lv(labels: dict) -> tuple:
+    return tuple(str(labels[k]) for k in sorted(labels))
+
+
+def _fmt(names: tuple, values: tuple, **extra) -> str:
+    pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
+    pairs += [f'{k}="{v}"' for k, v in extra.items()]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _num(v: float) -> str:
+    if v == int(v):
+        return str(int(v))
+    return repr(v)
+
+
+REGISTRY = Registry()
+
+
+def serve(port: int, registry: Registry = REGISTRY,
+          addr: str = "") -> http.server.ThreadingHTTPServer:
+    """Start the /metrics endpoint (reference --prometheus-port 8888)."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/") in ("", "/metrics"):
+                body = registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = http.server.ThreadingHTTPServer((addr, port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server
+
+
+# convenience recorders with reference metric names
+
+
+def report_violations(action: str, count: int) -> None:
+    REGISTRY.gauge_set("violations", "Total violations detected by the last "
+                       "audit run", count, enforcement_action=action)
+
+
+def report_audit_duration(seconds: float) -> None:
+    REGISTRY.observe("audit_duration_seconds", "Latency of audit operation",
+                     seconds)
+
+
+def report_audit_last_run(ts: Optional[float] = None) -> None:
+    REGISTRY.gauge_set("audit_last_run_time", "Timestamp of last audit run",
+                       ts if ts is not None else time.time())
+
+
+def report_request(admission_status: str, seconds: float) -> None:
+    REGISTRY.counter_add("request_count", "Count of admission requests",
+                         admission_status=admission_status)
+    REGISTRY.observe("request_duration_seconds",
+                     "Latency of admission requests", seconds,
+                     admission_status=admission_status)
+
+
+def report_constraints(action: str, count: int) -> None:
+    REGISTRY.gauge_set("constraints", "Current number of known constraints",
+                       count, enforcement_action=action)
+
+
+def report_constraint_templates(status: str, count: int) -> None:
+    REGISTRY.gauge_set("constraint_templates",
+                       "Number of observed constraint templates", count,
+                       status=status)
+
+
+def report_template_ingestion(status: str, seconds: float) -> None:
+    REGISTRY.observe("constraint_template_ingestion_duration_seconds",
+                     "Latency of constraint template ingestion", seconds,
+                     status=status)
+
+
+def report_sync(status: str, kind: str, count: int) -> None:
+    REGISTRY.gauge_set("sync", "Total number of resources replicated into "
+                       "OPA", count, status=status, kind=kind)
+
+
+def report_sync_duration(seconds: float) -> None:
+    REGISTRY.observe("sync_duration_seconds", "Latency of sync operation",
+                     seconds)
+
+
+def report_last_sync(ts: Optional[float] = None) -> None:
+    REGISTRY.gauge_set("sync_last_run_time", "Timestamp of last sync",
+                       ts if ts is not None else time.time())
+
+
+def report_watch_manager(gvk_count: int, intended: int) -> None:
+    REGISTRY.gauge_set("watch_manager_watched_gvk",
+                       "Total number of watched GroupVersionKinds",
+                       gvk_count)
+    REGISTRY.gauge_set("watch_manager_intended_watch_gvk",
+                       "Total number of GroupVersionKinds the watch manager "
+                       "intends to watch", intended)

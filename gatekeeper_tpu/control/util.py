@@ -1,0 +1,89 @@
+"""Control-plane utilities.
+
+Counterparts of the reference pkg/util: GVK-packed reconcile requests
+(pack.go:16-57), enforcement-action validation (enforcement_action.go:11-45),
+pod identity (pod_info.go), and byPod HA status helpers (ha_status.go:14-50,
+util/constraint/unstructured_ha_status.go:19-133).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+VALID_ENFORCEMENT_ACTIONS = ("deny", "dryrun")
+DEFAULT_ENFORCEMENT_ACTION = "deny"
+
+
+class UnrecognizedEnforcementAction(Exception):
+    pass
+
+
+def validate_enforcement_action(action: str) -> None:
+    if action not in VALID_ENFORCEMENT_ACTIONS:
+        raise UnrecognizedEnforcementAction(
+            f"Invalid enforcement action {action!r}; must be one of "
+            f"{VALID_ENFORCEMENT_ACTIONS}"
+        )
+
+
+# ------------------------------------------------------- packed GVK requests
+
+
+def pack_request(gvk: tuple, name: str, namespace: str = "") -> str:
+    """Encode GVK + object identity into one watch-event request token
+    (the reference packs GVK into reconcile request names, pack.go)."""
+    group, version, kind = gvk
+    ns_part = f"{namespace}/" if namespace else ""
+    return f"{group}|{version}|{kind}|{ns_part}{name}"
+
+
+def unpack_request(token: str) -> tuple[tuple, str, str]:
+    group, version, kind, rest = token.split("|", 3)
+    if "/" in rest:
+        namespace, name = rest.split("/", 1)
+    else:
+        namespace, name = "", rest
+    return (group, version, kind), name, namespace
+
+
+# ---------------------------------------------------------------- pod info
+
+
+def pod_name() -> str:
+    return os.environ.get("POD_NAME", os.environ.get("HOSTNAME", "gatekeeper"))
+
+
+def pod_namespace() -> str:
+    return os.environ.get("POD_NAMESPACE", "gatekeeper-system")
+
+
+# ------------------------------------------------------------ byPod status
+
+
+def get_by_pod_status(obj: dict) -> Optional[dict]:
+    """This pod's entry in status.byPod (HA: each replica owns one slot)."""
+    status = obj.get("status") or {}
+    for entry in status.get("byPod") or []:
+        if isinstance(entry, dict) and entry.get("id") == pod_name():
+            return entry
+    return None
+
+
+def set_by_pod_status(obj: dict, entry: dict) -> None:
+    """Upsert this pod's status entry, preserving other pods' entries."""
+    entry = dict(entry)
+    entry["id"] = pod_name()
+    status = obj.setdefault("status", {})
+    by_pod = [e for e in status.get("byPod") or []
+              if not (isinstance(e, dict) and e.get("id") == pod_name())]
+    by_pod.append(entry)
+    by_pod.sort(key=lambda e: e.get("id") or "")
+    status["byPod"] = by_pod
+
+
+def delete_by_pod_status(obj: dict) -> None:
+    status = obj.get("status") or {}
+    by_pod = [e for e in status.get("byPod") or []
+              if not (isinstance(e, dict) and e.get("id") == pod_name())]
+    status["byPod"] = by_pod

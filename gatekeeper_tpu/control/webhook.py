@@ -1,0 +1,329 @@
+"""Admission webhooks: /v1/admit and /v1/admitlabel.
+
+Counterpart of the reference pkg/webhook/policy.go + namespacelabel.go,
+with one structural change (BASELINE config #5): requests are MICRO-BATCHED
+— handler threads enqueue reviews and a flusher thread ships whole batches
+through the driver's vectorized review_batch, so admission latency rides
+the batched evaluator instead of per-request interpretation.
+
+Behavior parity:
+  * self-service-account requests short-circuit to allow (policy.go:122-124)
+  * DELETE reviews evaluate oldObject as object (policy.go:126-141)
+  * gatekeeper's own resources are validated structurally (CreateCRD /
+    ValidateConstraint), not policy-evaluated (policy.go:237-287)
+  * the request namespace is fetched and sideloaded for namespaceSelector
+    resolution (policy.go:310-317)
+  * only `deny` enforcement produces deny messages; dryrun only logs
+    (policy.go:194-217); --log-denies
+  * per-(user, kind) tracing via the Config CRD (policy.go:290-309)
+  * fail-open stance is deployment-level (failurePolicy: Ignore), so any
+    internal error here returns allow with a warning status
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import ssl
+import threading
+import time
+from typing import Any, Optional
+
+from ..client import Client, ClientError
+from ..target.handler import AugmentedReview
+from . import metrics
+from .kube import NotFound
+from .logging import logger
+from .util import DEFAULT_ENFORCEMENT_ACTION, validate_enforcement_action
+
+log = logger("webhook")
+
+TEMPLATE_GROUP = "templates.gatekeeper.sh"
+CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
+IGNORE_LABEL = "admission.gatekeeper.sh/ignore"
+SERVICE_ACCOUNT = "system:serviceaccount:gatekeeper-system:gatekeeper-admin"
+
+
+class _Pending:
+    __slots__ = ("review", "done", "results", "error")
+
+    def __init__(self, review: dict):
+        self.review = review
+        self.done = threading.Event()
+        self.results: list = []
+        self.error: Optional[Exception] = None
+
+
+class MicroBatcher:
+    """Deadline-bounded admission batching: collect pending reviews for up
+    to `max_wait`, flush them through driver.review_batch as one sweep."""
+
+    def __init__(self, opa: Client, max_wait: float = 0.005,
+                 max_batch: int = 256,
+                 target: str = "admission.k8s.gatekeeper.sh"):
+        self.opa = opa
+        self.max_wait = max_wait
+        self.max_batch = max_batch
+        self.target = target
+        self._queue: list[_Pending] = []
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name="batcher",
+                                        daemon=True)
+        self._thread.start()
+        self.batches = 0
+        self.batched_requests = 0
+
+    def submit(self, review: dict, timeout: float = 60.0) -> list:
+        p = _Pending(review)
+        with self._cv:
+            self._queue.append(p)
+            self._cv.notify()
+        if not p.done.wait(timeout):
+            raise TimeoutError("admission batch timed out")
+        if p.error is not None:
+            raise p.error
+        return p.results
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cv:
+                while not self._queue and not self._stop.is_set():
+                    self._cv.wait(0.1)
+                if self._stop.is_set():
+                    batch = self._queue[:]
+                    self._queue.clear()
+                else:
+                    deadline = time.time() + self.max_wait
+                    while (len(self._queue) < self.max_batch
+                           and time.time() < deadline):
+                        self._cv.wait(max(0.0, deadline - time.time()))
+                    batch = self._queue[: self.max_batch]
+                    del self._queue[: len(batch)]
+            if not batch:
+                continue
+            self._flush(batch)
+
+    def _flush(self, batch: list[_Pending]) -> None:
+        self.batches += 1
+        self.batched_requests += len(batch)
+        driver = self.opa.driver
+        try:
+            handler = self.opa.targets[self.target]
+            if hasattr(driver, "review_batch"):
+                outs = driver.review_batch(self.target,
+                                           [p.review for p in batch])
+            else:
+                outs = []
+                for p in batch:
+                    resp = driver.query(
+                        ("hooks", self.target, "violation"),
+                        {"review": p.review})
+                    outs.append(resp.results)
+            for p, results in zip(batch, outs):
+                for r in results:
+                    handler.handle_violation(r)
+                p.results = results
+                p.done.set()
+        except Exception as e:
+            for p in batch:
+                p.error = e
+                p.done.set()
+
+
+class ValidationHandler:
+    """The /v1/admit logic, transport-independent."""
+
+    def __init__(self, opa: Client, kube=None,
+                 batcher: Optional[MicroBatcher] = None,
+                 log_denies: bool = False,
+                 validate_enforcement: bool = True,
+                 traces_provider=None):
+        self.opa = opa
+        self.kube = kube
+        self.batcher = batcher or MicroBatcher(opa)
+        self.log_denies = log_denies
+        self.validate_enforcement = validate_enforcement
+        self.traces_provider = traces_provider or (lambda: [])
+
+    def handle(self, admission_review: dict) -> dict:
+        t0 = time.time()
+        request = admission_review.get("request") or {}
+        uid = request.get("uid") or ""
+        try:
+            response = self._decide(request)
+        except Exception as e:
+            # webhook is deployed fail-open; internal errors allow
+            log.error("admission error", details=str(e))
+            response = {"allowed": True,
+                        "status": {"code": 500, "message": str(e)}}
+        status = "allow" if response.get("allowed") else "deny"
+        metrics.report_request(status, time.time() - t0)
+        response["uid"] = uid
+        return {
+            "apiVersion": admission_review.get("apiVersion",
+                                               "admission.k8s.io/v1beta1"),
+            "kind": "AdmissionReview",
+            "response": response,
+        }
+
+    def _decide(self, request: dict) -> dict:
+        username = (request.get("userInfo") or {}).get("username")
+        if username == SERVICE_ACCOUNT:
+            return {"allowed": True}
+        kind = request.get("kind") or {}
+        group = kind.get("group") or ""
+        if group in (TEMPLATE_GROUP, CONSTRAINT_GROUP):
+            return self._validate_gatekeeper_resource(request, group)
+        review = dict(request)
+        if (request.get("operation") == "DELETE"
+                and not request.get("object")
+                and request.get("oldObject") is not None):
+            # evaluate what is being deleted (policy.go:126-141)
+            review["object"] = request.get("oldObject")
+        ns_obj = None
+        ns_name = request.get("namespace")
+        if ns_name and self.kube is not None:
+            try:
+                ns_obj = self.kube.get(("", "v1", "Namespace"), ns_name)
+            except NotFound:
+                ns_obj = None
+        handled, gk_review = self.opa.targets[
+            "admission.k8s.gatekeeper.sh"].handle_review(
+                AugmentedReview(review, ns_obj))
+        if not handled:
+            return {"allowed": True}
+        results = self.batcher.submit(gk_review)
+        denies = []
+        for r in results:
+            if self.log_denies:
+                log.info(
+                    "violation",
+                    event_type="violation",
+                    constraint_name=(r.constraint or {}).get(
+                        "metadata", {}).get("name"),
+                    constraint_kind=(r.constraint or {}).get("kind"),
+                    constraint_action=r.enforcement_action,
+                    resource_namespace=request.get("namespace"),
+                    resource_name=request.get("name"),
+                    request_username=username,
+                    details=r.msg,
+                )
+            if r.enforcement_action == "deny":
+                denies.append(r.msg)
+        if denies:
+            return {"allowed": False,
+                    "status": {"code": 403,
+                               "reason": "; ".join(sorted(denies))}}
+        return {"allowed": True}
+
+    def _validate_gatekeeper_resource(self, request: dict,
+                                      group: str) -> dict:
+        if request.get("operation") == "DELETE":
+            return {"allowed": True}
+        obj = request.get("object") or {}
+        try:
+            if group == TEMPLATE_GROUP:
+                self.opa.create_crd(obj)
+            else:
+                action = (obj.get("spec") or {}).get("enforcementAction") \
+                    or DEFAULT_ENFORCEMENT_ACTION
+                if self.validate_enforcement:
+                    validate_enforcement_action(action)
+                self.opa.validate_constraint(obj)
+        except Exception as e:
+            return {"allowed": False,
+                    "status": {"code": 422, "reason": str(e)}}
+        return {"allowed": True}
+
+
+class NamespaceLabelHandler:
+    """The /v1/admitlabel logic (namespacelabel.go:63-87): only exempt
+    namespaces may carry the ignore label."""
+
+    def __init__(self, exempt_namespaces: tuple = ()):
+        self.exempt = set(exempt_namespaces)
+
+    def handle(self, admission_review: dict) -> dict:
+        request = admission_review.get("request") or {}
+        uid = request.get("uid") or ""
+        obj = request.get("object") or {}
+        name = (obj.get("metadata") or {}).get("name") or request.get("name")
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        allowed = True
+        reason = ""
+        if IGNORE_LABEL in labels and name not in self.exempt:
+            allowed = False
+            reason = (f"Only exempt namespaces may have the {IGNORE_LABEL} "
+                      "label")
+        response: dict[str, Any] = {"uid": uid, "allowed": allowed}
+        if not allowed:
+            response["status"] = {"code": 403, "reason": reason}
+        return {
+            "apiVersion": admission_review.get("apiVersion",
+                                               "admission.k8s.io/v1beta1"),
+            "kind": "AdmissionReview",
+            "response": response,
+        }
+
+
+class WebhookServer:
+    """HTTPS transport over the handlers."""
+
+    def __init__(self, validation: ValidationHandler,
+                 ns_label: NamespaceLabelHandler,
+                 port: int = 8443, certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None, addr: str = ""):
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                try:
+                    review = json.loads(body)
+                except json.JSONDecodeError:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                if self.path.startswith("/v1/admitlabel"):
+                    out = outer.ns_label.handle(review)
+                elif self.path.startswith("/v1/admit"):
+                    out = outer.validation.handle(review)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                payload = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):
+                pass
+
+        self.validation = validation
+        self.ns_label = ns_label
+        self.server = http.server.ThreadingHTTPServer((addr, port), Handler)
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self.server.socket = ctx.wrap_socket(self.server.socket,
+                                                 server_side=True)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        name="webhook", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.validation.batcher.stop()

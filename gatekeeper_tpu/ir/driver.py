@@ -165,10 +165,9 @@ class TpuDriver(RegoDriver):
         if cand.size == 0:
             return []
         cand_reviews = [reviews[int(i)] for i in cand]
-        # key must pin the exact candidate set: constraint updates can shift
-        # membership without changing _data_gen or the count
-        feat_key = (self._data_gen, self._constraint_gen,
-                    hash(cand.tobytes()))
+        # key pins the exact candidate set; constraint churn that does not
+        # change membership keeps the (expensive) extraction cached
+        feat_key = (self._data_gen, hash(cand.tobytes()))
         try:
             fires = self.eval_compiled(ct, kind, cand_reviews, cons,
                                        feat_key=feat_key)
@@ -228,6 +227,10 @@ class TpuDriver(RegoDriver):
 
     # ----------------------------------------------------- batched reviews
 
+    # batches below this size run on the interpreter: a handful of reviews
+    # is cheaper there than a (possibly cold) device dispatch
+    MIN_DEVICE_BATCH = 4
+
     def review_batch(self, target: str, reviews: list[dict]
                      ) -> list[list[Result]]:
         """Evaluate many admission reviews at once (the webhook
@@ -267,7 +270,8 @@ class TpuDriver(RegoDriver):
             # fails them (unresolvable namespaceSelector), so no extra work
             ct = self.compiled_for(kind)
             pairs = None
-            if ct is not None and mask.any():
+            if ct is not None and mask.any() and \
+                    len(reviews) >= self.MIN_DEVICE_BATCH:
                 cand = np.flatnonzero(mask.any(axis=1))
                 cand_reviews = [reviews[int(i)] for i in cand]
                 try:

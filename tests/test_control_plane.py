@@ -1,0 +1,390 @@
+"""Control-plane lifecycle tests (envtest/kind-e2e analog, SURVEY.md §4
+tiers 3-4): real reconcilers, watch manager, audit manager, webhook server
+and cert rotation run against the in-memory apiserver model, driven through
+the same motions as the reference's bats suite (apply template -> apply
+constraint -> admission deny -> audit populates status.violations -> sync
+config -> ns-label webhook -> teardown)."""
+
+import http.client
+import json
+import ssl
+import time
+
+import pytest
+
+from gatekeeper_tpu.control.audit import AuditManager
+from gatekeeper_tpu.control.certs import CertRotator
+from gatekeeper_tpu.control.controllers import (
+    CONSTRAINT_GROUP,
+    TEMPLATE_GVK,
+    ControllerManager,
+)
+from gatekeeper_tpu.control.kube import FakeKube, NotFound
+from gatekeeper_tpu.control.main import Runtime, build_parser
+from gatekeeper_tpu.control.metrics import REGISTRY
+from gatekeeper_tpu.control.upgrade import UpgradeManager
+from gatekeeper_tpu.control.watch import WatchManager
+
+TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1beta1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "k8srequiredlabels"},
+    "spec": {
+        "crd": {"spec": {
+            "names": {"kind": "K8sRequiredLabels"},
+            "validation": {"openAPIV3Schema": {"properties": {
+                "labels": {"type": "array", "items": {"type": "string"}}}}},
+        }},
+        "targets": [{
+            "target": "admission.k8s.gatekeeper.sh",
+            "rego": """
+package k8srequiredlabels
+violation[{"msg": msg}] {
+  provided := {l | input.review.object.metadata.labels[l]}
+  required := {l | l := input.parameters.labels[_]}
+  missing := required - provided
+  count(missing) > 0
+  msg := sprintf("missing labels: %v", [missing])
+}
+""",
+        }],
+    },
+}
+
+CONSTRAINT = {
+    "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+    "kind": "K8sRequiredLabels",
+    "metadata": {"name": "ns-must-have-owner"},
+    "spec": {
+        "match": {"kinds": [{"apiGroups": [""], "kinds": ["Namespace"]}]},
+        "parameters": {"labels": ["owner"]},
+    },
+}
+
+
+def admission_review(obj, operation="CREATE", username="alice", old=None):
+    group, _, version = (obj.get("apiVersion") or "").rpartition("/")
+    req = {
+        "uid": "uid-1",
+        "kind": {"group": group, "version": version, "kind": obj["kind"]},
+        "operation": operation,
+        "name": obj["metadata"]["name"],
+        "userInfo": {"username": username},
+        "object": obj if operation != "DELETE" else None,
+    }
+    if old is not None:
+        req["oldObject"] = old
+    ns = obj["metadata"].get("namespace")
+    if ns:
+        req["namespace"] = ns
+    return {"apiVersion": "admission.k8s.io/v1beta1",
+            "kind": "AdmissionReview", "request": req}
+
+
+@pytest.fixture
+def runtime():
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--disable-cert-rotation", "--log-denies",
+    ])
+    rt = Runtime(args)
+    rt.args.metrics_backend = "none"
+    rt.start()
+    yield rt
+    rt.stop()
+
+
+def ns(name, labels=None):
+    o = {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": name}}
+    if labels is not None:
+        o["metadata"]["labels"] = labels
+    return o
+
+
+def test_full_lifecycle(runtime):
+    kube = runtime.kube
+    # 1. apply the template; reconciler ingests + creates the constraint CRD
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    crd = kube.get(("apiextensions.k8s.io", "v1beta1",
+                    "CustomResourceDefinition"),
+                   "k8srequiredlabels.constraints.gatekeeper.sh")
+    assert crd["spec"]["names"]["kind"] == "K8sRequiredLabels"
+    templ = kube.get(TEMPLATE_GVK, "k8srequiredlabels")
+    assert templ["status"]["created"] is True
+    assert templ["status"]["byPod"][0]["observedGeneration"] == 0
+    assert runtime.opa.knows_kind("K8sRequiredLabels")
+
+    # 2. apply a constraint; constraint controller enforces it
+    kube.create(CONSTRAINT)
+    runtime.manager.drain()
+    stored = kube.get((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                      "ns-must-have-owner")
+    assert stored["status"]["byPod"][0]["enforced"] is True
+
+    # 3. admission: violating namespace denied, compliant allowed
+    handler = runtime.webhook.validation
+    out = handler.handle(admission_review(ns("shipping")))
+    assert out["response"]["allowed"] is False
+    assert "missing labels" in out["response"]["status"]["reason"]
+    out = handler.handle(admission_review(ns("ok", {"owner": "me"})))
+    assert out["response"]["allowed"] is True
+
+    # 4. audit: cluster objects produce status.violations
+    kube.create(ns("bad-1"))
+    kube.create(ns("good-1", {"owner": "me"}))
+    runtime.audit.audit_once()
+    stored = kube.get((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                      "ns-must-have-owner")
+    viol = stored["status"]["violations"]
+    assert {v["name"] for v in viol} == {"bad-1", "shipping"} - {"shipping"} \
+        or any(v["name"] == "bad-1" for v in viol)
+    assert stored["status"]["totalViolations"] >= 1
+    assert all(v["enforcementAction"] == "deny" for v in viol)
+
+    # 5. deleting the constraint stops enforcement
+    kube.delete((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                "ns-must-have-owner")
+    runtime.manager.drain()
+    out = handler.handle(admission_review(ns("shipping")))
+    assert out["response"]["allowed"] is True
+
+    # 6. deleting the template removes the kind
+    kube.delete(TEMPLATE_GVK, "k8srequiredlabels")
+    runtime.manager.drain()
+    assert not runtime.opa.knows_kind("K8sRequiredLabels")
+
+
+def test_audit_respects_violation_limit(runtime):
+    kube = runtime.kube
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    kube.create(CONSTRAINT)
+    runtime.manager.drain()
+    runtime.audit.limit = 3
+    for i in range(10):
+        kube.create(ns(f"bad-{i}"))
+    runtime.audit.audit_once()
+    stored = kube.get((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                      "ns-must-have-owner")
+    assert len(stored["status"]["violations"]) == 3
+    assert stored["status"]["totalViolations"] == 10
+
+
+def test_sync_config_populates_inventory(runtime):
+    kube = runtime.kube
+    kube.create({
+        "apiVersion": "config.gatekeeper.sh/v1alpha1", "kind": "Config",
+        "metadata": {"name": "config", "namespace": "gatekeeper-system"},
+        "spec": {"sync": {"syncOnly": [
+            {"group": "", "version": "v1", "kind": "Namespace"}]}},
+    })
+    kube.create(ns("synced-ns", {"team": "a"}))
+    runtime.manager.drain()
+    time.sleep(0.05)
+    runtime.manager.drain()
+    data = runtime.opa.driver.get_data(
+        ("external", "admission.k8s.gatekeeper.sh", "cluster", "v1",
+         "Namespace", "synced-ns"))
+    assert data is not None and data["metadata"]["name"] == "synced-ns"
+    # deleting the object removes it from inventory
+    kube.delete(("", "v1", "Namespace"), "synced-ns")
+    runtime.manager.drain()
+    assert runtime.opa.driver.get_data(
+        ("external", "admission.k8s.gatekeeper.sh", "cluster", "v1",
+         "Namespace", "synced-ns")) is None
+
+
+def test_dryrun_constraint_does_not_deny(runtime):
+    kube = runtime.kube
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    c = json.loads(json.dumps(CONSTRAINT))
+    c["spec"]["enforcementAction"] = "dryrun"
+    kube.create(c)
+    runtime.manager.drain()
+    out = runtime.webhook.validation.handle(admission_review(ns("shipping")))
+    assert out["response"]["allowed"] is True
+    # but audit still reports it
+    kube.create(ns("bad-dry"))
+    runtime.audit.audit_once()
+    stored = kube.get((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                      "ns-must-have-owner")
+    assert any(v["enforcementAction"] == "dryrun"
+               for v in stored["status"]["violations"])
+
+
+def test_gatekeeper_resource_validation(runtime):
+    handler = runtime.webhook.validation
+    bad_template = json.loads(json.dumps(TEMPLATE))
+    bad_template["spec"]["targets"][0]["rego"] = "package broken\n}{"
+    review = admission_review(bad_template)
+    out = handler.handle(review)
+    assert out["response"]["allowed"] is False
+    assert out["response"]["status"]["code"] == 422
+    ok = handler.handle(admission_review(TEMPLATE))
+    assert ok["response"]["allowed"] is True
+    # constraint with bogus enforcement action rejected
+    runtime.kube.create(TEMPLATE)
+    runtime.manager.drain()
+    bad_c = json.loads(json.dumps(CONSTRAINT))
+    bad_c["spec"]["enforcementAction"] = "warn-everyone"
+    out = handler.handle(admission_review(bad_c))
+    assert out["response"]["allowed"] is False
+
+
+def test_delete_operation_reviews_old_object(runtime):
+    kube = runtime.kube
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    kube.create(CONSTRAINT)
+    runtime.manager.drain()
+    review = admission_review(ns("victim"), operation="DELETE",
+                              old=ns("victim"))
+    review["request"]["object"] = None
+    out = runtime.webhook.validation.handle(review)
+    assert out["response"]["allowed"] is False
+
+
+def test_self_service_account_short_circuits(runtime):
+    kube = runtime.kube
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    kube.create(CONSTRAINT)
+    runtime.manager.drain()
+    review = admission_review(
+        ns("shipping"),
+        username="system:serviceaccount:gatekeeper-system:gatekeeper-admin")
+    out = runtime.webhook.validation.handle(review)
+    assert out["response"]["allowed"] is True
+
+
+def test_namespace_label_webhook(runtime):
+    h = runtime.webhook.ns_label
+    labeled = ns("sneaky", {"admission.gatekeeper.sh/ignore": "true"})
+    out = h.handle(admission_review(labeled))
+    assert out["response"]["allowed"] is False
+    plain = h.handle(admission_review(ns("plain", {})))
+    assert plain["response"]["allowed"] is True
+
+
+def test_namespace_label_webhook_exemption():
+    from gatekeeper_tpu.control.webhook import NamespaceLabelHandler
+    h = NamespaceLabelHandler(exempt_namespaces=("kube-system",))
+    exempt = ns("kube-system", {"admission.gatekeeper.sh/ignore": "true"})
+    assert h.handle(admission_review(exempt))["response"]["allowed"] is True
+
+
+def test_webhook_over_https(runtime):
+    """Full transport path: TLS server + cert rotation against the fake
+    apiserver (secret + CA files), then a real HTTPS admission request."""
+    kube = runtime.kube
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    kube.create(CONSTRAINT)
+    runtime.manager.drain()
+    import tempfile
+
+    from gatekeeper_tpu.control.webhook import WebhookServer
+
+    with tempfile.TemporaryDirectory() as td:
+        rotator = CertRotator(kube, td)
+        rotator.refresh_certs()
+        secret = kube.get(("", "v1", "Secret"),
+                          "gatekeeper-webhook-server-cert",
+                          "gatekeeper-system")
+        assert "tls.crt" in secret["data"]
+        server = WebhookServer(runtime.webhook.validation,
+                               runtime.webhook.ns_label, port=0,
+                               certfile=f"{td}/tls.crt",
+                               keyfile=f"{td}/tls.key")
+        server.start()
+        try:
+            ctx = ssl.create_default_context(cafile=f"{td}/ca.crt")
+            ctx.check_hostname = False  # SANs are for the cluster DNS name
+            conn = http.client.HTTPSConnection("127.0.0.1", server.port,
+                                               context=ctx, timeout=10)
+            body = json.dumps(admission_review(ns("shipping")))
+            conn.request("POST", "/v1/admit", body,
+                         {"Content-Type": "application/json"})
+            resp = json.loads(conn.getresponse().read())
+            assert resp["response"]["allowed"] is False
+            assert resp["response"]["uid"] == "uid-1"
+        finally:
+            server.server.shutdown()
+
+
+def test_cert_rotation_injects_vwh(runtime):
+    kube = runtime.kube
+    kube.create({
+        "apiVersion": "admissionregistration.k8s.io/v1beta1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {"name": "gatekeeper-validating-webhook-configuration"},
+        "webhooks": [{"name": "validation.gatekeeper.sh",
+                      "clientConfig": {"service": {"name": "gk"}}},
+                     {"name": "check-ignore-label.gatekeeper.sh",
+                      "clientConfig": {}}],
+    })
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        CertRotator(kube, td).refresh_certs()
+    vwh = kube.get(("admissionregistration.k8s.io", "v1beta1",
+                    "ValidatingWebhookConfiguration"),
+                   "gatekeeper-validating-webhook-configuration")
+    bundles = [w["clientConfig"].get("caBundle") for w in vwh["webhooks"]]
+    assert all(bundles)
+
+
+def test_watch_manager_refcounting():
+    kube = FakeKube()
+    kube.register_kind(("", "v1", "Namespace"), namespaced=False)
+    wm = WatchManager(kube)
+    r1 = wm.registrar("a")
+    r2 = wm.registrar("b")
+    kube.create(ns("pre-existing"))
+    r1.add_watch(("", "v1", "Namespace"))
+    assert wm.is_watched(("", "v1", "Namespace"))
+    # r1 got the initial object
+    ev = r1.events.get(timeout=1)
+    assert ev.object["metadata"]["name"] == "pre-existing"
+    # late joiner replays from cache
+    r2.add_watch(("", "v1", "Namespace"))
+    ev = r2.events.get(timeout=1)
+    assert ev.object["metadata"]["name"] == "pre-existing"
+    # removal is ref-counted
+    r1.remove_watch(("", "v1", "Namespace"))
+    assert wm.is_watched(("", "v1", "Namespace"))
+    r2.remove_watch(("", "v1", "Namespace"))
+    assert not wm.is_watched(("", "v1", "Namespace"))
+
+
+def test_upgrade_manager_touches_objects():
+    kube = FakeKube()
+    kube.register_kind(TEMPLATE_GVK, namespaced=False)
+    kube.register_kind((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                       namespaced=False)
+    kube.create(TEMPLATE)
+    kube.create(CONSTRAINT)
+    rv_before = kube.get(TEMPLATE_GVK,
+                         "k8srequiredlabels")["metadata"]["resourceVersion"]
+    touched = UpgradeManager(kube).upgrade()
+    assert touched == 2
+    rv_after = kube.get(TEMPLATE_GVK,
+                        "k8srequiredlabels")["metadata"]["resourceVersion"]
+    assert rv_after != rv_before
+
+
+def test_metrics_rendered(runtime):
+    kube = runtime.kube
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    kube.create(CONSTRAINT)
+    runtime.manager.drain()
+    kube.create(ns("bad-metrics"))
+    runtime.audit.audit_once()
+    runtime.webhook.validation.handle(admission_review(ns("nope")))
+    text = REGISTRY.render()
+    for name in ("violations", "audit_duration_seconds", "audit_last_run_time",
+                 "request_count", "request_duration_seconds", "constraints",
+                 "constraint_templates"):
+        assert name in text, f"metric {name} missing"
