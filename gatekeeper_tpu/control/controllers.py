@@ -35,6 +35,7 @@ from .logging import logger
 from .util import (
     DEFAULT_ENFORCEMENT_ACTION,
     VALID_ENFORCEMENT_ACTIONS,
+    by_pod_status_unchanged,
     pod_name,
     set_by_pod_status,
     validate_enforcement_action,
@@ -218,6 +219,9 @@ class TemplateController:
                                  (obj.get("metadata") or {}).get("generation", 0)}
         if errors:
             entry["errors"] = [{"message": e} for e in errors]
+        if (by_pod_status_unchanged(obj, entry)
+                and (obj.get("status") or {}).get("created") == created):
+            return
         set_by_pod_status(obj, entry)
         obj.setdefault("status", {})["created"] = created
         _retry_status_update(self.kube, obj)
@@ -239,6 +243,7 @@ class ConstraintController:
                  validate_actions: bool = True):
         self.kube = kube
         self.opa = opa
+        self.wm = wm
         self.registrar = wm.registrar("constraint")
         self.worker = _Worker("constraint", self.registrar, self.reconcile)
         self.validate_actions = validate_actions
@@ -254,6 +259,18 @@ class ConstraintController:
         kind = obj.get("kind") or ""
         name = (obj.get("metadata") or {}).get("name") or ""
         uid = f"{kind}/{name}"
+        if event.type != "DELETED":
+            # Level-triggered: act on the watch cache (informer-cache
+            # analog, constraint_controller.go:174-188), never the possibly
+            # stale event payload — a MODIFIED drained after DELETED must
+            # not resurrect the constraint. The cache is always at least as
+            # new as any drained event and costs no API round-trip.
+            ns = (obj.get("metadata") or {}).get("namespace") or ""
+            cur = self.wm.cached_get(gvk_of(obj), name, ns)
+            if cur is None:
+                event = WatchEvent("DELETED", obj)
+            else:
+                obj = cur
         if event.type == "DELETED":
             try:
                 self.opa.remove_constraint(obj)
@@ -301,6 +318,10 @@ class ConstraintController:
                                                                  0)}
         if errors:
             entry["errors"] = [{"message": e} for e in errors]
+        # Skip no-op writes: an unconditional update emits a MODIFIED event
+        # back into our own queue and loops forever.
+        if by_pod_status_unchanged(obj, entry):
+            return
         set_by_pod_status(obj, entry)
         _retry_status_update(self.kube, obj)
 

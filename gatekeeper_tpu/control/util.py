@@ -87,3 +87,11 @@ def delete_by_pod_status(obj: dict) -> None:
     by_pod = [e for e in status.get("byPod") or []
               if not (isinstance(e, dict) and e.get("id") == pod_name())]
     status["byPod"] = by_pod
+
+
+def by_pod_status_unchanged(obj: dict, entry: dict) -> bool:
+    """True when this pod's existing byPod entry already equals `entry`
+    (ignoring the id field) — lets controllers skip no-op status writes
+    that would loop MODIFIED events back into their own queues."""
+    cur = get_by_pod_status(obj)
+    return cur is not None and {**cur, "id": None} == {**entry, "id": None}

@@ -12,6 +12,7 @@ cancelling a subscription.
 
 from __future__ import annotations
 
+import copy
 import queue
 import threading
 from typing import Callable, Optional
@@ -124,6 +125,21 @@ class WatchManager:
             targets = list(rec.registrars)
         for r in targets:
             r.events.put(event)
+
+    def cached_get(self, gvk: GVK, name: str,
+                   namespace: str = "") -> Optional[dict]:
+        """Latest cached object for (gvk, ns, name) — the informer-cache
+        read the reference reconcilers use. None when the object is gone
+        from the cache (at least as new as any drained event; _fanout pops
+        it on DELETED) or the GVK is no longer watched."""
+        with self._lock:
+            rec = self._records.get(tuple(gvk))
+            if rec is None:
+                return None
+            obj = rec.cache.get((namespace or "", name))
+            # deep copy: callers mutate (status writes) and the cache entry
+            # is shared with late-joiner replay and other registrars
+            return copy.deepcopy(obj) if obj is not None else None
 
     def cached_objects(self, gvk: GVK) -> list[dict]:
         with self._lock:

@@ -55,6 +55,52 @@ class Cell(NamedTuple):
     kind: Any  # int8
 
 
+class BPair(NamedTuple):
+    """Three-valued literal result: lo = certainly succeeds, hi = possibly
+    succeeds (lo implies hi). Uncertainty enters at leaves that only
+    approximate Rego semantics (f32 ordering ties, composite equality) and
+    must survive arbitrary negation — Not(lo, hi) = (~hi, ~lo) — so the
+    final filter verdict (hi) over-fires and never under-fires; the host
+    re-check of firing pairs is authoritative. Exact subtrees keep
+    lo `is` hi, so XLA sees a single computation for them."""
+
+    lo: Any
+    hi: Any
+
+    @staticmethod
+    def exact(v) -> "BPair":
+        return BPair(v, v)
+
+    @property
+    def is_exact(self) -> bool:
+        return self.lo is self.hi
+
+
+def _band(a: BPair, b: BPair) -> BPair:
+    lo = jnp.logical_and(a.lo, b.lo)
+    hi = lo if (a.is_exact and b.is_exact) else jnp.logical_and(a.hi, b.hi)
+    return BPair(lo, hi)
+
+
+def _bor(a: BPair, b: BPair) -> BPair:
+    lo = jnp.logical_or(a.lo, b.lo)
+    hi = lo if (a.is_exact and b.is_exact) else jnp.logical_or(a.hi, b.hi)
+    return BPair(lo, hi)
+
+
+def _bnot(a: BPair) -> BPair:
+    hi = jnp.logical_not(a.lo)
+    lo = hi if a.is_exact else jnp.logical_not(a.hi)
+    return BPair(lo, hi)
+
+
+def _bany(a: BPair, mask, axis: int) -> BPair:
+    lo = jnp.any(jnp.logical_and(a.lo, mask), axis=axis, keepdims=True)
+    hi = lo if a.is_exact else jnp.any(jnp.logical_and(a.hi, mask),
+                                       axis=axis, keepdims=True)
+    return BPair(lo, hi)
+
+
 class EvalError(Exception):
     pass
 
@@ -259,27 +305,36 @@ def _eval_cell(plan: _ClausePlan, e: Expr, feats, params) -> Cell:
 
 
 def _eval_num(plan: _ClausePlan, e: Expr, feats, params, table):
-    """-> (num value, defined)."""
+    """-> (vlo, vhi, defined, nid-or-None): an interval [vlo, vhi]
+    containing the true value.
+
+    Cell values are points (vlo is vhi) carrying nid, the interned
+    canonical-number id (exact-equality witness for f32 ties). Counts over
+    uncertain inner literals widen to [sum(lo), sum(hi)]; plain counts are
+    exact small ints (exact in f32)."""
     if isinstance(e, SumReduce):
         inner = _eval_bool(plan, e.e, feats, params, table)
         pres = plan.presence(e.axis, feats, params)
         pos = plan.axpos[e.axis]
-        s = jnp.sum(jnp.where(jnp.logical_and(inner, pres), 1.0, 0.0),
-                    axis=pos, keepdims=True)
-        return s, jnp.bool_(True)
+        slo = jnp.sum(jnp.where(jnp.logical_and(inner.lo, pres), 1.0, 0.0),
+                      axis=pos, keepdims=True)
+        shi = slo if inner.is_exact else jnp.sum(
+            jnp.where(jnp.logical_and(inner.hi, pres), 1.0, 0.0),
+            axis=pos, keepdims=True)
+        return slo, shi, jnp.bool_(True), None
     if isinstance(e, OVal) and e.f in ("count", "countz"):
         arrs = feats[e.slot]
         val = plan.place_obj(arrs["count"], e.slot, None)
         if e.f == "countz":
-            return val, jnp.bool_(True)
+            return val, val, jnp.bool_(True), None
         kinds = plan.place_obj(arrs["kind"], e.slot, None)
-        return val, kinds != K_ABSENT
+        return val, val, kinds != K_ABSENT, None
     if isinstance(e, PVal) and e.f == "count":
         arrs = params[e.slot]
         val = plan.place_param(arrs["count"], e.slot, None)
-        return val, jnp.bool_(True)
+        return val, val, jnp.bool_(True), None
     cell = _eval_cell(plan, e, feats, params)
-    return cell.num, cell.kind == K_NUM
+    return cell.num, cell.num, cell.kind == K_NUM, cell.nid
 
 
 def _cell_eq(l: Cell, r: Cell):
@@ -301,26 +356,71 @@ def _cell_eq(l: Cell, r: Cell):
     return jnp.logical_or(lit_eq, maybe), defined, maybe
 
 
-def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table):
-    """-> literal success (bool array, broadcastable to the clause rank)."""
+def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table) -> BPair:
+    """-> literal success BPair (bool arrays broadcastable to the clause
+    rank). hi is the over-approximation the filter fires on; lo feeds
+    negation so Not() can't turn over-fire into under-fire."""
     if isinstance(e, Cmp):
         if e.dtype == "auto":
             l = _eval_cell(plan, e.lhs, feats, params)
             r = _eval_cell(plan, e.rhs, feats, params)
             eq, defined, maybe = _cell_eq(l, r)
             if e.op == "eq":
-                return jnp.logical_and(defined, eq)
+                # eq includes maybe-equal composites; certain only without
+                return BPair(
+                    jnp.logical_and(defined,
+                                    jnp.logical_and(eq, ~maybe)),
+                    jnp.logical_and(defined, eq))
             if e.op == "ne":
-                # maybe-equal composites also succeed on != (over-fire bias)
-                return jnp.logical_and(defined,
-                                       jnp.logical_or(~eq, maybe))
+                return BPair(
+                    jnp.logical_and(defined, ~eq),
+                    jnp.logical_and(defined, jnp.logical_or(~eq, maybe)))
             raise EvalError(f"auto cmp op {e.op}")
-        lv, ld = _eval_num(plan, e.lhs, feats, params, table)
-        rv, rd = _eval_num(plan, e.rhs, feats, params, table)
-        ops = {"eq": jnp.equal, "ne": jnp.not_equal, "lt": jnp.less,
-               "le": jnp.less_equal, "gt": jnp.greater,
-               "ge": jnp.greater_equal}
-        return jnp.logical_and(jnp.logical_and(ld, rd), ops[e.op](lv, rv))
+        lvlo, lvhi, ld, lnid = _eval_num(plan, e.lhs, feats, params, table)
+        rvlo, rvhi, rd, rnid = _eval_num(plan, e.rhs, feats, params, table)
+        defined = jnp.logical_and(ld, rd)
+        # f32 carries ~24 bits of mantissa: values that differ beyond that
+        # (e.g. 16777217 vs 16777216) compare equal, hiding the true
+        # ordering. A "tie" = f32-equal point values whose exact canonical
+        # number ids differ — the comparison outcome is then unknown, so
+        # it lands in hi but not lo. nid 0 / None = computed value, exact.
+        if lnid is not None and rnid is not None:
+            tie = jnp.logical_and(
+                lvlo == rvlo, jnp.logical_and(lnid != rnid,
+                                              jnp.logical_and(lnid != 0,
+                                                              rnid != 0)))
+            exact = False
+        else:
+            tie = jnp.bool_(False)
+            exact = (lvlo is lvhi) and (rvlo is rvhi)
+        point = (lvlo is lvhi) and (rvlo is rvhi)
+        # interval comparison: lo = certain for all values in the
+        # intervals, hi = possible for some values (plus tie uncertainty)
+        if e.op == "lt":
+            lo, hi = lvhi < rvlo, jnp.logical_or(lvlo < rvhi, tie)
+        elif e.op == "gt":
+            lo, hi = lvlo > rvhi, jnp.logical_or(lvhi > rvlo, tie)
+        elif e.op == "le":
+            lo = jnp.logical_and(lvhi <= rvlo, ~tie)
+            hi = lvlo <= rvhi
+        elif e.op == "ge":
+            lo = jnp.logical_and(lvlo >= rvhi, ~tie)
+            hi = lvhi >= rvlo
+        elif e.op == "eq":
+            pts = (lvlo == rvlo) if point else jnp.logical_and(
+                lvlo == lvhi, jnp.logical_and(rvlo == rvhi, lvlo == rvlo))
+            lo = jnp.logical_and(pts, ~tie)
+            hi = jnp.logical_and(lvlo <= rvhi, rvlo <= lvhi)  # overlap
+        elif e.op == "ne":
+            pts = (lvlo == rvlo) if point else jnp.logical_and(
+                lvlo == lvhi, jnp.logical_and(rvlo == rvhi, lvlo == rvlo))
+            lo = jnp.logical_or(lvhi < rvlo, rvhi < lvlo)  # disjoint
+            hi = jnp.logical_not(jnp.logical_and(pts, ~tie))
+        else:
+            raise EvalError(f"cmp op {e.op}")
+        lo = jnp.logical_and(defined, lo)
+        hi = lo if exact else jnp.logical_and(defined, hi)
+        return BPair(lo, hi)
     if isinstance(e, MatchLookup):
         # table is bit-packed [V, W] uint32 (strtab.materialize_packed):
         # gather the string's row-bitmask words (1-D gather) and test the
@@ -342,56 +442,60 @@ def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table):
                            dtype=jnp.uint32)
         rbit = (jnp.uint32(1) << (r & 31).astype(jnp.uint32))
         hit = (word & rbit) != 0
-        return jnp.logical_and(defined, hit)
+        return BPair.exact(jnp.logical_and(defined, hit))
     if isinstance(e, Truthy):
         c = _eval_cell(plan, e.e, feats, params)
-        return jnp.logical_and(c.kind != K_ABSENT, c.kind != K_FALSE)
+        return BPair.exact(jnp.logical_and(c.kind != K_ABSENT,
+                                           c.kind != K_FALSE))
     if isinstance(e, Exists):
         c = _eval_cell(plan, e.e, feats, params)
-        return c.kind != K_ABSENT
+        return BPair.exact(c.kind != K_ABSENT)
     if isinstance(e, And):
         out = None
         for x in e.items:
             v = _eval_bool(plan, x, feats, params, table)
-            out = v if out is None else jnp.logical_and(out, v)
-        return out if out is not None else jnp.bool_(True)
+            out = v if out is None else _band(out, v)
+        return out if out is not None else BPair.exact(jnp.bool_(True))
     if isinstance(e, Or):
         out = None
         for x in e.items:
             v = _eval_bool(plan, x, feats, params, table)
-            out = v if out is None else jnp.logical_or(out, v)
-        return out if out is not None else jnp.bool_(False)
+            out = v if out is None else _bor(out, v)
+        return out if out is not None else BPair.exact(jnp.bool_(False))
     if isinstance(e, Not):
         inner = _eval_bool(plan, e.e, feats, params, table)
         for ax in e.local_axes:
             pres = plan.presence(ax, feats, params)
-            inner = jnp.any(jnp.logical_and(inner, pres),
-                            axis=plan.axpos[ax], keepdims=True)
-        return jnp.logical_not(inner)
+            inner = _bany(inner, pres, plan.axpos[ax])
+        return _bnot(inner)
     if isinstance(e, OrReduce):
         inner = _eval_bool(plan, e.e, feats, params, table)
         pres = plan.presence(e.axis, feats, params)
-        return jnp.any(jnp.logical_and(inner, pres),
-                       axis=plan.axpos[e.axis], keepdims=True)
+        return _bany(inner, pres, plan.axpos[e.axis])
     if isinstance(e, SumReduce):
-        v, _ = _eval_num(plan, e, feats, params, table)
-        return v != 0
+        slo, shi, _, _ = _eval_num(plan, e, feats, params, table)
+        lo = slo != 0
+        hi = lo if shi is slo else shi != 0
+        return BPair(lo, hi)
     if isinstance(e, Const):
         if e.kind == "bool":
-            return jnp.bool_(bool(e.value))
-        return jnp.bool_(True)  # any non-false scalar literal succeeds
+            return BPair.exact(jnp.bool_(bool(e.value)))
+        # any non-false scalar literal succeeds
+        return BPair.exact(jnp.bool_(True))
     raise EvalError(f"unsupported expr {type(e).__name__}")
 
 
 def _eval_clause(plan: _ClausePlan, feats, params, table):
-    success = None
+    pair = None
     for g in plan.clause.guards:
         v = _eval_bool(plan, g.expr, feats, params, table)
         if g.negated:  # guards are pre-wrapped in Not by the compiler
-            v = jnp.logical_not(v)
-        success = v if success is None else jnp.logical_and(success, v)
-    if success is None:
-        success = jnp.bool_(True)
+            v = _bnot(v)
+        pair = v if pair is None else _band(pair, v)
+    if pair is None:
+        pair = BPair.exact(jnp.bool_(True))
+    # the filter verdict is the over-approximation: possibly-fires
+    success = pair.hi
     for a in plan.clause.axes:
         success = jnp.logical_and(success,
                                   plan.presence(a.name, feats, params))
@@ -448,6 +552,9 @@ class CompiledTemplate:
         Single dispatch: inputs live on device whole, the chunk loop is a
         lax.map inside the jitted fn (no per-chunk host→device transfers —
         they dominate when the chip is reached over a network tunnel)."""
+        if not feats:
+            # parameter-only program: no object slots to chunk over
+            return self.fires(feats, params, match_table)
         n = next(iter(next(iter(feats.values())).values())).shape[0]
         if n <= chunk:
             return self.fires(feats, params, match_table)

@@ -57,7 +57,9 @@ def _review_kind(review: dict) -> dict:
 
 
 def _is_ns(kind: dict) -> bool:
-    return kind.get("group", "") in ("", None) and kind.get("kind") == "Namespace"
+    # reference is_ns (src.rego:258-261) requires kind.group == "" exactly;
+    # a missing or null group leaves it undefined, so it must NOT match
+    return kind.get("group") == "" and kind.get("kind") == "Namespace"
 
 
 def _get_ns_name(review: dict):

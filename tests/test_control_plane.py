@@ -388,3 +388,47 @@ def test_metrics_rendered(runtime):
                  "request_count", "request_duration_seconds", "constraints",
                  "constraint_templates"):
         assert name in text, f"metric {name} missing"
+
+
+def test_status_writes_reach_fixpoint(runtime):
+    """Regression: unconditional status writes used to emit MODIFIED events
+    back into the controllers' own queues, reconciling forever."""
+    kube = runtime.kube
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    kube.create(CONSTRAINT)
+    runtime.manager.drain()
+    gvk = (CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels")
+    rv0 = kube.get(gvk, "ns-must-have-owner")["metadata"]["resourceVersion"]
+    trv0 = kube.get(TEMPLATE_GVK,
+                    "k8srequiredlabels")["metadata"]["resourceVersion"]
+    time.sleep(0.5)  # idle: no event should cause further writes
+    runtime.manager.drain()
+    rv1 = kube.get(gvk, "ns-must-have-owner")["metadata"]["resourceVersion"]
+    trv1 = kube.get(TEMPLATE_GVK,
+                    "k8srequiredlabels")["metadata"]["resourceVersion"]
+    assert rv0 == rv1, "constraint status keeps rewriting (reconcile loop)"
+    assert trv0 == trv1, "template status keeps rewriting (reconcile loop)"
+
+
+def test_deleted_constraint_not_resurrected_by_stale_event(runtime):
+    """Regression: a MODIFIED event drained after DELETED must not re-add
+    the constraint from the stale event payload."""
+    from gatekeeper_tpu.control.kube import WatchEvent
+
+    kube = runtime.kube
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    kube.create(CONSTRAINT)
+    runtime.manager.drain()
+    stale = kube.get((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                     "ns-must-have-owner")
+    kube.delete((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                "ns-must-have-owner")
+    runtime.manager.drain()
+    # simulate the race: stale MODIFIED delivered after the delete
+    ctrl = runtime.manager.constraint_ctrl
+    ctrl.reconcile(WatchEvent("MODIFIED", stale))
+    out = runtime.webhook.validation.handle(admission_review(ns("anything")))
+    assert out["response"]["allowed"] is True, \
+        "deleted constraint still denying admissions"

@@ -267,3 +267,115 @@ deny[m] { input.x > 0; m := "d" }
                      "metadata": {"name": "other", "namespace": "d"}})
     res = client.audit().results()
     assert [r.resource["metadata"]["name"] for r in res] == ["target-me"]
+
+
+# --------------------------------------------------- numeric precision ties
+
+BIGNUM_TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1beta1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "bignum"},
+    "spec": {
+        "crd": {"spec": {"names": {"kind": "BigNum"}}},
+        "targets": [{
+            "target": "admission.k8s.gatekeeper.sh",
+            "rego": """
+package bignum
+violation[{"msg": msg}] {
+  provided := input.review.object.spec.replicas
+  maximum := input.parameters.max
+  provided > maximum
+  msg := "too many replicas"
+}
+""",
+        }],
+    },
+}
+
+
+def test_f32_tie_does_not_underfire():
+    """Regression (ADVICE r1): 16777217 > 16777216 is a tie in float32;
+    the device filter must over-fire on exact-id mismatch so the host
+    re-check decides, never silently dropping the violation."""
+    constraint = {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1", "kind": "BigNum",
+        "metadata": {"name": "c1"},
+        "spec": {"parameters": {"max": 16777216}},
+    }
+    objs = [
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "over", "namespace": "default"},
+         "spec": {"replicas": 16777217}},
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "at-limit", "namespace": "default"},
+         "spec": {"replicas": 16777216}},
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "under", "namespace": "default"},
+         "spec": {"replicas": 3}},
+    ]
+    run_both(BIGNUM_TEMPLATE, [constraint], objs)
+
+
+NEGATED_BIGNUM_TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1beta1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "bignumneg"},
+    "spec": {
+        "crd": {"spec": {"names": {"kind": "BigNumNeg"}}},
+        "targets": [{
+            "target": "admission.k8s.gatekeeper.sh",
+            "rego": """
+package bignumneg
+violation[{"msg": msg}] {
+  provided := input.review.object.spec.replicas
+  maximum := input.parameters.max
+  not provided < maximum
+  msg := "not under the limit"
+}
+""",
+        }],
+    },
+}
+
+
+def test_f32_tie_does_not_underfire_under_negation():
+    """Regression (r2 code review): over-fire bias at a comparison leaf is
+    flipped by `not` — uncertainty must propagate as a (lo, hi) pair so
+    negation swaps bounds instead of inverting the over-approximation."""
+    constraint = {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "BigNumNeg", "metadata": {"name": "c1"},
+        "spec": {"parameters": {"max": 16777217}},
+    }
+    objs = [
+        # 16777216 < 16777217 exactly, but ties in f32: `not <` must not
+        # drop the uncertainty (interpreter says no violation; and the
+        # device filter may fire, host re-check settles it)
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "tie-under", "namespace": "default"},
+         "spec": {"replicas": 16777216}},
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "over", "namespace": "default"},
+         "spec": {"replicas": 16777218}},
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "under", "namespace": "default"},
+         "spec": {"replicas": 3}},
+    ]
+    run_both(NEGATED_BIGNUM_TEMPLATE, [constraint], objs)
+
+
+def test_f32_tie_negated_exact_violation_found():
+    """The exact case from the review: replicas == max ties in f32; `not
+    provided < maximum` holds exactly (equal), so the violation must
+    surface on the device path."""
+    constraint = {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "BigNumNeg", "metadata": {"name": "c1"},
+        "spec": {"parameters": {"max": 16777216}},
+    }
+    objs = [
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "at-tie", "namespace": "default"},
+         "spec": {"replicas": 16777217}},
+    ]
+    run_both(NEGATED_BIGNUM_TEMPLATE, [constraint], objs)
