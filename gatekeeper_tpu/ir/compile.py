@@ -12,14 +12,21 @@ template runs on the interpreter driver instead.
 Supported subset (grown corpus-first, SURVEY.md §7 P0):
   * scalar guards over input.review.* / input.parameters.* paths
   * iteration over object lists/maps and parameter lists (up to 2 axes
-    per slot), including `v := obj.labels[k]` map-entry iteration
-  * set comprehensions over object keys/values and parameter values;
-    set difference + count(s) {>,!=,==,<=} 0 patterns
-  * string predicates startswith/endswith/contains/re_match with the
-    pattern from parameters or constants (match-table rows)
-  * array comprehensions of booleans + any() (allowedrepos pattern)
-  * boolean helper functions (single package), inlined; `not` with
-    locally-bound axes reduced inside the negation
+    per slot), including `v := obj.labels[k]` map-entry iteration and
+    path segments indexed by const-bound vars (`spec[field][_]`)
+  * local partial-set rules (`input_containers`) and path-valued helper
+    functions, flattened by ir/specialize.py before compilation
+  * set comprehensions (multi-literal bodies with filters, key-sets,
+    const-head existence sets), set difference/intersection, membership,
+    and count comparisons that reduce to emptiness tests
+  * string predicates startswith/endswith/contains/re_match/glob with
+    patterns from parameters or constants (match-table rows), including
+    pattern transforms (trim) applied at encode time
+  * pure unary helper functions (canonify_cpu/mem) as vocab-indexed
+    derived columns, and binary string helpers (path_matches) as
+    interpreter-backed match-table rows (ops/derived.py)
+  * boolean/value helper functions inlined with constant-formal
+    unification; `not` with locally-bound axes reduced inside the negation
 """
 
 from __future__ import annotations
@@ -30,13 +37,18 @@ from typing import Any, Optional, Union
 from ..rego import ast as A
 from .prog import (
     And,
+    Arith,
     Axis,
     Clause,
     Cmp,
     Const,
+    DerivedSpec,
+    DerivedVal,
     Exists,
     Expr,
     Guard,
+    K_ARR,
+    KindIs,
     MatchLookup,
     Not,
     Or,
@@ -50,9 +62,18 @@ from .prog import (
     SumReduce,
     Truthy,
 )
+from .specialize import specialize_module
 
 _MATCH_OPS = {"startswith": "startswith", "endswith": "endswith",
               "contains": "contains", "re_match": "re_match"}
+# pattern-side transforms applied at encode time (rego fn name -> tag)
+_PATTERN_TRANSFORMS = {"trim": "trim", "lower": "lower", "upper": "upper",
+                       "trim_prefix": "trim_prefix",
+                       "trim_suffix": "trim_suffix"}
+_CMP_OPS = {"==": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt",
+            ">=": "ge"}
+_ARITH_OPS = {"+": "add", "-": "sub", "*": "mul"}
+_BUILTIN_DERIVED = {"to_number"}
 _MAX_INLINE_DEPTH = 8
 _MAX_SLOT_AXES = 2
 
@@ -83,16 +104,27 @@ class SKey:
 
 @dataclass(frozen=True)
 class SSet:
-    """A set of scalars: object map keys, object list/map values, or
-    parameter list values."""
+    """A set of scalars with optional element filter. source:
+    "objkeys" | "objvals" | "paramvals" | "exists" (const-head compr whose
+    elements don't matter, only non-emptiness). axes are the set-local
+    iteration axes created during the comprehension walk; filter (over
+    those axes) gates which elements belong."""
 
-    source: str  # "objkeys" | "objvals" | "paramvals"
-    path: SPath  # path whose final seg is the iteration
+    source: str
+    path: Optional[SPath]
+    axes: tuple = ()
+    filter: Optional[Expr] = None
 
 
 @dataclass(frozen=True)
 class SSetDiff:
     left: Union[SSet, "SSetDiff"]
+    right: SSet
+
+
+@dataclass(frozen=True)
+class SSetInter:
+    left: SSet
     right: SSet
 
 
@@ -117,14 +149,21 @@ class SExpr:
     zero_only: bool = False
 
 
-Symbolic = Union[SPath, SKey, SSet, SSetDiff, SBoolList, SConst, SExpr]
+Symbolic = Union[SPath, SKey, SSet, SSetDiff, SSetInter, SBoolList, SConst,
+                 SExpr]
+
+# cell-producing device exprs (vs boolean / numeric-computed)
+_CELL_EXPRS = (OVal, PVal, Const, DerivedVal)
+_BOOL_EXPRS = (Cmp, MatchLookup, Truthy, Exists, And, Or, Not, OrReduce,
+               KindIs)
 
 
 class _Ctx:
     """Mutable compile state shared across a template's clauses."""
 
-    def __init__(self, module: A.Module):
+    def __init__(self, module: A.Module, kind: str):
         self.module = module
+        self.kind = kind
         self.rules: dict[str, list[A.Rule]] = {}
         for r in module.rules:
             self.rules.setdefault(r.name, []).append(r)
@@ -132,11 +171,33 @@ class _Ctx:
         self.param_slots: dict[tuple, ParamSlotRec] = {}
         self.axis_n = 0
         self.axes: dict[str, Axis] = {}
+        self.derived: dict[tuple, int] = {}  # spec key -> col
+        self.derived_specs: list[DerivedSpec] = []
+        self.pred_ops: dict[str, str] = {}  # op name -> fn name
 
     def new_axis(self, kind: str) -> str:
         name = f"a{self.axis_n}"
         self.axis_n += 1
         return name
+
+    def derived_col(self, kind: str, arg: str) -> int:
+        key = (kind, arg)
+        col = self.derived.get(key)
+        if col is None:
+            col = len(self.derived_specs)
+            self.derived[key] = col
+            self.derived_specs.append(DerivedSpec(col=col, kind=kind,
+                                                  arg=arg))
+        return col
+
+    def rec_for_slot(self, slot: int):
+        for rec in self.obj_slots.values():
+            if rec.slot == slot:
+                return rec
+        for rec in self.param_slots.values():
+            if rec.slot == slot:
+                return rec
+        return None
 
 
 @dataclass
@@ -157,13 +218,17 @@ class ParamSlotRec:
 
 def compile_template(module: A.Module, kind: str) -> Program:
     """Compile the (already rewritten) entry module of a template."""
-    ctx = _Ctx(module)
+    module = specialize_module(module)
+    ctx = _Ctx(module, kind)
     vio = ctx.rules.get("violation")
     if not vio:
         raise Uncompilable("no violation rule")
     clauses = []
     for rule in vio:
-        clauses.append(_compile_clause(ctx, rule))
+        clause = _compile_clause(ctx, rule)
+        for g in clause.guards:
+            _check_no_nested_axis(g.expr, set())
+        clauses.append(clause)
     obj_slots = tuple(
         ObjSlotSpec(slot=r.slot, root=r.root, segs=r.segs, mode=r.mode)
         for r in sorted(ctx.obj_slots.values(), key=lambda r: r.slot)
@@ -175,19 +240,39 @@ def compile_template(module: A.Module, kind: str) -> Program:
     )
     return Program(kind=kind, obj_slots=obj_slots, param_slots=param_slots,
                    clauses=tuple(clauses),
-                   axes=tuple(ctx.axes.values()))
+                   axes=tuple(ctx.axes.values()),
+                   derived=tuple(ctx.derived_specs),
+                   pred_ops=tuple(sorted(ctx.pred_ops.items())))
+
+
+def _check_no_nested_axis(e: Expr, active: set) -> None:
+    """An axis reduced inside its own reduction scope would silently
+    collapse to a size-1 reduce — reject (sibling reuse is fine)."""
+    if isinstance(e, (OrReduce, SumReduce)):
+        if e.axis in active:
+            raise Uncompilable(f"axis {e.axis} reduced within its own scope")
+        _check_no_nested_axis(e.e, active | {e.axis})
+    elif isinstance(e, (And, Or)):
+        for x in e.items:
+            _check_no_nested_axis(x, active)
+    elif isinstance(e, Not):
+        _check_no_nested_axis(e.e, active | set(e.local_axes))
+    elif isinstance(e, Cmp):
+        _check_no_nested_axis(e.lhs, active)
+        _check_no_nested_axis(e.rhs, active)
+    elif isinstance(e, MatchLookup):
+        _check_no_nested_axis(e.row, active)
+        _check_no_nested_axis(e.sid, active)
+    elif isinstance(e, (Truthy, Exists, KindIs)):
+        _check_no_nested_axis(e.e, active)
+    elif isinstance(e, DerivedVal):
+        _check_no_nested_axis(e.base, active)
+    elif isinstance(e, Arith):
+        _check_no_nested_axis(e.lhs, active)
+        _check_no_nested_axis(e.rhs, active)
 
 
 # ------------------------------------------------------------------ clauses
-
-
-def _head_vars(rule: A.Rule) -> set:
-    out: set = set()
-    if rule.key is not None:
-        _collect_vars(rule.key, out)
-    if rule.value is not None:
-        _collect_vars(rule.value, out)
-    return out
 
 
 def _collect_vars(t, out: set) -> None:
@@ -270,6 +355,9 @@ class _ClauseCompiler:
         self.clause_axes: list[Axis] = []
         self.guards: list[Guard] = []
         self.depth = depth
+        # (axes, filter) scopes opened by in-guard set iteration; consumed
+        # by the enclosing literal (existential wrap)
+        self.pending_scopes: list[tuple[tuple, Optional[Expr]]] = []
 
     # -------------------------------------------------------------- literals
 
@@ -285,12 +373,20 @@ class _ClauseCompiler:
             if name not in self.needed and not name.startswith("$wc"):
                 return  # head-only binding: host materializes
             self.env[name] = self.bind_rhs(e.rhs)
+            if self.pending_scopes:
+                raise Uncompilable("set iteration in binding position")
+            return
+        if not lit.negated and isinstance(e, (A.Assign, A.Unify)) and \
+                isinstance(e.lhs, A.ArrayLit) and isinstance(e.rhs, A.Call) \
+                and tuple(e.rhs.fn) == ("split",):
+            self.split_destructure(e.lhs, e.rhs)
             return
         if not lit.negated and isinstance(e, (A.Assign, A.Unify)):
             raise Uncompilable(f"unsupported binding pattern {e!r}")
         # guard literal
         new_axes_start = len(self.clause_axes)
         expr = self.bool_expr(e)
+        expr = self._wrap_pending(expr)
         if lit.negated:
             local = tuple(a.name for a in self.clause_axes[new_axes_start:])
             del self.clause_axes[new_axes_start:]
@@ -298,28 +394,84 @@ class _ClauseCompiler:
         else:
             self.guards.append(Guard(expr=expr))
 
+    def _wrap_pending(self, expr: Expr) -> Expr:
+        """Existentially close set-iteration scopes opened inside a guard."""
+        while self.pending_scopes:
+            axes, filt = self.pending_scopes.pop()
+            if filt is not None:
+                expr = And((filt, expr))
+            for ax in reversed(axes):
+                expr = OrReduce(ax, expr)
+        return expr
+
+    def split_destructure(self, lhs: A.ArrayLit, call: A.Call) -> None:
+        """[a, b] := split(x, "/") — parts as derived columns; the clause
+        is undefined unless the split yields exactly len(lhs) parts."""
+        if len(call.args) != 2 or not isinstance(call.args[1], A.Scalar) \
+                or not isinstance(call.args[1].value, str):
+            raise Uncompilable("split destructure needs a constant separator")
+        sep = call.args[1].value
+        base = self.value_expr(self.to_symbolic(call.args[0]))
+        k = len(lhs.items)
+        col0 = None
+        for i, v in enumerate(lhs.items):
+            if not isinstance(v, A.Var):
+                raise Uncompilable("split destructure into non-vars")
+            col = self.ctx.derived_col("split", f"{sep}|{i}|{k}")
+            if i == 0:
+                col0 = col
+            if v.name in self.needed and not v.name.startswith("$wc"):
+                self.env[v.name] = SExpr(DerivedVal(col, base))
+        # arity guard: part 0 is defined iff the split has exactly k parts
+        self.guards.append(Guard(expr=Exists(DerivedVal(col0, base))))
+
     # -------------------------------------------------------------- bindings
 
     def bind_rhs(self, t) -> Symbolic:
         if isinstance(t, A.Scalar):
             return SConst(t.value)
+        if isinstance(t, A.ArrayLit) and not t.items:
+            return SConst(())
         if isinstance(t, A.Ref) or isinstance(t, A.Var):
             return self.resolve_ref(t)
         if isinstance(t, A.SetCompr):
             return self.set_compr(t)
         if isinstance(t, A.ArrayCompr):
             return self.bool_list_compr(t)
-        if isinstance(t, A.BinOp) and t.op == "-":
-            l = self.bind_rhs(t.lhs)
-            r = self.bind_rhs(t.rhs)
-            if isinstance(l, (SSet, SSetDiff)) and isinstance(r, SSet):
+        if isinstance(t, A.BinOp):
+            if t.op in _CMP_OPS:
+                return SExpr(self.cmp_expr(t))
+            l = self.to_symbolic(t.lhs)
+            r = self.to_symbolic(t.rhs)
+            if t.op == "-" and isinstance(l, (SSet, SSetDiff)) and \
+                    isinstance(r, SSet):
                 return SSetDiff(l, r)
-            raise Uncompilable("only set difference is supported for '-' bindings")
+            if t.op == "&" and isinstance(l, SSet) and isinstance(r, SSet):
+                return SSetInter(l, r)
+            if t.op in _ARITH_OPS:
+                return SExpr(Arith(_ARITH_OPS[t.op], self.num_expr(l),
+                                   self.num_expr(r)))
+            raise Uncompilable(f"unsupported binary op {t.op} in binding")
         if isinstance(t, A.Call):
             if tuple(t.fn) == ("count",):
                 return self.count_symbolic(t.args[0])
-            return SExpr(self.call_expr(t))
+            return self.call_value(t)
         raise Uncompilable(f"unsupported binding rhs {type(t).__name__}")
+
+    def call_value(self, t: A.Call) -> Symbolic:
+        """A call in value (binding) position."""
+        fn = tuple(t.fn)
+        if len(fn) == 1 and fn[0] in _BUILTIN_DERIVED and len(t.args) == 1:
+            base = self.value_expr(self.to_symbolic(t.args[0]))
+            if isinstance(base, _CELL_EXPRS):
+                col = self.ctx.derived_col("builtin", fn[0])
+                return SExpr(DerivedVal(col, base))
+            raise Uncompilable(f"{fn[0]} over non-cell value")
+        if len(fn) == 1 and fn[0] in self.ctx.rules:
+            sym = self._unary_derived(fn[0], t.args)
+            if sym is not None:
+                return sym
+        return SExpr(self.call_expr(t))
 
     # ------------------------------------------------------------------ refs
 
@@ -366,7 +518,9 @@ class _ClauseCompiler:
         raise Uncompilable(f"unsupported ref base {type(base).__name__}")
 
     def walk_segments(self, sym: Symbolic, args: tuple) -> Symbolic:
-        for arg in args:
+        for ai, arg in enumerate(args):
+            if isinstance(sym, SSet):
+                return self.set_bracket(sym, arg, args[ai + 1:])
             if not isinstance(sym, SPath):
                 raise Uncompilable("cannot descend into non-path symbolic")
             if isinstance(arg, A.Scalar):
@@ -377,28 +531,77 @@ class _ClauseCompiler:
                 name = arg.name
                 if name in self.env:
                     bound = self.env[name]
+                    if isinstance(bound, SConst) and \
+                            isinstance(bound.value, str):
+                        # const-bound var: spec[field][_] with field from
+                        # an object-head expansion or helper formal
+                        sym = replace(sym, segs=sym.segs +
+                                      (Seg("field", name=bound.value),))
+                        continue
                     if isinstance(bound, SKey):
+                        # re-indexing the SAME collection by the same key
+                        # var (ranges[j].min … ranges[j].max) aliases the
+                        # existing axis; correlated indexing across
+                        # different collections is not vectorizable
+                        ax = self.ctx.axes.get(bound.axis)
+                        owner = self.ctx.rec_for_slot(ax.slot) if ax else None
+                        here = sym.segs + (Seg("iter", axis=bound.axis),)
+                        same_root = owner is not None and (
+                            getattr(owner, "root", "params") ==
+                            ("params" if sym.root == "params" else sym.root))
+                        if same_root and tuple(owner.segs) == here:
+                            sym = replace(sym, segs=here)
+                            continue
                         raise Uncompilable(
-                            "indexing by a previously-bound key is not supported"
+                            "correlated indexing by a bound key across "
+                            "collections is not supported"
                         )
                     raise Uncompilable("indexing by bound var")
                 # fresh var or wildcard -> iteration axis
                 axis = self.ctx.new_axis("obj")
                 is_param = sym.root == "params"
                 kind = "param" if is_param else "obj"
-                prior_iters = any(s.kind == "iter" for s in sym.segs)
                 sym = replace(sym, segs=sym.segs + (Seg("iter", axis=axis),))
                 self._register_axis(axis, kind, sym)
                 if not name.startswith("$wc"):
-                    if prior_iters:
-                        # extraction records keys for the innermost axis only
-                        raise Uncompilable(
-                            "key binding on an outer axis of a nested iteration"
-                        )
                     self.env[name] = SKey(axis=axis, kind=kind)
             else:
                 raise Uncompilable("composite bracket pattern")
         return sym
+
+    def set_bracket(self, s: SSet, arg, rest: tuple) -> Symbolic:
+        """boundset[x]: membership test (const) or element iteration
+        (fresh var / wildcard)."""
+        if rest:
+            raise Uncompilable("descending into set elements")
+        if s.source == "exists":
+            raise Uncompilable("bracket on existence-only set")
+        if isinstance(arg, A.Scalar):
+            elem = self._set_elem_expr(s)
+            test = Cmp("eq", elem, self._const_expr(arg.value), dtype="auto")
+            if s.filter is not None:
+                test = And((s.filter, test))
+            for ax in reversed(s.axes):
+                test = OrReduce(ax, test)
+            return SExpr(test)
+        if isinstance(arg, A.Var) and (arg.name.startswith("$wc")
+                                       or arg.name not in self.env):
+            # iteration: open an existential scope closed by the literal
+            elem = self._set_elem_expr(s)
+            self.pending_scopes.append((s.axes, s.filter))
+            if not arg.name.startswith("$wc"):
+                self.env[arg.name] = SExpr(elem)
+            return SExpr(elem)
+        raise Uncompilable("unsupported set bracket")
+
+    def _const_expr(self, v) -> Expr:
+        if isinstance(v, bool):
+            return Const("bool", v)
+        if isinstance(v, (int, float)):
+            return Const("num", float(v))
+        if isinstance(v, str):
+            return Const("str", v)
+        raise Uncompilable(f"unsupported constant {v!r}")
 
     def _register_axis(self, axis: str, kind: str, sym: SPath) -> None:
         """Axis presence is owned by the slot of the iterated collection."""
@@ -438,37 +641,69 @@ class _ClauseCompiler:
     # -------------------------------------------------------- comprehensions
 
     def set_compr(self, t: A.SetCompr) -> SSet:
-        if not isinstance(t.head, A.Var):
-            raise Uncompilable("set comprehension head must be a var")
-        head = t.head.name
-        if len(t.body) != 1:
-            raise Uncompilable("multi-literal set comprehension")
-        e = t.body[0].expr
-        if t.body[0].negated:
-            raise Uncompilable("negated comprehension body")
-        sub = _ClauseCompiler(self.ctx, self.needed | {head},
+        """{head | generator; ...filters...}. Forms:
+          {x | x := path[_]}        — value set
+          {k | path[k]}             — key set
+          {x | x = path[_][k]; ...} — nested value set
+          {1 | guards}              — existence set (const head)
+        Extra body literals become the element filter."""
+        sub = _ClauseCompiler(self.ctx, self.needed | _body_vars(t.body),
                               env=dict(self.env), depth=self.depth)
-        if isinstance(e, (A.Assign, A.Unify)) and isinstance(e.lhs, A.Var) \
-                and e.lhs.name == head:
-            sym = sub.resolve_ref(e.rhs)
-            if not isinstance(sym, SPath):
-                raise Uncompilable("comprehension rhs must be a path")
-            if not sym.segs or not any(s.kind == "iter" for s in sym.segs):
-                raise Uncompilable("comprehension must iterate")
-            source = "paramvals" if sym.root == "params" else "objvals"
-            return SSet(source=source, path=sym)
-        if isinstance(e, A.Ref):
-            # {k | obj.labels[k]} — key-set form
-            sym = sub.resolve_ref(e)
-            bound = sub.env.get(head)
-            if isinstance(bound, SKey) and isinstance(sym, SPath):
-                source = "paramvals" if sym.root == "params" else "objkeys"
-                if source == "objkeys":
-                    # path up to (and including) the iteration seg
-                    return SSet(source="objkeys", path=sym)
-                raise Uncompilable("param key-set comprehension")
+        head = t.head
+        head_name = head.name if isinstance(head, A.Var) else None
+        if head_name is not None:
+            sub.needed = sub.needed | {head_name}
+        start_axes = len(sub.clause_axes)
+        gen_path: Optional[SPath] = None
+        source: Optional[str] = None
+        filters: list[Expr] = []
+        for li, lit in enumerate(t.body):
+            e = lit.expr
+            if gen_path is None and not lit.negated and head_name and \
+                    isinstance(e, (A.Assign, A.Unify)) and \
+                    isinstance(e.lhs, A.Var) and e.lhs.name == head_name:
+                sym = sub.resolve_ref(e.rhs) if isinstance(
+                    e.rhs, (A.Ref, A.Var)) else None
+                if not isinstance(sym, SPath) or not any(
+                        s.kind == "iter" for s in sym.segs):
+                    raise Uncompilable("comprehension generator must iterate")
+                gen_path = sym
+                source = "paramvals" if sym.root == "params" else "objvals"
+                continue
+            if gen_path is None and not lit.negated and head_name and \
+                    isinstance(e, A.Ref):
+                sym = sub.resolve_ref(e)
+                bound = sub.env.get(head_name)
+                if isinstance(bound, SKey) and isinstance(sym, SPath):
+                    if sym.root == "params":
+                        raise Uncompilable("param key-set comprehension")
+                    gen_path = sym
+                    source = "objkeys"
+                    continue
+                # a plain ref guard (e.g. the generator for a const head)
+                expr = sub.bool_expr(e)
+                expr = sub._wrap_pending(expr)
+                filters.append(expr if not lit.negated else Not(expr))
+                continue
+            # filter literal
+            ax_mark = len(sub.clause_axes)
+            expr = sub.bool_expr(e)
+            expr = sub._wrap_pending(expr)
+            if lit.negated:
+                local = tuple(a.name for a in sub.clause_axes[ax_mark:])
+                del sub.clause_axes[ax_mark:]
+                expr = Not(expr, local_axes=local)
+            filters.append(expr)
+        axes = tuple(a.name for a in sub.clause_axes[start_axes:])
+        filt = And(tuple(filters)) if len(filters) > 1 else (
+            filters[0] if filters else None)
+        if gen_path is None:
+            if head_name is None and isinstance(head, A.Scalar):
+                # existence set: {1 | guards}
+                return SSet(source="exists", path=None, axes=axes,
+                            filter=filt)
             raise Uncompilable("unrecognized set comprehension form")
-        raise Uncompilable("unsupported set comprehension body")
+        return SSet(source=source, path=gen_path, axes=axes, filter=filt)
 
     def bool_list_compr(self, t: A.ArrayCompr) -> SBoolList:
         """[b | x = params.list[_]; ...guards...; b = pred(x)]"""
@@ -484,6 +719,7 @@ class _ClauseCompiler:
             if not lit.negated and isinstance(e, (A.Assign, A.Unify)) and \
                     isinstance(e.lhs, A.Var) and e.lhs.name == head:
                 pred = sub.bool_expr(e.rhs)
+                pred = sub._wrap_pending(pred)
             else:
                 sub.literal(lit)
         if pred is None:
@@ -492,9 +728,6 @@ class _ClauseCompiler:
         guards = [g.expr if not g.negated else Not(g.expr)
                   for g in sub.guards]
         expr = And(tuple(guards + [pred])) if guards else pred
-        # comprehension axes do not escape into the clause
-        for a in sub.clause_axes[start_axes:]:
-            pass
         return SBoolList(axes=axes, expr=expr)
 
     # ----------------------------------------------------------- guard exprs
@@ -505,7 +738,10 @@ class _ClauseCompiler:
         if isinstance(e, A.Call):
             return self.call_expr(e)
         if isinstance(e, (A.Ref, A.Var)):
-            return Truthy(self.value_expr(self.to_symbolic(e)))
+            sym = self.to_symbolic(e)
+            if isinstance(sym, SExpr) and isinstance(sym.expr, _BOOL_EXPRS):
+                return sym.expr
+            return Truthy(self.value_expr(sym))
         if isinstance(e, A.Scalar):
             # any scalar except `false` succeeds as a body literal (null too)
             return Const("bool", e.value is not False)
@@ -524,15 +760,21 @@ class _ClauseCompiler:
         if isinstance(t, A.Call):
             if tuple(t.fn) == ("count",):
                 return self.count_symbolic(t.args[0])
-            return SExpr(self.call_expr(t))
+            return self.call_value(t)
         return self.bind_rhs(t)
 
     def cmp_expr(self, e: A.BinOp) -> Expr:
-        op_map = {"==": "eq", "!=": "ne", "<": "lt", "<=": "le",
-                  ">": "gt", ">=": "ge"}
-        if e.op not in op_map:
+        if e.op not in _CMP_OPS:
             raise Uncompilable(f"unsupported operator {e.op}")
-        op = op_map[e.op]
+        op = _CMP_OPS[e.op]
+        # X == sprintf("prefix%v", [t]) — equality against a prefixed
+        # string (apparmor annotation keys): strip the prefix via a derived
+        # column and compare the remainder
+        if op in ("eq", "ne"):
+            for a, b in ((e.lhs, e.rhs), (e.rhs, e.lhs)):
+                desugar = self._sprintf_eq(a, b, op)
+                if desugar is not None:
+                    return desugar
         lhs = self.term_for_cmp(e.lhs)
         rhs = self.term_for_cmp(e.rhs)
         _check_zero_only(lhs, rhs, op)
@@ -544,6 +786,26 @@ class _ClauseCompiler:
         rexpr = self.num_expr(rhs)
         return Cmp(op, lexpr, rexpr, dtype="num")
 
+    def _sprintf_eq(self, value_t, call_t, op: str) -> Optional[Expr]:
+        if not (isinstance(call_t, A.Call)
+                and tuple(call_t.fn) == ("sprintf",)
+                and len(call_t.args) == 2
+                and isinstance(call_t.args[0], A.Scalar)
+                and isinstance(call_t.args[0].value, str)
+                and isinstance(call_t.args[1], A.ArrayLit)
+                and len(call_t.args[1].items) == 1):
+            return None
+        fmt = call_t.args[0].value
+        if not fmt.endswith("%v") or fmt.count("%") != 1:
+            return None
+        if op != "eq":
+            raise Uncompilable("sprintf equality only supports ==")
+        prefix = fmt[:-2]
+        col = self.ctx.derived_col("strip_prefix", prefix)
+        base = self.value_expr(self.to_symbolic(value_t))
+        arg = self.value_expr(self.to_symbolic(call_t.args[1].items[0]))
+        return Cmp("eq", DerivedVal(col, base), arg, dtype="auto")
+
     def term_for_cmp(self, t) -> Symbolic:
         if isinstance(t, A.Call) and tuple(t.fn) == ("count",):
             return self.count_symbolic(t.args[0])
@@ -551,11 +813,25 @@ class _ClauseCompiler:
 
     def count_symbolic(self, arg) -> SExpr:
         sym = self.to_symbolic(arg)
-        zero_only = isinstance(sym, (SSet, SSetDiff))
+        zero_only = isinstance(sym, (SSet, SSetDiff, SSetInter))
         return SExpr(self.count_of(sym), zero_only=zero_only)
 
     def eq_expr(self, lhs: Symbolic, rhs: Symbolic, op: str = "eq") -> Expr:
-        if isinstance(lhs, SExpr) or isinstance(rhs, SExpr):
+        # equality against the empty array: kind test + zero count
+        for a, b in ((lhs, rhs), (rhs, lhs)):
+            if isinstance(a, SConst) and a.value == ():
+                if op != "eq":
+                    raise Uncompilable("!= [] is not supported")
+                if not isinstance(b, SPath):
+                    raise Uncompilable("[] comparison needs a path")
+                return And((KindIs(self.value_expr(b), (K_ARR,)),
+                            Cmp("eq", self.count_of(b), Const("num", 0.0),
+                                dtype="num")))
+        l_num = isinstance(lhs, SExpr) and not isinstance(lhs.expr,
+                                                          _CELL_EXPRS)
+        r_num = isinstance(rhs, SExpr) and not isinstance(rhs.expr,
+                                                          _CELL_EXPRS)
+        if l_num or r_num:
             l = self.num_expr(lhs)
             r = self.num_expr(rhs)
             return Cmp(op, l, r, dtype="num")
@@ -574,19 +850,12 @@ class _ClauseCompiler:
     def value_expr(self, sym: Symbolic) -> Expr:
         """Leaf device expr for a scalar symbolic value."""
         if isinstance(sym, SConst):
-            v = sym.value
-            if isinstance(v, bool):
-                return Const("bool", v)
-            if isinstance(v, (int, float)):
-                return Const("num", float(v))
-            if isinstance(v, str):
-                return Const("str", v)
-            raise Uncompilable(f"unsupported constant {v!r}")
+            return self._const_expr(sym.value)
         if isinstance(sym, SKey):
-            if sym.kind == "param":
-                ax = self.ctx.axes[sym.axis]
-                return PVal(ax.slot, f="key", axis=sym.axis)
             ax = self.ctx.axes[sym.axis]
+            self._check_key_innermost(sym, ax)
+            if sym.kind == "param":
+                return PVal(ax.slot, f="key", axis=sym.axis)
             return OVal(ax.slot, f="key", axis=sym.axis)
         if isinstance(sym, SExpr):
             return sym.expr
@@ -601,6 +870,15 @@ class _ClauseCompiler:
             rec = self._obj_slot(sym, mode=mode)
             return OVal(rec.slot, f="val", axis=axis)
         raise Uncompilable(f"cannot make a scalar of {type(sym).__name__}")
+
+    def _check_key_innermost(self, sym: SKey, ax: Axis) -> None:
+        """Extraction records keys for a slot's innermost axis only."""
+        rec = self.ctx.rec_for_slot(ax.slot)
+        if rec is None:
+            return
+        iters = [s.axis for s in rec.segs if s.kind == "iter"]
+        if iters and iters[-1] != sym.axis:
+            raise Uncompilable("key binding on a non-innermost axis")
 
     # ----------------------------------------------------------------- calls
 
@@ -624,8 +902,76 @@ class _ClauseCompiler:
                 raise Uncompilable("glob.match arity")
             return self.match_call("glob", (e.args[0], e.args[2]))
         if len(fn) == 1 and fn[0] in self.ctx.rules:
-            return self.inline_helper(fn[0], e.args)
+            try:
+                return self.inline_helper(fn[0], e.args)
+            except Uncompilable:
+                alt = self._fn_fallback(fn[0], e.args)
+                if alt is not None:
+                    return alt
+                raise
         raise Uncompilable(f"unsupported call {'.'.join(fn)}")
+
+    def _fn_fallback(self, name: str, args: tuple) -> Optional[Expr]:
+        """Helper calls the inliner can't vectorize: unary fns become
+        vocab-indexed derived columns; binary (value, param-pattern) fns
+        become interpreter-backed match-table rows."""
+        if len(args) == 1:
+            sym = self._unary_derived(name, args)
+            if sym is not None:
+                return Truthy(sym.expr)
+            return None
+        if len(args) == 2:
+            return self._binary_predicate(name, args)
+        return None
+
+    def _unary_derived(self, name: str, args: tuple) -> Optional[SExpr]:
+        if len(args) != 1:
+            return None
+        rules = self.ctx.rules.get(name) or []
+        if not rules or any(r.kind != "function" for r in rules):
+            return None
+        if any(_refs_input(r) for r in rules):
+            return None  # not pure in its argument
+        try:
+            sym = self.to_symbolic(args[0])
+            base = self.value_expr(sym)
+        except Uncompilable:
+            return None
+        if not isinstance(base, _CELL_EXPRS):
+            return None
+        col = self.ctx.derived_col("fn", name)
+        return SExpr(DerivedVal(col, base))
+
+    def _binary_predicate(self, name: str, args: tuple) -> Optional[Expr]:
+        rules = self.ctx.rules.get(name) or []
+        if not rules or any(r.kind != "function" for r in rules):
+            return None
+        if any(_refs_input(r) for r in rules):
+            return None
+        syms = []
+        try:
+            syms = [self.to_symbolic(a) for a in args]
+        except Uncompilable:
+            return None
+        # find the parameter-side (pattern) argument
+        pat_i = None
+        for i, s in enumerate(syms):
+            if isinstance(s, SPath) and s.root == "params":
+                pat_i = i
+        if pat_i is None:
+            return None
+        val_i = 1 - pat_i
+        pat_sym = syms[pat_i]
+        # op encodes argument order so the host closure applies the fn
+        # with the pattern in the right position
+        op = f"pred:{self.ctx.kind}:{name}:{pat_i}"
+        self.ctx.pred_ops[op] = name
+        try:
+            vexpr = self.value_expr(syms[val_i])
+        except Uncompilable:
+            return None
+        row = self._pattern_row(op, pat_sym)
+        return MatchLookup(row=row, sid=vexpr)
 
     def match_call(self, op: str, args: tuple) -> Expr:
         """startswith(value, pattern) / re_match(pattern, value) etc."""
@@ -635,35 +981,83 @@ class _ClauseCompiler:
             value_t, pattern_t = args[0], args[1]
         value = self.to_symbolic(value_t)
         vexpr = self.value_expr(value)
+        # pattern-side transform: startswith(x, trim(params.p[_], "*"))
+        while isinstance(pattern_t, A.Call) and len(pattern_t.fn) == 1 and \
+                pattern_t.fn[0] in _PATTERN_TRANSFORMS:
+            targs = pattern_t.args
+            if len(targs) == 2 and isinstance(targs[1], A.Scalar) and \
+                    isinstance(targs[1].value, str):
+                op = f"{op}@{_PATTERN_TRANSFORMS[pattern_t.fn[0]]}:{targs[1].value}"
+                pattern_t = targs[0]
+            elif len(targs) == 1:
+                op = f"{op}@{_PATTERN_TRANSFORMS[pattern_t.fn[0]]}:"
+                pattern_t = targs[0]
+            else:
+                raise Uncompilable("unsupported pattern transform")
         pattern = self.to_symbolic(pattern_t)
+        row = self._pattern_row(op, pattern)
+        return MatchLookup(row=row, sid=vexpr)
+
+    def _pattern_row(self, op: str, pattern: Symbolic) -> Expr:
         if isinstance(pattern, SConst):
             if not isinstance(pattern.value, str):
                 raise Uncompilable("pattern must be a string")
-            row = Const("row", (op, pattern.value))
-        elif isinstance(pattern, SPath) and pattern.root == "params":
+            return Const("row", (op, pattern.value))
+        if isinstance(pattern, SPath) and pattern.root == "params":
             axes = [s.axis for s in pattern.segs if s.kind == "iter"]
             mode = "list" if axes else "scalar"
             rec = self._param_slot(pattern, mode=mode)
             rec.pattern_ops.add(op)
-            row = PVal(rec.slot, f=f"row:{op}", axis=axes[-1] if axes else None)
-        elif isinstance(pattern, SKey) and pattern.kind == "param":
+            return PVal(rec.slot, f=f"row:{op}",
+                        axis=axes[-1] if axes else None)
+        if isinstance(pattern, SKey) and pattern.kind == "param":
             raise Uncompilable("param key as pattern")
-        else:
-            raise Uncompilable("pattern must come from parameters or constants")
-        return MatchLookup(row=row, sid=vexpr)
+        raise Uncompilable("pattern must come from parameters or constants")
+
+    # ------------------------------------------------------------------ sets
+
+    def _set_elem_expr(self, s: SSet) -> Expr:
+        slot = self._set_slot(s)
+        axes = [seg.axis for seg in s.path.segs if seg.kind == "iter"]
+        axis = axes[-1] if axes else None
+        if s.source == "paramvals":
+            return PVal(slot, f="val", axis=axis)
+        if s.source == "objkeys":
+            return OVal(slot, f="key", axis=axis)
+        return OVal(slot, f="val", axis=axis)
+
+    def _set_slot(self, s: SSet) -> int:
+        if s.source == "paramvals":
+            return self._param_slot(s.path, mode="list").slot
+        return self._obj_slot(s.path, mode="entries").slot
 
     def count_of(self, sym: Symbolic) -> Expr:
         if isinstance(sym, SSetDiff):
             return self.setdiff_count(sym)
+        if isinstance(sym, SSetInter):
+            return self.setinter_count(sym)
         if isinstance(sym, SSet):
-            # |set comprehension| as an existence sum — dedup makes this
-            # valid only for emptiness comparisons (zero_only enforced by
-            # the caller via count_symbolic)
-            if sym.source == "paramvals":
+            # |set| as an existence sum — dedup makes this valid only for
+            # emptiness comparisons (zero_only enforced by count_symbolic)
+            if sym.source == "exists":
+                inner = sym.filter if sym.filter is not None else \
+                    Const("bool", True)
+                out = inner
+                for ax in reversed(sym.axes):
+                    out = SumReduce(ax, out)
+                if not sym.axes:
+                    raise Uncompilable("existence set without iteration")
+                return out
+            if sym.source == "paramvals" and sym.filter is None:
                 return PVal(self._set_slot(sym), f="count")
-            axis = self.ctx.new_axis("iter")
-            elem = self._set_elem(sym, axis)
-            return SumReduce(axis, Exists(elem))
+            elem = self._set_elem_expr(sym)
+            inner: Expr = Exists(elem)
+            if sym.filter is not None:
+                inner = And((sym.filter, inner))
+            out = inner
+            for ax in reversed(sym.axes):
+                out = SumReduce(ax, out)
+            return out
         if isinstance(sym, SPath):
             # count(path): defined only when the collection exists
             if sym.root == "params":
@@ -673,13 +1067,15 @@ class _ClauseCompiler:
             return OVal(rec.slot, f="count")
         raise Uncompilable("unsupported count() argument")
 
-    def count_expr(self, arg) -> Expr:
-        return self.count_symbolic(arg).expr
-
-    def _set_slot(self, s: SSet) -> int:
-        if s.source == "paramvals":
-            return self._param_slot(s.path, mode="list").slot
-        return self._obj_slot(s.path, mode="entries").slot
+    def _member_test(self, elem: Expr, s: SSet) -> Expr:
+        """∃ element of s equal to elem."""
+        other = self._set_elem_expr(s)
+        test: Expr = Cmp("eq", elem, other, dtype="auto")
+        if s.filter is not None:
+            test = And((s.filter, test))
+        for ax in reversed(s.axes):
+            test = OrReduce(ax, test)
+        return test
 
     def setdiff_count(self, sd: SSetDiff) -> Expr:
         """|A - B| as a device expr, valid for comparisons against 0 (set
@@ -687,22 +1083,33 @@ class _ClauseCompiler:
         if not isinstance(sd.left, SSet):
             raise Uncompilable("nested set difference")
         left, right = sd.left, sd.right
-        l_axis = self.ctx.new_axis("iter")
-        r_axis = self.ctx.new_axis("iter")
-        lv = self._set_elem(left, l_axis)
-        rv = self._set_elem(right, r_axis)
-        member = OrReduce(r_axis, Cmp("eq", lv, rv, dtype="auto"))
-        return SumReduce(l_axis, Not(member))
+        if left.source == "exists" or right.source == "exists":
+            raise Uncompilable("set difference over existence set")
+        lv = self._set_elem_expr(left)
+        inner: Expr = Not(self._member_test(lv, right))
+        if left.filter is not None:
+            inner = And((left.filter, inner))
+        out = inner
+        for ax in reversed(left.axes):
+            out = SumReduce(ax, out)
+        if not left.axes:
+            raise Uncompilable("set difference without iteration")
+        return out
 
-    def _set_elem(self, s: SSet, axis: str) -> Expr:
-        slot = self._set_slot(s)
-        rec_kind = "param" if s.source == "paramvals" else "obj"
-        self.ctx.axes[axis] = Axis(name=axis, kind=rec_kind, slot=slot)
-        if s.source == "paramvals":
-            return PVal(slot, f="val", axis=axis)
-        if s.source == "objkeys":
-            return OVal(slot, f="key", axis=axis)
-        return OVal(slot, f="val", axis=axis)
+    def setinter_count(self, si: SSetInter) -> Expr:
+        left, right = si.left, si.right
+        if left.source == "exists" or right.source == "exists":
+            raise Uncompilable("set intersection over existence set")
+        lv = self._set_elem_expr(left)
+        inner: Expr = self._member_test(lv, right)
+        if left.filter is not None:
+            inner = And((left.filter, inner))
+        out = inner
+        for ax in reversed(left.axes):
+            out = SumReduce(ax, out)
+        if not left.axes:
+            raise Uncompilable("set intersection without iteration")
+        return out
 
     # --------------------------------------------------------------- helpers
 
@@ -715,27 +1122,42 @@ class _ClauseCompiler:
         for r in rules:
             if r.kind != "function":
                 raise Uncompilable(f"{name} is not a function")
-            if r.value is not None and not (
-                isinstance(r.value, A.Scalar) and r.value.value is True
-            ):
-                raise Uncompilable(f"{name} is not a boolean helper")
             if len(r.args) != len(actuals):
                 continue
             env = {}
+            const_guards: list[Expr] = []
             ok = True
             for formal, actual in zip(r.args, actuals):
-                if not isinstance(formal, A.Var):
+                if isinstance(formal, A.Var):
+                    env[formal.name] = actual
+                elif isinstance(formal, A.Scalar):
+                    # constant formal: unify against the actual value
+                    if isinstance(actual, SConst):
+                        if actual.value != formal.value:
+                            ok = False
+                            break
+                    else:
+                        const_guards.append(Cmp(
+                            "eq", self.value_expr(actual),
+                            self._const_expr(formal.value), dtype="auto"))
+                else:
                     ok = False
                     break
-                env[formal.name] = actual
             if not ok:
-                raise Uncompilable(f"{name}: non-var formal args")
+                continue
             sub = _ClauseCompiler(self.ctx, _body_vars(r.body) | self.needed,
                                   env=env, depth=self.depth + 1)
             for lit in r.body:
                 sub.literal(lit)
-            exprs = [g.expr if not g.negated else Not(g.expr)
-                     for g in sub.guards]
+            exprs = const_guards + [
+                g.expr if not g.negated else Not(g.expr)
+                for g in sub.guards]
+            # head value: None/true => boolean helper; a var bound to a
+            # boolean expr (res := u != 0) contributes that expr; any other
+            # value contributes its truthiness
+            val_expr = self._helper_value(r, sub)
+            if val_expr is not None:
+                exprs.append(val_expr)
             body = And(tuple(exprs)) if len(exprs) != 1 else exprs[0]
             # axes bound inside the helper are existential at its boundary
             for ax in sub.clause_axes:
@@ -744,6 +1166,71 @@ class _ClauseCompiler:
         if not alts:
             raise Uncompilable(f"{name}: no applicable clauses")
         return Or(tuple(alts)) if len(alts) > 1 else alts[0]
+
+    def _helper_value(self, r: A.Rule, sub: "_ClauseCompiler"
+                      ) -> Optional[Expr]:
+        v = r.value
+        if v is None:
+            return None
+        if isinstance(v, A.Scalar):
+            if v.value is True:
+                return None
+            # falsy head constant can never succeed in boolean position
+            return Const("bool", v.value is not False and v.value is not None)
+        if isinstance(v, A.Var) and v.name in sub.env:
+            sym = sub.env[v.name]
+            if isinstance(sym, SExpr) and isinstance(sym.expr, _BOOL_EXPRS):
+                return sym.expr
+            return Truthy(sub.value_expr(sym))
+        if isinstance(v, (A.Ref, A.Var)):
+            return Truthy(sub.value_expr(sub.to_symbolic(v)))
+        raise Uncompilable(f"{r.name}: unsupported head value")
+
+
+def _refs_input(r: A.Rule) -> bool:
+    """Does the rule body reference input/data (i.e. not pure in args)?"""
+    found = [False]
+
+    def walk(t):
+        if isinstance(t, A.Var) and t.name in ("input", "data"):
+            found[0] = True
+        elif isinstance(t, A.Ref):
+            walk(t.base)
+            for a in t.args:
+                walk(a)
+        elif isinstance(t, A.Call):
+            for a in t.args:
+                walk(a)
+        elif isinstance(t, A.BinOp):
+            walk(t.lhs)
+            walk(t.rhs)
+        elif isinstance(t, A.UnaryMinus):
+            walk(t.term)
+        elif isinstance(t, (A.ArrayLit, A.SetLit)):
+            for x in t.items:
+                walk(x)
+        elif isinstance(t, A.ObjectLit):
+            for k, v in t.items:
+                walk(k)
+                walk(v)
+        elif isinstance(t, (A.ArrayCompr, A.SetCompr)):
+            walk(t.head)
+            for l in t.body:
+                walk(l.expr)
+        elif isinstance(t, A.ObjectCompr):
+            walk(t.key)
+            walk(t.value)
+            for l in t.body:
+                walk(l.expr)
+        elif isinstance(t, (A.Assign, A.Unify)):
+            walk(t.lhs)
+            walk(t.rhs)
+
+    for lit in r.body:
+        walk(lit.expr)
+    if r.value is not None:
+        walk(r.value)
+    return found[0]
 
 
 # comparisons whose truth is unchanged by duplicate counting (emptiness

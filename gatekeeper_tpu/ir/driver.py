@@ -26,6 +26,7 @@ import numpy as np
 
 from ..client.drivers import RegoDriver
 from ..client.types import Result
+from ..ops.derived import DerivedTables, interp_pred, interp_unary, split_part, strip_prefix
 from ..ops.strtab import MatchTables, StringTable
 from ..rego import ast as A
 from ..target.batch import match_masks
@@ -37,13 +38,95 @@ from .params import ParamEncodeError, encode_params
 _PREFIX_RE = re.compile(r'^templates\["([^"]+)"\]\["([^"]+)"\]$')
 
 
+def merge_template_modules(mods: list) -> Optional[A.Module]:
+    """Flatten a template's entry + lib modules into one compile unit.
+
+    The rewriter (client/rewriter.py) namespaces libs under
+    `libs.<target>.<Kind>...` and turns lib calls into
+    `data.libs...fn(...)` / refs into `data.libs...rule`. For the
+    vectorized compiler we flatten each lib rule to a unique local name
+    and redirect those data paths to it, so specialization and helper
+    inlining see one module. Returns None when the shape is unexpected
+    (falls back to the interpreter path)."""
+    from dataclasses import replace as dc_replace
+
+    entry = mods[0]
+    if not entry.package or entry.package[0] != "templates":
+        return None
+    renames: dict[tuple, str] = {}
+    rules = list(entry.rules)
+    for m in mods[1:]:
+        for r in m.rules:
+            flat = "__lib_" + "_".join(m.package[3:]) + "__" + r.name
+            renames[("data",) + tuple(m.package) + (r.name,)] = flat
+            rules.append(dc_replace(r, name=flat))
+
+    def fix_term(t):
+        if isinstance(t, A.Call):
+            if t.fn and t.fn[0] == "data":
+                flat = renames.get(tuple(t.fn))
+                if flat is not None:
+                    return A.Call((flat,), tuple(fix_term(a)
+                                                 for a in t.args))
+            return A.Call(t.fn, tuple(fix_term(a) for a in t.args))
+        if isinstance(t, A.Ref):
+            if isinstance(t.base, A.Var) and t.base.name == "data":
+                statics = []
+                for a in t.args:
+                    if isinstance(a, A.Scalar) and isinstance(a.value, str):
+                        statics.append(a.value)
+                    else:
+                        break
+                for ln in range(len(statics), 0, -1):
+                    flat = renames.get(("data",) + tuple(statics[:ln]))
+                    if flat is not None:
+                        rest = tuple(fix_term(a) for a in t.args[ln:])
+                        if not rest:
+                            return A.Var(flat)
+                        return A.Ref(base=A.Var(flat), args=rest)
+            return A.Ref(base=fix_term(t.base),
+                         args=tuple(fix_term(a) for a in t.args))
+        if isinstance(t, A.BinOp):
+            return A.BinOp(t.op, fix_term(t.lhs), fix_term(t.rhs))
+        if isinstance(t, A.UnaryMinus):
+            return A.UnaryMinus(fix_term(t.term))
+        if isinstance(t, (A.ArrayLit, A.SetLit)):
+            return type(t)(tuple(fix_term(x) for x in t.items))
+        if isinstance(t, A.ObjectLit):
+            return A.ObjectLit(tuple((fix_term(k), fix_term(v))
+                                     for k, v in t.items))
+        if isinstance(t, (A.ArrayCompr, A.SetCompr)):
+            return type(t)(fix_term(t.head),
+                           tuple(dc_replace(l, expr=fix_term(l.expr))
+                                 for l in t.body))
+        if isinstance(t, A.ObjectCompr):
+            return A.ObjectCompr(fix_term(t.key), fix_term(t.value),
+                                 tuple(dc_replace(l, expr=fix_term(l.expr))
+                                       for l in t.body))
+        if isinstance(t, (A.Assign, A.Unify)):
+            return type(t)(fix_term(t.lhs), fix_term(t.rhs))
+        return t
+
+    fixed = [dc_replace(
+        r,
+        key=fix_term(r.key) if r.key is not None else None,
+        value=fix_term(r.value) if r.value is not None else None,
+        args=tuple(fix_term(a) for a in r.args),
+        body=tuple(dc_replace(l, expr=fix_term(l.expr)) for l in r.body),
+    ) for r in rules]
+    return dc_replace(entry, rules=tuple(fixed))
+
+
 class TpuDriver(RegoDriver):
     def __init__(self):
         super().__init__()
         self.strtab = StringTable()
         self.match_tables = MatchTables(self.strtab)
+        self.derived_tables = DerivedTables(self.strtab)
         self._compiled: dict[str, Optional[CompiledTemplate]] = {}
         self._programs: dict[str, Any] = {}
+        self._modules: dict[str, A.Module] = {}
+        self._derived_cols: dict[str, list[int]] = {}  # kind -> global cols
         # generation counters for cache invalidation
         self._constraint_gen = 0
         self._data_gen = 0
@@ -63,13 +146,17 @@ class TpuDriver(RegoDriver):
         kind = m.group(2)
         self._compiled.pop(kind, None)
         self._programs.pop(kind, None)
+        self._modules.pop(kind, None)
+        self._derived_cols.pop(kind, None)
         self._param_cache.pop(kind, None)
         self._feat_cache.pop(kind, None)
-        if len(mods) != 1:
-            self._compiled[kind] = None  # libs: interpreter path for now
+        module = mods[0] if len(mods) == 1 else merge_template_modules(mods)
+        if module is None:
+            self._compiled[kind] = None
             return
         try:
-            self._programs[kind] = compile_template(mods[0], kind)
+            self._programs[kind] = compile_template(module, kind)
+            self._modules[kind] = module
         except Uncompilable:
             self._compiled[kind] = None
 
@@ -79,10 +166,14 @@ class TpuDriver(RegoDriver):
         if m:
             self._compiled.pop(m.group(2), None)
             self._programs.pop(m.group(2), None)
+            self._modules.pop(m.group(2), None)
+            self._derived_cols.pop(m.group(2), None)
         return n
 
     def compiled_for(self, kind: str) -> Optional[CompiledTemplate]:
-        """Lazily wrap the Program in a device evaluator."""
+        """Lazily wrap the Program in a device evaluator, registering its
+        derived columns (host-interpreted unary fns) and interpreted
+        predicate ops with the shared tables."""
         if kind in self._compiled:
             return self._compiled[kind]
         prog = self._programs.get(kind)
@@ -90,7 +181,28 @@ class TpuDriver(RegoDriver):
             self._compiled[kind] = None
             return None
         try:
+            module = self._modules[kind]
+            cols: list[int] = []
+            for spec in prog.derived:
+                if spec.kind == "fn":
+                    key = ("fn", kind, spec.arg)
+                    fn = interp_unary(module, spec.arg)
+                elif spec.kind == "split":
+                    sep, i, k = spec.arg.rsplit("|", 2)
+                    key = ("split", spec.arg)
+                    fn = split_part(sep, int(i), int(k))
+                elif spec.kind == "strip_prefix":
+                    key = ("strip_prefix", spec.arg)
+                    fn = strip_prefix(spec.arg)
+                else:
+                    raise EvalError(f"unknown derived kind {spec.kind}")
+                cols.append(self.derived_tables.col(key, fn))
+            for op, fn_name in prog.pred_ops:
+                pat_i = int(op.rsplit(":", 1)[1])
+                self.match_tables.register_op(
+                    op, interp_pred(module, fn_name, pat_i))
             ct = CompiledTemplate(prog, self.strtab, self.match_tables)
+            self._derived_cols[kind] = cols
         except Exception:
             ct = None
         self._compiled[kind] = ct
@@ -221,9 +333,21 @@ class TpuDriver(RegoDriver):
             if feat_key is not None:
                 fcache.clear()
                 fcache[feat_key] = feats
+        derived = self._derived_arrays(kind, ct)
         table = self.match_tables.materialize_packed()
-        fires = ct.fires(feats, enc, table)
+        fires = ct.fires(feats, enc, table, derived)
         return fires[: len(reviews)]
+
+    def _derived_arrays(self, kind: str, ct: CompiledTemplate) -> dict:
+        """Program-local derived columns, extended to the current vocab.
+        Must run after extraction/encoding interned this batch's strings
+        (same ordering contract as materialize_packed)."""
+        cols = self._derived_cols.get(kind) or []
+        if not cols:
+            return {}
+        global_arrays = self.derived_tables.materialize(cols)
+        return {spec.col: global_arrays[g]
+                for spec, g in zip(ct.program.derived, cols)}
 
     # ----------------------------------------------------- batched reviews
 

@@ -18,7 +18,7 @@ authoritative; it must never under-fire.
 from __future__ import annotations
 
 import functools
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,11 +30,13 @@ from .prog import (
     Axis,
     Cmp,
     Const,
+    DerivedVal,
     Expr,
     K_ABSENT,
     K_FALSE,
     K_NUM,
     K_STR,
+    KindIs,
     MatchLookup,
     Not,
     Or,
@@ -142,6 +144,10 @@ def resolve_consts(program: Program, table: StringTable,
             return OrReduce(e.axis, fix(e.e))
         if isinstance(e, SumReduce):
             return SumReduce(e.axis, fix(e.e))
+        if isinstance(e, DerivedVal):
+            return DerivedVal(e.col, fix(e.base))
+        if isinstance(e, KindIs):
+            return KindIs(fix(e.e), e.kinds)
         return e
 
     clauses = tuple(
@@ -149,9 +155,7 @@ def resolve_consts(program: Program, table: StringTable,
             dc_replace(g, expr=fix(g.expr)) for g in c.guards))
         for c in program.clauses
     )
-    return Program(kind=program.kind, obj_slots=program.obj_slots,
-                   param_slots=program.param_slots, clauses=clauses,
-                   axes=program.axes)
+    return dc_replace(program, clauses=clauses)
 
 
 def _collect_axes(e: Expr, out: set) -> None:
@@ -164,8 +168,10 @@ def _collect_axes(e: Expr, out: set) -> None:
     elif isinstance(e, MatchLookup):
         _collect_axes(e.row, out)
         _collect_axes(e.sid, out)
-    elif isinstance(e, (Truthy, Exists)):
+    elif isinstance(e, (Truthy, Exists, KindIs)):
         _collect_axes(e.e, out)
+    elif isinstance(e, DerivedVal):
+        _collect_axes(e.base, out)
     elif isinstance(e, (And, Or)):
         for x in e.items:
             _collect_axes(x, out)
@@ -248,7 +254,7 @@ class _ClausePlan:
         return self.place_obj(kinds, ax.slot, axis) != K_ABSENT
 
 
-def _eval_cell(plan: _ClausePlan, e: Expr, feats, params) -> Cell:
+def _eval_cell(plan: _ClausePlan, e: Expr, feats, params, derived) -> Cell:
     if isinstance(e, OVal):
         arrs = feats[e.slot]
         if e.f == "key":
@@ -301,10 +307,24 @@ def _eval_cell(plan: _ClausePlan, e: Expr, feats, params) -> Cell:
             return Cell(jnp.int32(e.value), jnp.float32(0), jnp.int32(0),
                         jnp.int8(0))
         raise EvalError(f"unresolved const {e.kind}")
+    if isinstance(e, DerivedVal):
+        # one gather per cell: the unary function's image over the vocab,
+        # indexed by the base cell's intern id (sid for strings, nid for
+        # numbers; other kinds have no image -> absent)
+        base = _eval_cell(plan, e.base, feats, params, derived)
+        col = derived[e.col]
+        is_str = base.kind == K_STR
+        is_num = base.kind == K_NUM
+        ix = jnp.where(is_str, base.sid, jnp.where(is_num, base.nid, 0))
+        V = col["kind"].shape[0]
+        ix = jnp.clip(ix, 0, V - 1)
+        kind = jnp.where(jnp.logical_or(is_str, is_num),
+                         col["kind"][ix], K_ABSENT).astype(jnp.int8)
+        return Cell(col["sid"][ix], col["num"][ix], col["nid"][ix], kind)
     raise EvalError(f"not a value expr: {type(e).__name__}")
 
 
-def _eval_num(plan: _ClausePlan, e: Expr, feats, params, table):
+def _eval_num(plan: _ClausePlan, e: Expr, feats, params, table, derived):
     """-> (vlo, vhi, defined, nid-or-None): an interval [vlo, vhi]
     containing the true value.
 
@@ -313,7 +333,7 @@ def _eval_num(plan: _ClausePlan, e: Expr, feats, params, table):
     uncertain inner literals widen to [sum(lo), sum(hi)]; plain counts are
     exact small ints (exact in f32)."""
     if isinstance(e, SumReduce):
-        inner = _eval_bool(plan, e.e, feats, params, table)
+        inner = _eval_bool(plan, e.e, feats, params, table, derived)
         pres = plan.presence(e.axis, feats, params)
         pos = plan.axpos[e.axis]
         slo = jnp.sum(jnp.where(jnp.logical_and(inner.lo, pres), 1.0, 0.0),
@@ -333,7 +353,7 @@ def _eval_num(plan: _ClausePlan, e: Expr, feats, params, table):
         arrs = params[e.slot]
         val = plan.place_param(arrs["count"], e.slot, None)
         return val, val, jnp.bool_(True), None
-    cell = _eval_cell(plan, e, feats, params)
+    cell = _eval_cell(plan, e, feats, params, derived)
     return cell.num, cell.num, cell.kind == K_NUM, cell.nid
 
 
@@ -356,14 +376,14 @@ def _cell_eq(l: Cell, r: Cell):
     return jnp.logical_or(lit_eq, maybe), defined, maybe
 
 
-def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table) -> BPair:
+def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table, derived) -> BPair:
     """-> literal success BPair (bool arrays broadcastable to the clause
     rank). hi is the over-approximation the filter fires on; lo feeds
     negation so Not() can't turn over-fire into under-fire."""
     if isinstance(e, Cmp):
         if e.dtype == "auto":
-            l = _eval_cell(plan, e.lhs, feats, params)
-            r = _eval_cell(plan, e.rhs, feats, params)
+            l = _eval_cell(plan, e.lhs, feats, params, derived)
+            r = _eval_cell(plan, e.rhs, feats, params, derived)
             eq, defined, maybe = _cell_eq(l, r)
             if e.op == "eq":
                 # eq includes maybe-equal composites; certain only without
@@ -376,8 +396,8 @@ def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table) -> BPair:
                     jnp.logical_and(defined, ~eq),
                     jnp.logical_and(defined, jnp.logical_or(~eq, maybe)))
             raise EvalError(f"auto cmp op {e.op}")
-        lvlo, lvhi, ld, lnid = _eval_num(plan, e.lhs, feats, params, table)
-        rvlo, rvhi, rd, rnid = _eval_num(plan, e.rhs, feats, params, table)
+        lvlo, lvhi, ld, lnid = _eval_num(plan, e.lhs, feats, params, table, derived)
+        rvlo, rvhi, rd, rnid = _eval_num(plan, e.rhs, feats, params, table, derived)
         defined = jnp.logical_and(ld, rd)
         # f32 carries ~24 bits of mantissa: values that differ beyond that
         # (e.g. 16777217 vs 16777216) compare equal, hiding the true
@@ -426,8 +446,8 @@ def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table) -> BPair:
         # gather the string's row-bitmask words (1-D gather) and test the
         # pattern row's bit — a single fused int32 AND per (obj, constraint)
         # cell, no extra broadcast dim and no 2-D fancy-index tuples.
-        row = _eval_cell(plan, e.row, feats, params).sid
-        sv = _eval_cell(plan, e.sid, feats, params)
+        row = _eval_cell(plan, e.row, feats, params, derived).sid
+        sv = _eval_cell(plan, e.sid, feats, params, derived)
         defined = jnp.logical_and(row >= 0, sv.kind == K_STR)
         V, W = table.shape
         r = jnp.clip(row, 0, W * 32 - 1)
@@ -444,36 +464,43 @@ def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table) -> BPair:
         hit = (word & rbit) != 0
         return BPair.exact(jnp.logical_and(defined, hit))
     if isinstance(e, Truthy):
-        c = _eval_cell(plan, e.e, feats, params)
+        c = _eval_cell(plan, e.e, feats, params, derived)
         return BPair.exact(jnp.logical_and(c.kind != K_ABSENT,
                                            c.kind != K_FALSE))
     if isinstance(e, Exists):
-        c = _eval_cell(plan, e.e, feats, params)
+        c = _eval_cell(plan, e.e, feats, params, derived)
         return BPair.exact(c.kind != K_ABSENT)
+    if isinstance(e, KindIs):
+        c = _eval_cell(plan, e.e, feats, params, derived)
+        hit = None
+        for k in e.kinds:
+            t = c.kind == k
+            hit = t if hit is None else jnp.logical_or(hit, t)
+        return BPair.exact(hit if hit is not None else jnp.bool_(False))
     if isinstance(e, And):
         out = None
         for x in e.items:
-            v = _eval_bool(plan, x, feats, params, table)
+            v = _eval_bool(plan, x, feats, params, table, derived)
             out = v if out is None else _band(out, v)
         return out if out is not None else BPair.exact(jnp.bool_(True))
     if isinstance(e, Or):
         out = None
         for x in e.items:
-            v = _eval_bool(plan, x, feats, params, table)
+            v = _eval_bool(plan, x, feats, params, table, derived)
             out = v if out is None else _bor(out, v)
         return out if out is not None else BPair.exact(jnp.bool_(False))
     if isinstance(e, Not):
-        inner = _eval_bool(plan, e.e, feats, params, table)
+        inner = _eval_bool(plan, e.e, feats, params, table, derived)
         for ax in e.local_axes:
             pres = plan.presence(ax, feats, params)
             inner = _bany(inner, pres, plan.axpos[ax])
         return _bnot(inner)
     if isinstance(e, OrReduce):
-        inner = _eval_bool(plan, e.e, feats, params, table)
+        inner = _eval_bool(plan, e.e, feats, params, table, derived)
         pres = plan.presence(e.axis, feats, params)
         return _bany(inner, pres, plan.axpos[e.axis])
     if isinstance(e, SumReduce):
-        slo, shi, _, _ = _eval_num(plan, e, feats, params, table)
+        slo, shi, _, _ = _eval_num(plan, e, feats, params, table, derived)
         lo = slo != 0
         hi = lo if shi is slo else shi != 0
         return BPair(lo, hi)
@@ -485,10 +512,10 @@ def _eval_bool(plan: _ClausePlan, e: Expr, feats, params, table) -> BPair:
     raise EvalError(f"unsupported expr {type(e).__name__}")
 
 
-def _eval_clause(plan: _ClausePlan, feats, params, table):
+def _eval_clause(plan: _ClausePlan, feats, params, table, derived):
     pair = None
     for g in plan.clause.guards:
-        v = _eval_bool(plan, g.expr, feats, params, table)
+        v = _eval_bool(plan, g.expr, feats, params, table, derived)
         if g.negated:  # guards are pre-wrapped in Not by the compiler
             v = _bnot(v)
         pair = v if pair is None else _band(pair, v)
@@ -532,38 +559,41 @@ class CompiledTemplate:
         self._fn = jax.jit(self._eval)
         self._scan_cache: dict[int, Any] = {}
 
-    def _eval(self, feats, params, table):
+    def _eval(self, feats, params, table, derived):
         out = None
         for plan in self.plans:
-            v = _eval_clause(plan, feats, params, table)
+            v = _eval_clause(plan, feats, params, table, derived)
             out = v if out is None else jnp.logical_or(out, v)
         return out
 
-    def fires(self, feats: dict, params: dict,
-              match_table: np.ndarray) -> np.ndarray:
+    def fires(self, feats: dict, params: dict, match_table: np.ndarray,
+              derived: Optional[dict] = None) -> np.ndarray:
         """-> bool [N, C]."""
-        return np.asarray(self._fn(feats, params, match_table))
+        return np.asarray(self._fn(feats, params, match_table,
+                                   derived or {}))
 
     def fires_chunked(self, feats: dict, params: dict,
                       match_table: np.ndarray,
+                      derived: Optional[dict] = None,
                       chunk: int = 8192) -> np.ndarray:
         """Chunk the N axis so [N, C, K...] intermediates stay bounded.
 
         Single dispatch: inputs live on device whole, the chunk loop is a
         lax.map inside the jitted fn (no per-chunk host→device transfers —
         they dominate when the chip is reached over a network tunnel)."""
+        derived = derived or {}
         if not feats:
             # parameter-only program: no object slots to chunk over
-            return self.fires(feats, params, match_table)
+            return self.fires(feats, params, match_table, derived)
         n = next(iter(next(iter(feats.values())).values())).shape[0]
         if n <= chunk:
-            return self.fires(feats, params, match_table)
+            return self.fires(feats, params, match_table, derived)
         if n % chunk:
             pad_n = ((n + chunk - 1) // chunk) * chunk
             feats = jax.tree_util.tree_map(
                 lambda a: jnp.pad(a, [(0, pad_n - n)] + [(0, 0)] *
                                   (a.ndim - 1)), feats)
-        out = self._fn_scan(feats, params, match_table, chunk)
+        out = self._fn_scan(feats, params, match_table, derived, chunk)
         # slice the bit-unpack padding back to the true C: the first param
         # array's leading dim, or 1 when the program has no parameters
         # (_eval_clause broadcasts C=1 then)
@@ -575,18 +605,18 @@ class CompiledTemplate:
             break
         return np.asarray(out)[:n, :c]
 
-    def _fn_scan(self, feats, params, match_table, chunk: int):
+    def _fn_scan(self, feats, params, match_table, derived, chunk: int):
         """Verdicts return bit-packed over C (32x smaller device→host
         transfer — decisive when the chip sits behind a network tunnel)."""
         fn = self._scan_cache.get(chunk)
         if fn is None:
-            def run(feats, params, table):
+            def run(feats, params, table, derived):
                 def reshape(a):
                     return a.reshape((-1, chunk) + a.shape[1:])
                 chunked = jax.tree_util.tree_map(reshape, feats)
 
                 def body(ch):
-                    fires = self._eval(ch, params, table)  # [chunk, C]
+                    fires = self._eval(ch, params, table, derived)  # [chunk, C]
                     c = fires.shape[-1]
                     w = (c + 31) // 32
                     pad = w * 32 - c
@@ -602,7 +632,7 @@ class CompiledTemplate:
                 return outs.reshape((-1,) + outs.shape[2:])
             fn = jax.jit(run)
             self._scan_cache[chunk] = fn
-        packed = np.asarray(fn(feats, params, match_table))
+        packed = np.asarray(fn(feats, params, match_table, derived))
         # unpack on host (vectorized)
         bits = (packed[..., None] >> np.arange(32, dtype=np.uint32)) & 1
         return bits.reshape(packed.shape[0], -1).astype(bool)

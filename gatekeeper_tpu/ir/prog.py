@@ -142,6 +142,37 @@ class MatchLookup(Expr):
 
 
 @dataclass(frozen=True)
+class DerivedVal(Expr):
+    """derived_columns[col] gathered at the base cell's intern id (sid for
+    strings, nid for numbers) — the device image of a pure unary function
+    (canonify_cpu/canonify_mem, split parts, prefix strips) precomputed
+    host-side over the vocab (ops/derived.py). Kind K_ABSENT where the
+    function is undefined for that input."""
+
+    col: int  # index into Program.derived
+    base: Expr
+
+
+@dataclass(frozen=True)
+class KindIs(Expr):
+    """cell.kind ∈ kinds, as a boolean (always defined)."""
+
+    e: Expr
+    kinds: tuple  # of int kind codes
+
+
+@dataclass(frozen=True)
+class Arith(Expr):
+    """Numeric arithmetic over value intervals. Results are widened by an
+    f32-rounding epsilon so threshold comparisons over-fire instead of
+    under-firing (host re-check is exact)."""
+
+    op: str  # "add" | "sub" | "mul"
+    lhs: Expr
+    rhs: Expr
+
+
+@dataclass(frozen=True)
 class Truthy(Expr):
     """Rego body-literal success of a value: defined and not false."""
 
@@ -218,6 +249,21 @@ class Clause:
 
 
 @dataclass(frozen=True)
+class DerivedSpec:
+    """One derived column the program needs. kind:
+      "fn"           — arg = module function name, host-evaluated by the
+                       interpreter per vocab entry
+      "split"        — arg = "<sep>|<i>|<k>": part i of split(s, sep),
+                       defined only when the split has exactly k parts
+      "strip_prefix" — arg = prefix; s minus prefix, undefined otherwise
+    """
+
+    col: int
+    kind: str
+    arg: str
+
+
+@dataclass(frozen=True)
 class Program:
     """One compiled template."""
 
@@ -227,6 +273,10 @@ class Program:
     clauses: tuple  # of Clause
     # every axis in the program (clause-level AND reduce-internal), by name
     axes: tuple = ()  # of Axis
+    derived: tuple = ()  # of DerivedSpec
+    # interpreted binary predicates: (match op name, module function name);
+    # the driver registers each op with MatchTables before evaluation
+    pred_ops: tuple = ()
 
     def axis_table(self) -> dict[str, Axis]:
         return {a.name: a for a in self.axes}

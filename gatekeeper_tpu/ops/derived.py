@@ -1,0 +1,201 @@
+"""Derived vocab columns: pure unary functions as device lookup tables.
+
+The TPU evaluator never runs scalar string/number functions per (object,
+constraint) pair. Instead, a pure unary helper (canonify_cpu / canonify_mem
+from library/general/containerlimits/src.rego, split parts, prefix strips)
+is evaluated ONCE per interned vocab entry on the host — via the Rego
+interpreter for module functions — and shipped to the device as columns
+indexed by string id. The cross-product sweep then costs one gather, the
+same hoisting trick the match tables use for string predicates
+(ops/strtab.py): O(vocab) host work outside the hot loop instead of
+O(objects × constraints) interpreted calls inside it (the reference's cost
+shape, vendor/.../opa/topdown).
+
+Columns extend lazily as the vocab grows, keyed by the same epoch scheme
+as MatchTables.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .strtab import StringTable, canon_num
+
+UNDEF = object()
+
+# kind codes mirrored from ir/prog.py (no import cycle: ops is below ir)
+_K_ABSENT = 0
+_K_NULL = 1
+_K_FALSE = 2
+_K_TRUE = 3
+_K_NUM = 4
+_K_STR = 5
+
+
+def decode_vocab(s: str) -> Any:
+    """Interned vocab entry -> the value it stands for. Numbers are
+    interned as canonical "\\x01n<repr>" strings (strtab.canon_num)."""
+    if s.startswith("\x01n"):
+        body = s[2:]
+        try:
+            return int(body)
+        except ValueError:
+            return float(body)
+    if s.startswith("\x00"):
+        return UNDEF  # pad entry
+    return s
+
+
+def split_part(sep: str, i: int, k: int) -> Callable[[Any], Any]:
+    """Part i of split(s, sep), defined only for exactly-k-part splits —
+    the definedness of part 0 doubles as the destructure arity guard."""
+
+    def fn(v: Any) -> Any:
+        if not isinstance(v, str):
+            return UNDEF
+        parts = v.split(sep)
+        if len(parts) != k:
+            return UNDEF
+        return parts[i]
+
+    return fn
+
+
+def strip_prefix(prefix: str) -> Callable[[Any], Any]:
+    def fn(v: Any) -> Any:
+        if not isinstance(v, str) or not v.startswith(prefix):
+            return UNDEF
+        return v[len(prefix):]
+
+    return fn
+
+
+class DerivedTables:
+    """Per-driver cache of derived columns over the shared vocab."""
+
+    def __init__(self, table: StringTable):
+        self.table = table
+        self._cols: dict[Any, int] = {}
+        self._fns: list[Callable[[Any], Any]] = []
+        self._data: list[dict[str, np.ndarray]] = []
+        self._built: list[int] = []
+
+    def col(self, key: Any, fn: Callable[[Any], Any]) -> int:
+        c = self._cols.get(key)
+        if c is None:
+            c = len(self._fns)
+            self._cols[key] = c
+            self._fns.append(fn)
+            self._data.append({
+                "sid": np.zeros(0, dtype=np.int32),
+                "num": np.zeros(0, dtype=np.float32),
+                "nid": np.zeros(0, dtype=np.int32),
+                "kind": np.zeros(0, dtype=np.int8),
+            })
+            self._built.append(0)
+        return c
+
+    def materialize(self, cols: list[int]) -> dict[int, dict[str, np.ndarray]]:
+        """Extend the requested columns to the current vocab and return
+        {col: {sid, num, nid, kind}} arrays of length V. Evaluating a fn
+        may intern new output strings (growing the vocab); the arrays are
+        sized to the pre-call snapshot — output ids are values, not
+        indices, so they may legitimately exceed V."""
+        out: dict[int, dict[str, np.ndarray]] = {}
+        for c in cols:
+            V = len(self.table)
+            built = self._built[c]
+            if built < V:
+                n_new = V - built
+                sid = np.zeros(n_new, dtype=np.int32)
+                num = np.full(n_new, np.nan, dtype=np.float32)
+                nid = np.zeros(n_new, dtype=np.int32)
+                kind = np.zeros(n_new, dtype=np.int8)
+                fn = self._fns[c]
+                for j in range(n_new):
+                    i = built + j
+                    if i == 0:
+                        continue  # pad entry: absent
+                    v = decode_vocab(self.table.string(i))
+                    if v is UNDEF:
+                        continue
+                    try:
+                        r = fn(v)
+                    except Exception:
+                        r = UNDEF
+                    if r is UNDEF:
+                        continue
+                    if isinstance(r, bool):
+                        kind[j] = _K_TRUE if r else _K_FALSE
+                        num[j] = 1.0 if r else 0.0
+                    elif isinstance(r, (int, float)):
+                        kind[j] = _K_NUM
+                        num[j] = float(r)
+                        nid[j] = self.table.intern(canon_num(r))
+                    elif isinstance(r, str):
+                        kind[j] = _K_STR
+                        sid[j] = self.table.intern(r)
+                    elif r is None:
+                        kind[j] = _K_NULL
+                    # arrays/objects: leave absent (no scalar image)
+                d = self._data[c]
+                self._data[c] = {
+                    "sid": np.concatenate([d["sid"], sid]),
+                    "num": np.concatenate([d["num"], num]),
+                    "nid": np.concatenate([d["nid"], nid]),
+                    "kind": np.concatenate([d["kind"], kind]),
+                }
+                self._built[c] = V
+            out[c] = self._data[c]
+        return out
+
+
+def interp_unary(module, name: str) -> Callable[[Any], Any]:
+    """Host closure evaluating a module function of one argument via the
+    Rego interpreter (the exact-semantics engine the host re-check uses)."""
+    from ..rego.interp import Ctx, Interpreter, RegoError, UNDEF as R_UNDEF
+    from ..utils.values import freeze, thaw
+
+    interp = Interpreter({"m": module})
+
+    def fn(v: Any) -> Any:
+        ctx = Ctx(interp, None)
+        try:
+            r = interp._call_function(module.package, name, (freeze(v),), ctx)
+        except RegoError:
+            return UNDEF
+        return UNDEF if r is R_UNDEF else thaw(r)
+
+    return fn
+
+
+def interp_pred(module, name: str, pattern_pos: int
+                ) -> Callable[[str, list], np.ndarray]:
+    """Match-table op closure for a binary boolean helper: rows are keyed
+    by the pattern (parameter-side) string; the vector is the predicate
+    over every vocab entry. pattern_pos says which formal receives the
+    pattern."""
+    from ..rego.interp import Ctx, Interpreter, RegoError, UNDEF as R_UNDEF
+    from ..utils.values import freeze
+
+    interp = Interpreter({"m": module})
+
+    def op(pattern: str, strings: list) -> np.ndarray:
+        out = np.zeros(len(strings), dtype=bool)
+        fp = freeze(pattern)
+        for i, s in enumerate(strings):
+            v = decode_vocab(s)
+            if v is UNDEF:
+                continue
+            args = (fp, freeze(v)) if pattern_pos == 0 else (freeze(v), fp)
+            ctx = Ctx(interp, None)
+            try:
+                r = interp._call_function(module.package, name, args, ctx)
+            except RegoError:
+                continue
+            out[i] = r is not R_UNDEF and r is not False
+        return out
+
+    return op

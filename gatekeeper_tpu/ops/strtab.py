@@ -21,7 +21,7 @@ the vocab is large enough to matter.
 from __future__ import annotations
 
 import re
-from typing import Iterable, Optional
+from typing import Any, Iterable, Optional
 
 import numpy as np
 
@@ -84,6 +84,19 @@ class MatchTables:
     """Cache of boolean match vectors over the vocab, one row per
     (op, pattern) pair. Rows extend lazily as the vocab grows."""
 
+    # pattern-side transforms: "<op>@trim:<cutset>" applies the transform
+    # to the pattern string at row-creation time (rego trim/trim_prefix/…
+    # wrapped around a parameter pattern, e.g. forbidden-sysctls)
+    TRANSFORMS = {
+        "trim": lambda v, arg: v.strip(arg) if arg else v.strip(),
+        "lower": lambda v, arg: v.lower(),
+        "upper": lambda v, arg: v.upper(),
+        "trim_prefix": lambda v, arg: v[len(arg):]
+        if arg and v.startswith(arg) else v,
+        "trim_suffix": lambda v, arg: v[: -len(arg)]
+        if arg and v.endswith(arg) else v,
+    }
+
     def __init__(self, table: StringTable):
         self.table = table
         self._rows: dict[tuple[str, str], int] = {}
@@ -92,9 +105,26 @@ class MatchTables:
         self._built_len: list[int] = []
         self._packed_cache: np.ndarray | None = None
         self._packed_key: tuple | None = None
+        self._custom: dict[str, Any] = {}  # op -> fn(pattern, strings)->bool[]
+
+    def register_op(self, op: str, fn) -> None:
+        """Custom predicate op (interpreter-backed binary helpers,
+        ops/derived.py interp_pred). Idempotent per op name."""
+        self._custom.setdefault(op, fn)
 
     def row(self, op: str, pattern: str) -> int:
-        """Row index for (op, pattern); builds the vector on first use."""
+        """Row index for (op, pattern); builds the vector on first use.
+        op may carry @transform tags applied to the pattern here, so
+        transformed patterns share rows with directly-written ones."""
+        if "@" in op:
+            op, _, tags = op.partition("@")
+            for tag in tags.split("@"):
+                name, _, arg = tag.partition(":")
+                fn = self.TRANSFORMS.get(name)
+                if fn is None:
+                    raise ValueError(f"unknown pattern transform {name!r}")
+                if isinstance(pattern, str):
+                    pattern = fn(pattern, arg)
         key = (op, pattern)
         r = self._rows.get(key)
         if r is None:
@@ -106,6 +136,8 @@ class MatchTables:
         return r
 
     def _eval(self, op: str, pattern: str, strings: list[str]) -> np.ndarray:
+        if op in self._custom:
+            return np.asarray(self._custom[op](pattern, strings), dtype=bool)
         if op == "startswith":
             return np.fromiter((s.startswith(pattern) for s in strings),
                                dtype=bool, count=len(strings))

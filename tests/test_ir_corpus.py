@@ -1,0 +1,202 @@
+"""Device-path conformance over the reference's own test corpus.
+
+For every library template that compiles to the device path, harvest the
+input documents its src_test.rego builds (evaluating the test files'
+helper functions with our interpreter), then check the core invariant on
+each: the device filter must fire for every input where the interpreter
+finds violations (never under-fire; over-fire is allowed — the host
+re-check is authoritative). Also asserts end-to-end client audit parity
+(TpuDriver vs RegoDriver) over the harvested objects.
+
+Reference corpus: /root/reference/library/**/src_test.rego (SURVEY.md §4
+tier 1 — the same suites the interpreter conformance tests run).
+"""
+
+from __future__ import annotations
+
+import glob
+from dataclasses import replace as dc_replace
+from pathlib import Path
+
+import pytest
+
+from gatekeeper_tpu.client import Backend, RegoDriver
+from gatekeeper_tpu.ir import TpuDriver
+from gatekeeper_tpu.rego import ast as A
+from gatekeeper_tpu.rego.interp import Interpreter, UNDEF
+from gatekeeper_tpu.rego.parser import parse_module
+from gatekeeper_tpu.target import K8sValidationTarget
+from gatekeeper_tpu.utils.values import thaw, freeze
+
+from .conftest import REFERENCE, requires_reference
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+LIB_DIRS = sorted(
+    str(Path(p).parent.relative_to(REFERENCE))
+    for p in glob.glob(str(REFERENCE / "library/*/*/src_test.rego"))
+) if REFERENCE.exists() else []
+
+# templates that are expected NOT to compile to the device path
+INTERPRETER_ONLY = {
+    "library/general/uniqueingresshost",      # data.inventory join
+    "library/general/uniqueserviceselector",  # data.inventory join
+}
+
+
+def _kind_for(pkg_name: str) -> str:
+    return "T" + pkg_name.capitalize()
+
+
+def harvest_inputs(src: str, test_src: str, pkg: tuple) -> list[dict]:
+    """Evaluate each test rule's `... with input as X` document."""
+    src_mod = parse_module(src)
+    test_mod = parse_module(test_src)
+    docs = []
+    harvest_rules = []
+    n = 0
+    for r in test_mod.rules:
+        if not r.name.startswith("test_"):
+            continue
+        for i, lit in enumerate(r.body):
+            wv = None
+            for w in lit.withs:
+                if tuple(w.target) == ("input",):
+                    wv = w.value
+            if wv is None:
+                continue
+            n += 1
+            harvest_rules.append(A.Rule(
+                name=f"__harvest_{n}", kind="complete", value=wv,
+                body=tuple(dc_replace(l, withs=()) for l in r.body[:i]),
+            ))
+            break
+    hmod = dc_replace(test_mod, rules=test_mod.rules + tuple(harvest_rules))
+    interp = Interpreter({"src": src_mod, "test": hmod})
+    for r in harvest_rules:
+        try:
+            v = interp.eval_rule(src_mod.package, r.name)
+        except Exception:
+            continue
+        if v is UNDEF:
+            continue
+        doc = thaw(freeze(v))
+        if isinstance(doc, dict) and "review" in doc:
+            docs.append(doc)
+    return docs
+
+
+def _template_for(dirpath: str) -> tuple[dict, str]:
+    src = (REFERENCE / dirpath / "src.rego").read_text()
+    pkg_name = parse_module(src).package[-1]
+    kind = _kind_for(pkg_name)
+    template = {
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": kind}}},
+            "targets": [{"target": TARGET, "rego": src}],
+        },
+    }
+    return template, kind
+
+
+@requires_reference
+@pytest.mark.parametrize("dirpath", LIB_DIRS)
+def test_device_never_underfires_on_reference_corpus(dirpath):
+    template, kind = _template_for(dirpath)
+    test_src = (REFERENCE / dirpath / "src_test.rego").read_text()
+    src = (REFERENCE / dirpath / "src.rego").read_text()
+    docs = harvest_inputs(src, test_src, None)
+    assert docs, f"no inputs harvested from {dirpath}"
+
+    drv = TpuDriver()
+    client = Backend(drv).new_client([K8sValidationTarget()])
+    client.add_template(template)
+    if dirpath in INTERPRETER_ONLY:
+        assert kind not in drv.compiled_kinds()
+        return
+    assert kind in drv.compiled_kinds(), f"{kind} did not compile"
+    ct = drv.compiled_for(kind)
+    assert ct is not None, f"{kind} failed device lowering"
+
+    under = []
+    over = 0
+    fired_cases = 0
+    for i, doc in enumerate(docs):
+        review = doc.get("review") or {}
+        params = doc.get("parameters")
+        constraint = {
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind, "metadata": {"name": f"c{i}"},
+            "spec": ({"parameters": params} if params is not None else {}),
+        }
+        interp_results = drv._eval_template_violations(
+            TARGET, constraint, review, "deny", {}, None)
+        fires = drv.eval_compiled(ct, kind, [review], [constraint])
+        if interp_results:
+            fired_cases += 1
+            if not fires[0, 0]:
+                under.append((i, [r.msg for r in interp_results]))
+        elif fires[0, 0]:
+            over += 1
+    assert not under, (
+        f"{dirpath}: device filter under-fired on {len(under)}/{len(docs)} "
+        f"harvested inputs: {under[:3]}"
+    )
+    # sanity: the corpus must actually exercise the violating path
+    assert fired_cases > 0, f"{dirpath}: no violating inputs harvested"
+
+
+@requires_reference
+@pytest.mark.parametrize("dirpath", [d for d in LIB_DIRS
+                                     if d not in INTERPRETER_ONLY])
+def test_client_audit_parity_on_reference_corpus(dirpath):
+    """End-to-end: audit over the harvested review objects must produce
+    identical result multisets through both drivers."""
+    template, kind = _template_for(dirpath)
+    test_src = (REFERENCE / dirpath / "src_test.rego").read_text()
+    src = (REFERENCE / dirpath / "src.rego").read_text()
+    docs = harvest_inputs(src, test_src, None)
+    # distinct parameterizations become distinct constraints; objects with
+    # metadata.name become inventory
+    outs = []
+    for drv_cls in (RegoDriver, TpuDriver):
+        drv = drv_cls()
+        client = Backend(drv).new_client([K8sValidationTarget()])
+        client.add_template(template)
+        seen_params = []
+        objs = []
+        for i, doc in enumerate(docs):
+            params = doc.get("parameters")
+            if params not in seen_params:
+                seen_params.append(params)
+            obj = (doc.get("review") or {}).get("object")
+            if isinstance(obj, dict):
+                o = dict(obj)
+                o.setdefault("apiVersion", "v1")
+                o.setdefault("kind", "Pod")
+                meta = dict(o.get("metadata") or {})
+                meta["name"] = f"obj-{i}"
+                meta.setdefault("namespace", "default")
+                o["metadata"] = meta
+                objs.append(o)
+        for j, params in enumerate(seen_params):
+            client.add_constraint({
+                "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": kind, "metadata": {"name": f"c{j}"},
+                "spec": ({"parameters": params} if params is not None
+                         else {}),
+            })
+        for o in objs:
+            client.add_data(o)
+        outs.append(sorted(
+            (r.msg, r.constraint["metadata"]["name"],
+             (r.resource or {}).get("metadata", {}).get("name"))
+            for r in client.audit().results()))
+    assert outs[0] == outs[1], (
+        f"{dirpath}: audit mismatch\ninterp only: "
+        f"{[x for x in outs[0] if x not in outs[1]][:5]}\ndevice only: "
+        f"{[x for x in outs[1] if x not in outs[0]][:5]}"
+    )
