@@ -35,8 +35,8 @@ def main() -> None:
     from gatekeeper_tpu.parallel.workload import build_eval_setup
 
     n_bucket = ((N_OBJECTS + CHUNK - 1) // CHUNK) * CHUNK
-    driver, ct, feats, params, table, reviews, cons = build_eval_setup(
-        N_OBJECTS, N_CONSTRAINTS, n_bucket=n_bucket)
+    driver, ct, feats, params, table, derived, reviews, cons = \
+        build_eval_setup(N_OBJECTS, N_CONSTRAINTS, n_bucket=n_bucket)
     setup_s = time.time() - t_setup
 
     # ---- compiled sweep (one real chip) -------------------------------
@@ -48,12 +48,12 @@ def main() -> None:
     params = jax.tree_util.tree_map(jax.device_put, params)
     table = jax.device_put(table)
     t0 = time.time()
-    fires = ct.fires_chunked(feats, params, table, chunk=CHUNK)
+    fires = ct.fires_chunked(feats, params, table, derived, chunk=CHUNK)
     warm_s = time.time() - t0  # includes jit compile
     t0 = time.time()
     iters = 3
     for _ in range(iters):
-        fires = ct.fires_chunked(feats, params, table, chunk=CHUNK)
+        fires = ct.fires_chunked(feats, params, table, derived, chunk=CHUNK)
     sweep_s = (time.time() - t0) / iters
     evals = N_OBJECTS * N_CONSTRAINTS
     evals_per_sec = evals / sweep_s

@@ -217,6 +217,13 @@ def report_last_sync(ts: Optional[float] = None) -> None:
                        ts if ts is not None else time.time())
 
 
+def report_device_demotion(kind: str, reason: str) -> None:
+    REGISTRY.counter_add("gatekeeper_tpu_device_demotions_total",
+                         "Templates demoted from the device path to the "
+                         "interpreter (a ~10^4x per-eval slowdown; should "
+                         "stay 0 in steady state)", kind=kind, reason=reason)
+
+
 def report_watch_manager(gvk_count: int, intended: int) -> None:
     REGISTRY.gauge_set("watch_manager_watched_gvk",
                        "Total number of watched GroupVersionKinds",

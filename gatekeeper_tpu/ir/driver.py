@@ -19,6 +19,7 @@ authoritative, so results are identical to the interpreter driver's
 
 from __future__ import annotations
 
+import logging
 import re
 from typing import Any, Iterable, Optional
 
@@ -26,7 +27,14 @@ import numpy as np
 
 from ..client.drivers import RegoDriver
 from ..client.types import Result
-from ..ops.derived import DerivedTables, interp_pred, interp_unary, split_part, strip_prefix
+from ..ops.derived import (
+    DerivedTables,
+    builtin_unary,
+    interp_pred,
+    interp_unary,
+    split_part,
+    strip_prefix,
+)
 from ..ops.strtab import MatchTables, StringTable
 from ..rego import ast as A
 from ..target.batch import match_masks
@@ -36,6 +44,8 @@ from .features import extract_batch
 from .params import ParamEncodeError, encode_params
 
 _PREFIX_RE = re.compile(r'^templates\["([^"]+)"\]\["([^"]+)"\]$')
+
+log = logging.getLogger("gatekeeper_tpu.ir.driver")
 
 
 def merge_template_modules(mods: list) -> Optional[A.Module]:
@@ -194,6 +204,9 @@ class TpuDriver(RegoDriver):
                 elif spec.kind == "strip_prefix":
                     key = ("strip_prefix", spec.arg)
                     fn = strip_prefix(spec.arg)
+                elif spec.kind == "builtin":
+                    key = ("builtin", spec.arg)
+                    fn = builtin_unary(spec.arg)
                 else:
                     raise EvalError(f"unknown derived kind {spec.kind}")
                 cols.append(self.derived_tables.col(key, fn))
@@ -203,10 +216,21 @@ class TpuDriver(RegoDriver):
                     op, interp_pred(module, fn_name, pat_i))
             ct = CompiledTemplate(prog, self.strtab, self.match_tables)
             self._derived_cols[kind] = cols
-        except Exception:
+        except Exception as e:
+            self._demote(kind, "lowering", e)
             ct = None
         self._compiled[kind] = ct
         return ct
+
+    def _demote(self, kind: str, reason: str, exc: Exception) -> None:
+        """A device->interpreter demotion is a ~10^4x per-eval slowdown;
+        it must never be silent (each one is logged and counted)."""
+        from ..control.metrics import report_device_demotion
+
+        log.warning(
+            "template %s demoted to interpreter path (%s): %s: %s",
+            kind, reason, type(exc).__name__, exc)
+        report_device_demotion(kind, reason)
 
     def compiled_kinds(self) -> list[str]:
         return sorted(k for k in self._programs)
@@ -283,9 +307,10 @@ class TpuDriver(RegoDriver):
         try:
             fires = self.eval_compiled(ct, kind, cand_reviews, cons,
                                        feat_key=feat_key)
-        except Exception:
+        except Exception as e:
             # eval-time failures (shapes/ops outside the evaluator's
             # envelope) demote the template to the interpreter path
+            self._demote(kind, "audit-eval", e)
             self._compiled[kind] = None
             return self._audit_interp(target, kind, cons, reviews,
                                       lookup_ns, inventory, trace)
@@ -403,7 +428,8 @@ class TpuDriver(RegoDriver):
                     hits = np.logical_and(fires, mask[cand])
                     pairs = [(int(cand[ri]), int(ci))
                              for ri, ci in zip(*np.nonzero(hits))]
-                except Exception:
+                except Exception as e:
+                    self._demote(kind, "review-eval", e)
                     self._compiled[kind] = None
             if pairs is None:
                 pairs = [(r, c) for r in range(len(reviews))

@@ -27,6 +27,7 @@ import numpy as np
 from ..ops.strtab import MatchTables, StringTable
 from .prog import (
     And,
+    Arith,
     Axis,
     Cmp,
     Const,
@@ -128,6 +129,8 @@ def resolve_consts(program: Program, table: StringTable,
             return e
         if isinstance(e, Cmp):
             return Cmp(e.op, fix(e.lhs), fix(e.rhs), e.dtype)
+        if isinstance(e, Arith):
+            return Arith(e.op, fix(e.lhs), fix(e.rhs))
         if isinstance(e, MatchLookup):
             return MatchLookup(fix(e.row), fix(e.sid))
         if isinstance(e, Truthy):
@@ -162,7 +165,7 @@ def _collect_axes(e: Expr, out: set) -> None:
     if isinstance(e, (OVal, PVal)):
         if e.axis:
             out.add(e.axis)
-    elif isinstance(e, Cmp):
+    elif isinstance(e, (Cmp, Arith)):
         _collect_axes(e.lhs, out)
         _collect_axes(e.rhs, out)
     elif isinstance(e, MatchLookup):
@@ -353,6 +356,37 @@ def _eval_num(plan: _ClausePlan, e: Expr, feats, params, table, derived):
         arrs = params[e.slot]
         val = plan.place_param(arrs["count"], e.slot, None)
         return val, val, jnp.bool_(True), None
+    if isinstance(e, Arith):
+        llo, lhi, ld, _ = _eval_num(plan, e.lhs, feats, params, table, derived)
+        rlo, rhi, rd, _ = _eval_num(plan, e.rhs, feats, params, table, derived)
+        defined = jnp.logical_and(ld, rd)
+        if e.op == "add":
+            lo, hi = llo + rlo, lhi + rhi
+        elif e.op == "sub":
+            lo, hi = llo - rhi, lhi - rlo
+        elif e.op == "mul":
+            # interval product: extremes are among the endpoint products
+            a, b, c, d = llo * rlo, llo * rhi, lhi * rlo, lhi * rhi
+            lo = jnp.minimum(jnp.minimum(a, b), jnp.minimum(c, d))
+            hi = jnp.maximum(jnp.maximum(a, b), jnp.maximum(c, d))
+        else:
+            raise EvalError(f"arith op {e.op}")
+        # widen by the f32 rounding slack (each op contributes <=2^-24
+        # relative error; 1e-5 covers deep expression chains) plus a tiny
+        # absolute term so exact-zero results still straddle the true
+        # value — threshold comparisons then over-fire, never under-fire
+        # (the Arith docstring's contract in prog.py; host re-check exact)
+        eps = jnp.float32(1e-5)
+        tiny = jnp.float32(1e-30)
+        lo = lo - (jnp.abs(lo) * eps + tiny)
+        hi = hi + (jnp.abs(hi) * eps + tiny)
+        # f32 overflow in chained ops can yield nan (inf - inf, 0 * inf),
+        # which compares False on BOTH bounds — an under-fire. Scrub nan to
+        # the unbounded interval; bare ±inf endpoints are already
+        # conservative (lo=-inf claims nothing, hi=+inf over-fires).
+        lo = jnp.where(jnp.isnan(lo), -jnp.inf, lo)
+        hi = jnp.where(jnp.isnan(hi), jnp.inf, hi)
+        return lo, hi, defined, None
     cell = _eval_cell(plan, e, feats, params, derived)
     return cell.num, cell.num, cell.kind == K_NUM, cell.nid
 

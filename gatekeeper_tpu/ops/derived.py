@@ -72,6 +72,15 @@ def strip_prefix(prefix: str) -> Callable[[Any], Any]:
     return fn
 
 
+def builtin_unary(name: str) -> Callable[[Any], Any]:
+    """Host image of a unary Rego builtin (compile.py _BUILTIN_DERIVED,
+    e.g. to_number — vendor/.../opa/topdown/casts.go). Raising -> UNDEF
+    via materialize()'s exception guard."""
+    from ..rego.builtins import BUILTINS
+
+    return BUILTINS[(name,)]
+
+
 class DerivedTables:
     """Per-driver cache of derived columns over the shared vocab."""
 
@@ -132,7 +141,14 @@ class DerivedTables:
                         num[j] = 1.0 if r else 0.0
                     elif isinstance(r, (int, float)):
                         kind[j] = _K_NUM
-                        num[j] = float(r)
+                        # clamp into f32 range rather than letting the cast
+                        # overflow to inf: distinct huge values collapse to
+                        # the same f32 either way (the nid tie-detection in
+                        # evaljax keeps comparisons over-firing), but inf
+                        # would turn device arithmetic into nan (inf - inf)
+                        # which compares false on BOTH interval bounds — an
+                        # under-fire. Clamped values stay nan-free.
+                        num[j] = min(max(float(r), -3.4e38), 3.4e38)
                         nid[j] = self.table.intern(canon_num(r))
                     elif isinstance(r, str):
                         kind[j] = _K_STR

@@ -25,19 +25,20 @@ from jax.experimental.shard_map import shard_map
 
 def make_audit_step(eval_fn, mesh: Mesh):
     """Build the sharded audit step: feats sharded on data, params sharded
-    on model, returns (fires[N, C] fully addressable, counts[C] replicated).
+    on model, derived vocab columns replicated, returns (fires[N, C] fully
+    addressable, counts[C] replicated).
 
-    eval_fn(feats, params, table) -> fires[N_local, C_local] must be pure.
+    eval_fn(feats, params, table, derived) -> fires[N_local, C_local] must
+    be pure.
     """
 
     fspec = lambda a: P("data", *([None] * (a.ndim - 1)))
     pspec = lambda a: P("model", *([None] * (a.ndim - 1)))
 
-    n_data = mesh.shape["data"]
-
-    def step(feats, params, table, n_valid):
-        def local(feats_l, params_l, table_l, n_valid_l):
-            fires = eval_fn(feats_l, params_l, table_l)  # [n_loc, c_loc]
+    def step(feats, params, table, derived, n_valid):
+        def local(feats_l, params_l, table_l, derived_l, n_valid_l):
+            fires = eval_fn(feats_l, params_l, table_l,
+                            derived_l)  # [n_loc, c_loc]
             # mask padding rows: this shard covers global rows
             # [idx*n_loc, (idx+1)*n_loc)
             idx = jax.lax.axis_index("data")
@@ -52,11 +53,15 @@ def make_audit_step(eval_fn, mesh: Mesh):
 
         feats_specs = jax.tree_util.tree_map(fspec, feats)
         params_specs = jax.tree_util.tree_map(pspec, params)
+        # derived columns are vocab-indexed lookup tables — replicated,
+        # like the match table
+        derived_specs = jax.tree_util.tree_map(lambda a: P(), derived)
         return shard_map(
             local, mesh=mesh,
-            in_specs=(feats_specs, params_specs, P(None, None), P()),
+            in_specs=(feats_specs, params_specs, P(None, None),
+                      derived_specs, P()),
             out_specs=(P("data", "model"), P("model")),
             check_rep=False,
-        )(feats, params, table, n_valid)
+        )(feats, params, table, derived, n_valid)
 
     return jax.jit(step)

@@ -113,9 +113,10 @@ def synth_constraints(c: int, seed: int = 1):
 
 
 def build_eval_setup(n_objects: int, n_constraints: int, seed: int = 0,
-                     n_bucket: int | None = None):
-    """-> (driver, compiled_template, feats, params, match_table, reviews,
-    constraints). Device arrays not yet placed."""
+                     n_bucket: int | None = None,
+                     violate_frac: float = 0.01):
+    """-> (driver, compiled_template, feats, params, match_table, derived,
+    reviews, constraints). Device arrays not yet placed."""
     from ..client import Backend
     from ..ir import TpuDriver
     from ..ir.features import extract_batch
@@ -130,7 +131,7 @@ def build_eval_setup(n_objects: int, n_constraints: int, seed: int = 0,
         client.add_constraint(c)
     ct = driver.compiled_for("K8sRequiredLabels")
     assert ct is not None, "flagship template must compile"
-    objects = synth_objects(n_objects, seed=seed)
+    objects = synth_objects(n_objects, violate_frac=violate_frac, seed=seed)
     reviews = [{"kind": {"group": "", "version": "v1", "kind": "Namespace"},
                 "name": o["metadata"]["name"], "object": o}
                for o in objects]
@@ -139,5 +140,9 @@ def build_eval_setup(n_objects: int, n_constraints: int, seed: int = 0,
     cons = driver._constraints("admission.k8s.gatekeeper.sh")
     pd = [(x.get("spec") or {}).get("parameters") or {} for x in cons]
     params = encode_params(ct.program, pd, driver.strtab, driver.match_tables)
+    # derived columns + match table materialize AFTER extraction/encoding
+    # interned this batch's strings (driver._derived_arrays ordering
+    # contract)
+    derived = driver._derived_arrays("K8sRequiredLabels", ct)
     table = driver.match_tables.materialize_packed()
-    return driver, ct, feats, params, table, reviews, cons
+    return driver, ct, feats, params, table, derived, reviews, cons

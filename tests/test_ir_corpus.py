@@ -82,8 +82,28 @@ def harvest_inputs(src: str, test_src: str, pkg: tuple) -> list[dict]:
             continue
         doc = thaw(freeze(v))
         if isinstance(doc, dict) and "review" in doc:
-            docs.append(doc)
+            docs.append(_complete_review(doc))
     return docs
+
+
+def _complete_review(doc: dict) -> dict:
+    """Fill in review.kind from the object's apiVersion/kind when the test
+    fixture omits it. The live system always populates it — the webhook
+    from the AdmissionRequest, the audit path when wrapping Unstructured
+    objects (reference pkg/target/target.go:91-127) — so fixtures relying
+    on input.review.kind (e.g. httpsonly's group/kind guard) only exercise
+    their violating path with it present."""
+    review = doc.get("review")
+    if not isinstance(review, dict) or "kind" in review:
+        return doc
+    obj = review.get("object")
+    if not isinstance(obj, dict) or "kind" not in obj:
+        return doc
+    api = obj.get("apiVersion") or ""
+    group, _, version = api.rpartition("/")
+    review["kind"] = {"group": group, "version": version or api,
+                      "kind": obj["kind"]}
+    return doc
 
 
 def _template_for(dirpath: str) -> tuple[dict, str]:
