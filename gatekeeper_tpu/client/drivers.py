@@ -23,14 +23,17 @@ anywhere. Well-known roots (reference client.go:79-86, 493-511):
 from __future__ import annotations
 
 import json
+import logging
 from typing import Any, Iterable, Optional, Protocol
 
 from ..rego import ast as A
 from ..rego.interp import UNDEF, Interpreter, RegoError
 from ..target.matcher import constraint_matches, needs_autoreject
-from ..utils.values import FrozenDict, freeze, thaw
+from ..utils.values import FrozenDict, freeze, sort_key, thaw
 from .templates import CONSTRAINT_GROUP
 from .types import Response, Result
+
+log = logging.getLogger("gatekeeper_tpu.client.drivers")
 
 
 class DriverError(Exception):
@@ -78,6 +81,15 @@ class RegoDriver:
         self._interp = Interpreter()
         self._module_names: set[str] = set()
         self._trace_sink: Optional[list] = None
+        # per-template codegen'd materializers (rego/codegen.py): None =
+        # outside the compilable subset, fall through to the interpreter
+        self._codegen: dict[tuple, Any] = {}
+        # identity-keyed freeze caches for the audit materialization loop
+        # (consecutive firing pairs share the review; constraints repeat)
+        self._frz_review: tuple = (None, None)
+        self._frz_params: dict[int, tuple] = {}
+        self._frz_inv: tuple = (None, None)
+        self._plain_constraint: dict[int, tuple] = {}
 
     # ------------------------------------------------------------- modules
 
@@ -87,6 +99,7 @@ class RegoDriver:
     def put_module(self, name: str, module: A.Module) -> None:
         self._interp.put_module(name, module)
         self._module_names.add(name)
+        self._codegen.clear()
 
     def put_modules(self, prefix: str, modules: Iterable[A.Module]) -> None:
         # mirror of PutModules upsert semantics (local.go:124-148): existing
@@ -102,12 +115,14 @@ class RegoDriver:
         for name, m in zip(new_names, mods):
             self._interp.put_module(name, m)
             self._module_names.add(name)
+        self._codegen.clear()
 
     def delete_module(self, name: str) -> bool:
         if name not in self._module_names:
             return False
         self._interp.delete_module(name)
         self._module_names.discard(name)
+        self._codegen.clear()
         return True
 
     def delete_modules(self, prefix: str) -> int:
@@ -115,6 +130,7 @@ class RegoDriver:
         for n in doomed:
             self._interp.delete_module(n)
             self._module_names.discard(n)
+        self._codegen.clear()
         return len(doomed)
 
     # ---------------------------------------------------------------- data
@@ -123,11 +139,18 @@ class RegoDriver:
         if not path:
             raise DriverError("cannot put data at the root")
         self._interp.put_data(tuple(path), data)
+        self._frz_params.clear()
+        self._plain_constraint.clear()
+        self._frz_inv = (None, None)
 
     def delete_data(self, path: tuple) -> bool:
         if not path:
             raise DriverError("cannot delete the data root")
-        return self._interp.delete_data(tuple(path))
+        out = self._interp.delete_data(tuple(path))
+        self._frz_params.clear()
+        self._plain_constraint.clear()
+        self._frz_inv = (None, None)
+        return out
 
     def get_data(self, path: tuple) -> Any:
         v = self._interp.get_data(tuple(path))
@@ -210,6 +233,67 @@ class RegoDriver:
                 )
         return results
 
+    def _codegen_for(self, target: str, kind: str):
+        """Per-template codegen'd materializer, or None (interpreter
+        path). Built lazily from the same rewritten modules the
+        interpreter holds, merged into one compile unit."""
+        key = (target, kind)
+        if key in self._codegen:
+            return self._codegen[key]
+        fn = None
+        prefix = f'templates["{target}"]["{kind}"]#'
+        names = sorted(n for n in self._module_names
+                       if n.startswith(prefix))
+        if names:
+            from ..rego.codegen import Unsupported, compile_module
+            # lazy: ir imports this module at load; no cycle at call time
+            from ..ir.driver import merge_template_modules
+            mods = [self._interp.modules[n] for n in names]
+            try:
+                merged = (mods[0] if len(mods) == 1
+                          else merge_template_modules(mods))
+                if merged is not None:
+                    fn = compile_module(merged, entry="violation")
+            except Unsupported as e:
+                log.debug("codegen unsupported for %s: %s", kind, e)
+                fn = None
+        self._codegen[key] = fn
+        return fn
+
+    def _freeze_review(self, review: dict):
+        c = self._frz_review
+        if c[0] is review:
+            return c[1]
+        f = freeze(review)
+        self._frz_review = (review, f)
+        return f
+
+    def _freeze_params(self, constraint: dict, parameters):
+        c = self._frz_params.get(id(constraint))
+        if c is not None and c[0] is constraint:
+            return c[1]
+        f = freeze(parameters)
+        self._frz_params[id(constraint)] = (constraint, f)
+        return f
+
+    def _freeze_inv(self, inventory):
+        c = self._frz_inv
+        if c[0] is inventory:
+            return c[1]
+        f = freeze(inventory)
+        self._frz_inv = (inventory, f)
+        return f
+
+    def _constraint_plain(self, constraint: dict) -> dict:
+        """Result.constraint deep-copy, cached per constraint object (one
+        audit materializes the same constraint thousands of times)."""
+        c = self._plain_constraint.get(id(constraint))
+        if c is not None and c[0] is constraint:
+            return c[1]
+        p = thaw(freeze(constraint))
+        self._plain_constraint[id(constraint)] = (constraint, p)
+        return p
+
     def _eval_template_violations(self, target: str, constraint: dict,
                                   review: dict, enforcement: str,
                                   inventory: Any,
@@ -223,20 +307,44 @@ class RegoDriver:
         parameters = spec.get("parameters")
         if parameters is None:
             parameters = {}
-        inp = {"review": review, "parameters": parameters}
-        try:
-            out = self._interp.eval_rule(
-                pkg, "violation", inp, overrides={("inventory",): inventory}
-            )
-        except RegoError as e:
-            raise DriverError(
-                f"evaluating {kind} violation: {e}"
-            ) from e
+        out = _MISSING_OUT = object()
+        fn = self._codegen_for(target, kind) if trace is None else None
+        if fn is not None:
+            finp = FrozenDict((
+                ("review", self._freeze_review(review)),
+                ("parameters", self._freeze_params(constraint, parameters)),
+            ))
+            try:
+                out = fn(finp, self._freeze_inv(inventory))
+            except RegoError as e:
+                raise DriverError(
+                    f"evaluating {kind} violation: {e}"
+                ) from e
+            except Exception as e:
+                # a codegen bug must be visible, never silent, and must
+                # not take the request down: log + permanent fallback
+                log.warning("codegen evaluator for %s failed (%s: %s); "
+                            "falling back to the interpreter",
+                            kind, type(e).__name__, e)
+                self._codegen[(target, kind)] = None
+                out = _MISSING_OUT
+        if out is _MISSING_OUT:
+            inp = {"review": review, "parameters": parameters}
+            try:
+                out = self._interp.eval_rule(
+                    pkg, "violation", inp,
+                    overrides={("inventory",): inventory}
+                )
+            except RegoError as e:
+                raise DriverError(
+                    f"evaluating {kind} violation: {e}"
+                ) from e
         results = []
         if out is UNDEF:
             return results
-        constraint_plain = thaw(freeze(constraint))
-        for r in sorted(out, key=lambda v: json.dumps(thaw(v), sort_keys=True)):
+        constraint_plain = self._constraint_plain(constraint)
+        ordered = out if len(out) <= 1 else sorted(out, key=sort_key)
+        for r in ordered:
             if not isinstance(r, FrozenDict) or "msg" not in r:
                 raise DriverError(
                     f"template {kind}: violation output must be an object "

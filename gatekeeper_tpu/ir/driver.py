@@ -267,22 +267,25 @@ class TpuDriver(RegoDriver):
         for c in constraints:
             by_kind.setdefault(c.get("kind"), []).append(c)
         results: list[Result] = []
+        sig_cache: dict = {}  # review match-signatures shared across kinds
         for kind in sorted(by_kind):
             cons = by_kind[kind]
             ct = self.compiled_for(kind)
             if ct is None:
                 results.extend(self._audit_interp(target, kind, cons, reviews,
-                                                  lookup_ns, inventory, trace))
+                                                  lookup_ns, inventory, trace,
+                                                  sig_cache))
             else:
                 results.extend(self._audit_compiled(target, kind, ct, cons,
                                                     reviews, lookup_ns,
-                                                    inventory, trace))
+                                                    inventory, trace,
+                                                    sig_cache))
         return results
 
     def _audit_interp(self, target, kind, cons, reviews, lookup_ns,
-                      inventory, trace) -> list[Result]:
+                      inventory, trace, sig_cache=None) -> list[Result]:
         out: list[Result] = []
-        mask = match_masks(cons, reviews, lookup_ns)
+        mask = match_masks(cons, reviews, lookup_ns, sig_cache)
         for r, review in enumerate(reviews):
             for c, constraint in enumerate(cons):
                 if not mask[r, c]:
@@ -295,8 +298,9 @@ class TpuDriver(RegoDriver):
         return out
 
     def _audit_compiled(self, target, kind, ct: CompiledTemplate, cons,
-                        reviews, lookup_ns, inventory, trace) -> list[Result]:
-        mask = match_masks(cons, reviews, lookup_ns)
+                        reviews, lookup_ns, inventory, trace,
+                        sig_cache=None) -> list[Result]:
+        mask = match_masks(cons, reviews, lookup_ns, sig_cache)
         cand = np.flatnonzero(mask.any(axis=1))
         if cand.size == 0:
             return []
@@ -313,7 +317,7 @@ class TpuDriver(RegoDriver):
             self._demote(kind, "audit-eval", e)
             self._compiled[kind] = None
             return self._audit_interp(target, kind, cons, reviews,
-                                      lookup_ns, inventory, trace)
+                                      lookup_ns, inventory, trace, sig_cache)
         hits = np.logical_and(fires, mask[cand])
         out: list[Result] = []
         for ri, ci in zip(*np.nonzero(hits)):
@@ -360,7 +364,9 @@ class TpuDriver(RegoDriver):
                 fcache[feat_key] = feats
         derived = self._derived_arrays(kind, ct)
         table = self.match_tables.materialize_packed()
-        fires = ct.fires(feats, enc, table, derived)
+        # chunked: keeps [N, axes..., C] intermediates bounded on large
+        # audits; falls through to a single dispatch for small batches
+        fires = ct.fires_chunked(feats, enc, table, derived)
         return fires[: len(reviews)]
 
     def _derived_arrays(self, kind: str, ct: CompiledTemplate) -> dict:

@@ -1,0 +1,716 @@
+"""Per-template Python code generation — the host materialization JIT.
+
+The tree-walking interpreter (interp.py) spends ~4-5k function calls per
+violation evaluation on generic unification/backtracking machinery. For the
+audit tail — materializing exact messages for every (object, constraint)
+pair the device filter fired — that generic cost dominates the end-to-end
+wall clock (the reference's analogous cost center is the topdown evaluator
+behind pkg/audit/manager.go:250-271).
+
+This module partially evaluates the interpreter for one template: each rule
+body becomes straight-line Python (nested loops for iteration, `if` chains
+for guards), sharing the interpreter's value model (frozen values from
+utils/values.py), its builtins (builtins.py — identical sprintf/number
+formatting), and its undefined semantics (an UNDEF sentinel threaded
+through helper calls). Outputs are therefore bit-identical to the
+interpreter's wherever compilation succeeds; anything outside the subset
+raises Unsupported at compile time and the caller keeps the interpreter
+path (the same fallback discipline as the device compiler, ir/compile.py).
+
+Differential coverage: tests/test_codegen.py runs every reference library
+template's harvested corpus through both paths and asserts equality.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from . import ast as A
+from .builtins import BUILTINS, BuiltinError
+from .interp import UNDEF, RegoError, _binop
+from .safety import reorder_module
+from ..utils.values import FrozenDict, rego_eq, sort_key
+
+
+class Unsupported(Exception):
+    pass
+
+
+# ----------------------------------------------------------- runtime helpers
+
+
+def _enum(base):
+    """Value-only _enumerate (interp.py:696): (key, value) children."""
+    if isinstance(base, dict):  # FrozenDict included
+        return base.items()
+    if isinstance(base, tuple):
+        return enumerate(base)
+    if isinstance(base, frozenset):
+        return ((m, m) for m in sorted(base, key=sort_key))
+    return ()
+
+
+def _stepv(base, key):
+    """Value-only _step (interp.py:743) with UNDEF propagation."""
+    if isinstance(base, dict):
+        v = base.get(key, UNDEF)
+        return v
+    if isinstance(base, tuple):
+        if isinstance(key, bool) or not isinstance(key, int):
+            return UNDEF
+        if 0 <= key < len(base):
+            return base[key]
+        return UNDEF
+    if isinstance(base, frozenset):
+        return key if key in base else UNDEF
+    return UNDEF
+
+
+def _call(fn, *args):
+    """Builtin call: undefined args / builtin errors -> undefined
+    (mirrors _iter_call's except clauses, interp.py:822-830)."""
+    for a in args:
+        if a is UNDEF:
+            return UNDEF
+    try:
+        return fn(*args)
+    except BuiltinError:
+        return UNDEF
+    except (TypeError, ValueError, KeyError, ZeroDivisionError):
+        return UNDEF
+
+
+def _callu(fn, J, *args):
+    """User-function call with undefined-argument propagation."""
+    for a in args:
+        if a is UNDEF:
+            return UNDEF
+    return fn(J, *args)
+
+
+def _bin(op, a, b):
+    if a is UNDEF or b is UNDEF:
+        return UNDEF
+    return _binop(op, a, b)
+
+
+def _neg(a):
+    if isinstance(a, (int, float)) and not isinstance(a, bool):
+        return -a
+    return UNDEF
+
+
+def _arr(*xs):
+    for x in xs:
+        if x is UNDEF:
+            return UNDEF
+    return xs
+
+
+def _setl(*xs):
+    for x in xs:
+        if x is UNDEF:
+            return UNDEF
+    return frozenset(xs)
+
+
+def _obj(*kv):
+    for x in kv:
+        if x is UNDEF:
+            return UNDEF
+    return FrozenDict(zip(kv[0::2], kv[1::2]))
+
+
+# ----------------------------------------------------------------- compiler
+
+
+class _NotDeterministic(Exception):
+    """Internal: term needs loop emission (unbound ref args)."""
+
+
+class _Emit:
+    def __init__(self):
+        self.lines: list[str] = []
+        self._n = 0
+
+    def w(self, ind: int, s: str) -> None:
+        self.lines.append("    " * ind + s)
+
+    def tmp(self) -> str:
+        self._n += 1
+        return f"_t{self._n}"
+
+    def src(self) -> str:
+        return "\n".join(self.lines)
+
+
+class _Scope:
+    """Static var -> python-name map; mirrors the runtime env exactly
+    because literals are compiled in the safety-reordered evaluation
+    order the interpreter uses."""
+
+    def __init__(self, names: Optional[dict] = None):
+        self.names = dict(names or {})
+        self.fresh: set[str] = set()
+
+    def child(self) -> "_Scope":
+        c = _Scope(self.names)
+        c.fresh = set(self.fresh)
+        return c
+
+    def bound(self, name: str) -> bool:
+        return name in self.names
+
+
+class ModuleCompiler:
+    def __init__(self, module: A.Module):
+        module = reorder_module(module)
+        self.module = module
+        self.rules: dict[str, list[A.Rule]] = {}
+        for r in module.rules:
+            self.rules.setdefault(r.name, []).append(r)
+        self.em = _Emit()
+        self.builtin_bindings: dict[tuple, str] = {}
+        self._pat_n = 0
+
+    # ------------------------------------------------------------- naming
+
+    def _py(self, scope: _Scope, name: str) -> str:
+        pn = "v_" + name.replace("$", "_w_")
+        scope.names[name] = pn
+        scope.fresh.discard(name)
+        return pn
+
+    def _builtin(self, fn: tuple) -> str:
+        b = self.builtin_bindings.get(fn)
+        if b is None:
+            b = "_b" + str(len(self.builtin_bindings))
+            self.builtin_bindings[fn] = b
+        return b
+
+    # -------------------------------------------------------- deterministic
+
+    def value(self, t, scope: _Scope, ind: int) -> str:
+        """Python expression for a single-valued term; may pre-emit
+        statements (comprehensions). Raises _NotDeterministic when the
+        term iterates (unbound ref brackets)."""
+        if isinstance(t, A.Scalar):
+            return repr(t.value)
+        if isinstance(t, A.Var):
+            return self._var_value(t.name, scope)
+        if isinstance(t, A.Ref):
+            return self._ref_value(t, scope, ind)
+        if isinstance(t, A.Call):
+            return self._call_value(t, scope, ind)
+        if isinstance(t, A.BinOp):
+            a = self.value(t.lhs, scope, ind)
+            b = self.value(t.rhs, scope, ind)
+            return f"_bin({t.op!r}, {a}, {b})"
+        if isinstance(t, A.UnaryMinus):
+            return f"_neg({self.value(t.term, scope, ind)})"
+        if isinstance(t, A.ArrayLit):
+            items = [self.value(x, scope, ind) for x in t.items]
+            return f"_arr({', '.join(items)})"
+        if isinstance(t, A.SetLit):
+            items = [self.value(x, scope, ind) for x in t.items]
+            return f"_setl({', '.join(items)})"
+        if isinstance(t, A.ObjectLit):
+            kv = []
+            for k, v in t.items:
+                kv.append(self.value(k, scope, ind))
+                kv.append(self.value(v, scope, ind))
+            return f"_obj({', '.join(kv)})"
+        if isinstance(t, (A.SetCompr, A.ArrayCompr, A.ObjectCompr)):
+            return self._compr(t, scope, ind)
+        raise Unsupported(f"term {type(t).__name__}")
+
+    def _var_value(self, name: str, scope: _Scope) -> str:
+        if scope.bound(name):
+            return scope.names[name]
+        if name == "input":
+            return "_J['input']"
+        if name == "data":
+            raise Unsupported("bare data reference")
+        rules = self.rules.get(name)
+        if rules:
+            if rules[0].kind == "function":
+                raise Unsupported(f"function {name} in value position")
+            return f"rule_{name}(_J)"
+        if name.startswith("$wc") or name in scope.fresh:
+            raise _NotDeterministic()
+        raise Unsupported(f"unbound var {name} in value position")
+
+    def _ref_value(self, t: A.Ref, scope: _Scope, ind: int) -> str:
+        args = list(t.args)
+        if isinstance(t.base, A.Var) and t.base.name == "data" and \
+                not scope.bound("data"):
+            if args and isinstance(args[0], A.Scalar) and \
+                    args[0].value == "inventory":
+                base = "_J['inv']"
+                args = args[1:]
+            else:
+                raise Unsupported("data reference beyond inventory")
+        else:
+            base = self.value(t.base, scope, ind)
+        for a in args:
+            if isinstance(a, A.Var) and not scope.bound(a.name) and \
+                    a.name not in ("input", "data"):
+                raise _NotDeterministic()
+            if self._is_static_pattern(a, scope):
+                raise _NotDeterministic()
+            base = f"_stepv({base}, {self.value(a, scope, ind)})"
+        return base
+
+    def _call_value(self, t: A.Call, scope: _Scope, ind: int) -> str:
+        fn = tuple(t.fn)
+        args = [self.value(a, scope, ind) for a in t.args]
+        if len(fn) == 1 and fn[0] in self.rules:
+            rules = self.rules[fn[0]]
+            if rules[0].kind != "function":
+                raise Unsupported(f"{fn[0]} is not a function")
+            return f"_callu(fn_{fn[0]}, _J, {', '.join(args)})"
+        if fn[0] == "data":
+            raise Unsupported(f"data function call {fn}")
+        if fn not in BUILTINS:
+            raise Unsupported(f"unknown function {'.'.join(fn)}")
+        b = self._builtin(fn)
+        return f"_call({b}, {', '.join(args)})"
+
+    def _compr(self, t, scope: _Scope, ind: int) -> str:
+        acc = self.em.tmp()
+        sub = scope.child()
+        if isinstance(t, A.ObjectCompr):
+            self.em.w(ind, f"{acc} = {{}}")
+
+            def done(i):
+                def kcont(j, kname):
+                    def vcont(l, vname):
+                        self.em.w(l, f"if {kname} in {acc} and not rego_eq("
+                                     f"{acc}[{kname}], {vname}):")
+                        self.em.w(l + 1,
+                                  "raise RegoError('object comprehension: "
+                                  "conflicting key')")
+                        self.em.w(l, f"{acc}[{kname}] = {vname}")
+                    self.iter_emit(t.value, sub, j, vcont)
+                self.iter_emit(t.key, sub, i, kcont)
+            self.solve(t.body, 0, sub, ind, done)
+            out = self.em.tmp()
+            self.em.w(ind, f"{out} = FrozenDict({acc})")
+            return out
+        ctor = "frozenset" if isinstance(t, A.SetCompr) else "tuple"
+        self.em.w(ind, f"{acc} = []" if ctor == "tuple" else f"{acc} = set()")
+        add = f"{acc}.append" if ctor == "tuple" else f"{acc}.add"
+
+        def done2(i):
+            self.iter_emit(t.head, sub, i,
+                           lambda j, v: self.em.w(j, f"{add}({v})"))
+        self.solve(t.body, 0, sub, ind, done2)
+        out = self.em.tmp()
+        self.em.w(ind, f"{out} = {ctor}({acc})")
+        return out
+
+    # ---------------------------------------------------------- iteration
+
+    def iter_emit(self, t, scope: _Scope, ind: int,
+                  cont: Callable[[int, str], None]) -> None:
+        """Emit code yielding each value of term t; cont(ind, pyname) emits
+        the per-value continuation. Values passed to cont are never UNDEF
+        (mirrors _iter_term: undefined terms yield nothing)."""
+        try:
+            expr = self.value(t, scope, ind)
+        except _NotDeterministic:
+            self._iter_structural(t, scope, ind, cont)
+            return
+        v = self.em.tmp()
+        self.em.w(ind, f"{v} = {expr}")
+        if isinstance(t, A.Scalar):
+            cont(ind, v)
+            return
+        self.em.w(ind, f"if {v} is not UNDEF:")
+        cont(ind + 1, v)
+
+    def _iter_structural(self, t, scope: _Scope, ind: int, cont) -> None:
+        if isinstance(t, A.Ref):
+            self._iter_ref(t, scope, ind, cont)
+            return
+        if isinstance(t, A.Call):
+            self._iter_args(list(t.args), [], scope, ind,
+                            lambda i, names: self._finish_call(
+                                t, names, scope, i, cont))
+            return
+        if isinstance(t, A.BinOp):
+            def fin(i, names):
+                v = self.em.tmp()
+                self.em.w(i, f"{v} = _bin({t.op!r}, {names[0]}, {names[1]})")
+                self.em.w(i, f"if {v} is not UNDEF:")
+                cont(i + 1, v)
+            self._iter_args([t.lhs, t.rhs], [], scope, ind, fin)
+            return
+        if isinstance(t, (A.ArrayLit, A.SetLit)):
+            ctor = "_arr" if isinstance(t, A.ArrayLit) else "_setl"
+
+            def fin2(i, names):
+                v = self.em.tmp()
+                self.em.w(i, f"{v} = {ctor}({', '.join(names)})")
+                cont(i, v)
+            self._iter_args(list(t.items), [], scope, ind, fin2)
+            return
+        if isinstance(t, A.ObjectLit):
+            terms = [k for k, _ in t.items] + [v for _, v in t.items]
+
+            def fin3(i, names):
+                n = len(t.items)
+                kv = []
+                for j in range(n):
+                    kv.append(names[j])
+                    kv.append(names[n + j])
+                v = self.em.tmp()
+                self.em.w(i, f"{v} = _obj({', '.join(kv)})")
+                cont(i, v)
+            self._iter_args(terms, [], scope, ind, fin3)
+            return
+        raise Unsupported(f"iterating term {type(t).__name__}")
+
+    def _finish_call(self, t: A.Call, argnames, scope, ind, cont):
+        fn = tuple(t.fn)
+        if len(fn) == 1 and fn[0] in self.rules:
+            if self.rules[fn[0]][0].kind != "function":
+                raise Unsupported(f"{fn[0]} is not a function")
+            expr = f"_callu(fn_{fn[0]}, _J, {', '.join(argnames)})"
+        elif fn in BUILTINS:
+            expr = f"_call({self._builtin(fn)}, {', '.join(argnames)})"
+        else:
+            raise Unsupported(f"unknown function {'.'.join(fn)}")
+        v = self.em.tmp()
+        self.em.w(ind, f"{v} = {expr}")
+        self.em.w(ind, f"if {v} is not UNDEF:")
+        cont(ind + 1, v)
+
+    def _iter_args(self, terms, names, scope, ind, fin) -> None:
+        """Cross-product iteration of argument terms (interp _iter_product)."""
+        if not terms:
+            fin(ind, names)
+            return
+        self.iter_emit(terms[0], scope, ind,
+                       lambda i, v: self._iter_args(
+                           terms[1:], names + [v], scope, i, fin))
+
+    def _iter_ref(self, t: A.Ref, scope: _Scope, ind: int, cont) -> None:
+        args = list(t.args)
+        if isinstance(t.base, A.Var) and t.base.name == "data" and \
+                not scope.bound("data"):
+            if args and isinstance(args[0], A.Scalar) and \
+                    args[0].value == "inventory":
+                base = self.em.tmp()
+                self.em.w(ind, f"{base} = _J['inv']")
+                self._walk(base, args[1:], scope, ind, cont)
+                return
+            raise Unsupported("data reference beyond inventory")
+        self.iter_emit(t.base, scope, ind,
+                       lambda i, b: self._walk(b, args, scope, i, cont))
+
+    def _walk(self, base: str, args, scope: _Scope, ind: int, cont) -> None:
+        if not args:
+            cont(ind, base)
+            return
+        a = args[0]
+        unbound_var = (isinstance(a, A.Var)
+                       and not scope.bound(a.name)
+                       and a.name not in ("input", "data"))
+        if unbound_var:
+            k = self.em.tmp()
+            v = self.em.tmp()
+            self.em.w(ind, f"for {k}, {v} in _enum({base}):")
+            sub_ind = ind + 1
+            if not a.name.startswith("$wc"):
+                pn = self._py(scope, a.name)
+                self.em.w(sub_ind, f"{pn} = {k}")
+            self._walk(v, args[1:], scope, sub_ind, cont)
+            return
+        if self._is_static_pattern(a, scope):
+            k = self.em.tmp()
+            v = self.em.tmp()
+            self.em.w(ind, f"for {k}, {v} in _enum({base}):")
+            self.pattern(a, k, scope, ind + 1,
+                         lambda i: self._walk(v, args[1:], scope, i, cont))
+            return
+        key = self.value(a, scope, ind)
+        nxt = self.em.tmp()
+        self.em.w(ind, f"{nxt} = _stepv({base}, {key})")
+        self.em.w(ind, f"if {nxt} is not UNDEF:")
+        self._walk(nxt, args[1:], scope, ind + 1, cont)
+
+    # ------------------------------------------------------------ patterns
+
+    def _is_static_pattern(self, t, scope: _Scope) -> bool:
+        """Static mirror of interp._is_pattern over the tracked scope."""
+        if isinstance(t, A.Var):
+            if t.name in ("input", "data") and not scope.bound(t.name):
+                return False
+            return not scope.bound(t.name)
+        if isinstance(t, A.ArrayLit):
+            return any(self._is_static_pattern(x, scope) for x in t.items)
+        if isinstance(t, A.ObjectLit):
+            return any(self._is_static_pattern(v, scope)
+                       for _, v in t.items)
+        return False
+
+    def pattern(self, t, val: str, scope: _Scope, ind: int, cont) -> None:
+        """Emit unification of pattern t against value `val`
+        (mirrors _unify_pattern, interp.py:487)."""
+        if isinstance(t, A.Var):
+            if not scope.bound(t.name):
+                if t.name.startswith("$wc"):
+                    cont(ind)
+                    return
+                pn = self._py(scope, t.name)
+                self.em.w(ind, f"{pn} = {val}")
+                cont(ind)
+                return
+            self.em.w(ind, f"if rego_eq({scope.names[t.name]}, {val}):")
+            cont(ind + 1)
+            return
+        if isinstance(t, A.ArrayLit):
+            n = len(t.items)
+            self.em.w(ind, f"if isinstance({val}, tuple) and "
+                           f"len({val}) == {n}:")
+            def chain(i, idx):
+                if idx == n:
+                    cont(i)
+                    return
+                el = self.em.tmp()
+                self.em.w(i, f"{el} = {val}[{idx}]")
+                self.pattern(t.items[idx], el, scope, i,
+                             lambda j: chain(j, idx + 1))
+            chain(ind + 1, 0)
+            return
+        if isinstance(t, A.ObjectLit):
+            n = len(t.items)
+            self.em.w(ind, f"if isinstance({val}, FrozenDict) and "
+                           f"len({val}) == {n}:")
+            items = list(t.items)
+
+            def ochain(i, idx):
+                if idx == n:
+                    cont(i)
+                    return
+                k_t, v_t = items[idx]
+                kx = self.value(k_t, scope, i)
+                kv = self.em.tmp()
+                self.em.w(i, f"{kv} = {kx}")
+                self.em.w(i, f"if {kv} in {val}:")
+                el = self.em.tmp()
+                self.em.w(i + 1, f"{el} = {val}[{kv}]")
+                self.pattern(v_t, el, scope, i + 1,
+                             lambda j: ochain(j, idx + 1))
+            ochain(ind + 1, 0)
+            return
+        # ground term: compare (final case of _unify_pattern)
+        expr = self.value(t, scope, ind)
+        self.em.w(ind, f"if rego_eq({expr}, {val}):")
+        cont(ind + 1)
+
+    # ------------------------------------------------------------- literals
+
+    def solve(self, lits, i: int, scope: _Scope, ind: int, done) -> None:
+        """Emit body literals [i:], then done(ind) at full success."""
+        if i == len(lits):
+            done(ind)
+            return
+        lit = lits[i]
+        nxt = lambda j: self.solve(lits, i + 1, scope, j, done)
+        if lit.withs:
+            raise Unsupported("with modifier")
+        expr = lit.expr
+        if lit.negated:
+            self._emit_negation(expr, scope, ind, nxt)
+            return
+        if isinstance(expr, A.SomeDecl):
+            for n in expr.names:
+                scope.fresh.add(n)
+                scope.names.pop(n, None)
+            nxt(ind)
+            return
+        if isinstance(expr, (A.Assign, A.Unify)):
+            self._emit_unify(expr, scope, ind, nxt)
+            return
+        # plain expression literal: succeeds per non-false value
+        self.iter_emit(expr, scope, ind, lambda j, v: (
+            self.em.w(j, f"if {v} is not False:"), nxt(j + 1)))
+
+    def _emit_negation(self, expr, scope: _Scope, ind: int, nxt) -> None:
+        fn = self.em.tmp()
+        self.em.w(ind, f"def _ng{fn}():")
+        sub = scope.child()
+        body_ind = ind + 1
+        wrote = len(self.em.lines)
+        if isinstance(expr, (A.Assign, A.Unify)):
+            # expression position: unify success -> exists
+            self._emit_unify(expr, sub, body_ind,
+                             lambda j: self.em.w(j, "return True"))
+        else:
+            self.iter_emit(expr, sub, body_ind, lambda j, v: (
+                self.em.w(j, f"if {v} is not False:"),
+                self.em.w(j + 1, "return True")))
+        if len(self.em.lines) == wrote:
+            self.em.w(body_ind, "pass")
+        self.em.w(body_ind, "return False")
+        self.em.w(ind, f"if not _ng{fn}():")
+        nxt(ind + 1)
+
+    def _emit_unify(self, expr, scope: _Scope, ind: int, nxt) -> None:
+        assign = isinstance(expr, A.Assign)
+        lhs, rhs = expr.lhs, expr.rhs
+        lp = assign or self._is_static_pattern(lhs, scope)
+        rp = (not assign) and self._is_static_pattern(rhs, scope)
+        if lp and rp:
+            raise Unsupported("unifying two non-ground terms")
+        if lp:
+            self.iter_emit(rhs, scope, ind, lambda i, v:
+                           self.pattern(lhs, v, scope, i, nxt))
+            return
+        if rp:
+            self.iter_emit(lhs, scope, ind, lambda i, v:
+                           self.pattern(rhs, v, scope, i, nxt))
+            return
+        def both(i, a):
+            self.iter_emit(rhs, scope, i, lambda j, b: (
+                self.em.w(j, f"if rego_eq({a}, {b}):"), nxt(j + 1)))
+        self.iter_emit(lhs, scope, ind, both)
+
+    # --------------------------------------------------------------- rules
+
+    def _emit_rule(self, name: str) -> None:
+        rules = self.rules[name]
+        kind = rules[0].kind
+        if kind == "function":
+            self._emit_function(name, rules)
+            return
+        self.em.w(0, f"def rule_{name}(_J):")
+        self.em.w(1, "_m = _J['memo']")
+        self.em.w(1, f"if {name!r} in _m: return _m[{name!r}]")
+        if kind == "complete":
+            self.em.w(1, "_outs = []")
+            default_expr = "UNDEF"
+            for r in rules:
+                scope = _Scope()
+                if r.is_default:
+                    default_expr = self.value(
+                        r.value if r.value is not None else A.Scalar(True),
+                        scope, 1)
+                    continue
+                val_t = r.value if r.value is not None else A.Scalar(True)
+
+                def acc(i, v):
+                    self.em.w(i, f"if not any(rego_eq({v}, _o) "
+                                 f"for _o in _outs): _outs.append({v})")
+                self.solve(r.body, 0, scope, 1,
+                           lambda i, _v=val_t, _s=scope: self.iter_emit(
+                               _v, _s, i, acc))
+            self.em.w(1, "if len(_outs) > 1: raise RegoError("
+                         f"'complete rule {name}: multiple outputs')")
+            self.em.w(1, f"_r = _outs[0] if _outs else {default_expr}")
+        elif kind == "partial_set":
+            self.em.w(1, "_acc = set()")
+            for r in rules:
+                scope = _Scope()
+                self.solve(r.body, 0, scope, 1,
+                           lambda i, _k=r.key, _s=scope: self.iter_emit(
+                               _k, _s, i,
+                               lambda j, v: self.em.w(j, f"_acc.add({v})")))
+            self.em.w(1, "_r = frozenset(_acc)")
+        elif kind == "partial_object":
+            self.em.w(1, "_accd = {}")
+            for r in rules:
+                scope = _Scope()
+
+                def put(i, _r=r, _s=None):
+                    s = _s
+
+                    def kcont(j, kv):
+                        def vcont(l, vv):
+                            self.em.w(l, f"if {kv} in _accd and not "
+                                         f"rego_eq(_accd[{kv}], {vv}):")
+                            self.em.w(l + 1, "raise RegoError("
+                                      f"'object rule {name}: conflict')")
+                            self.em.w(l, f"_accd[{kv}] = {vv}")
+                        self.iter_emit(_r.value, s, j, vcont)
+                    self.iter_emit(_r.key, s, i, kcont)
+                self.solve(r.body, 0, scope, 1,
+                           lambda i, _r=r, _s=scope: put(i, _r, _s))
+            self.em.w(1, "_r = FrozenDict(_accd)")
+        else:
+            raise Unsupported(f"rule kind {kind}")
+        self.em.w(1, f"_m[{name!r}] = _r")
+        self.em.w(1, "return _r")
+        self.em.w(0, "")
+
+    def _emit_function(self, name: str, rules) -> None:
+        arity = len(rules[0].args)
+        formals = [f"_a{i}" for i in range(arity)]
+        self.em.w(0, f"def fn_{name}(_J, {', '.join(formals)}):")
+        self.em.w(1, "_outs = []")
+        for r in rules:
+            if len(r.args) != arity:
+                raise Unsupported(f"function {name}: mixed arity")
+            scope = _Scope()
+            val_t = r.value if r.value is not None else A.Scalar(True)
+
+            def acc(i, v):
+                self.em.w(i, f"if not any(rego_eq({v}, _o) "
+                             f"for _o in _outs): _outs.append({v})")
+
+            def body(i, _r=r, _s=scope, _v=val_t):
+                self.solve(_r.body, 0, _s, i,
+                           lambda j: self.iter_emit(_v, _s, j, acc))
+
+            def chain(i, idx, _r=r, _s=scope, _body=body):
+                if idx == arity:
+                    _body(i)
+                    return
+                self.pattern(_r.args[idx], formals[idx], _s, i,
+                             lambda j: chain(j, idx + 1, _r, _s, _body))
+            chain(1, 0)
+        self.em.w(1, f"if len(_outs) > 1: raise RegoError("
+                     f"'function {name}: conflicting outputs')")
+        self.em.w(1, "return _outs[0] if _outs else UNDEF")
+        self.em.w(0, "")
+
+    # ----------------------------------------------------------- top level
+
+    def compile(self, entry: str = "violation") -> Callable[[Any, Any], Any]:
+        if entry not in self.rules:
+            raise Unsupported(f"no {entry} rule")
+        for name in self.rules:
+            self._emit_rule(name)
+        self.em.w(0, "def __evaluate__(_input, _inv):")
+        self.em.w(1, "_J = {'input': _input, 'inv': _inv, 'memo': {}}")
+        if self.rules[entry][0].kind == "function":
+            raise Unsupported(f"{entry} is a function")
+        self.em.w(1, f"return rule_{entry}(_J)")
+
+        params = ["UNDEF", "FrozenDict", "RegoError", "rego_eq", "_enum",
+                  "_stepv", "_call", "_callu", "_bin", "_neg", "_arr",
+                  "_setl", "_obj"]
+        bparams = list(self.builtin_bindings.values())
+        src = (f"def __make__({', '.join(params + bparams)}):\n"
+               + "\n".join("    " + l for l in self.em.lines)
+               + "\n    return __evaluate__\n")
+        g: dict = {}
+        exec(compile(src, f"<codegen:{'.'.join(self.module.package)}>",
+                     "exec"), g)
+        bvals = [BUILTINS[fn] for fn in self.builtin_bindings]
+        fn = g["__make__"](UNDEF, FrozenDict, RegoError, rego_eq, _enum,
+                           _stepv, _call, _callu, _bin, _neg, _arr, _setl,
+                           _obj, *bvals)
+        fn.__source__ = src  # for debugging
+        return fn
+
+
+def compile_module(module: A.Module,
+                   entry: str = "violation") -> Callable[[Any, Any], Any]:
+    """Compile a (merged, single-package) template module to a Python
+    evaluator fn(input_frozen, inventory_frozen) -> frozen document of
+    `entry`. Raises Unsupported when the module falls outside the
+    compilable subset."""
+    return ModuleCompiler(module).compile(entry)

@@ -1,13 +1,27 @@
 """Batched constraint matching: match masks for the audit cross-product.
 
-Computes mask[R, C] (review × constraint) without R×C Python calls: match
-depends only on (group, kind, namespace[, Namespace-object identity]) for
-constraints without label selectors, so reviews are grouped by that
-signature and each (group-signature, constraint) decided once. Only
-label-selector constraints (and Namespace-kind reviews, whose own labels
-feed namespaceSelector) fall back to per-review checks.
+Computes mask[R, C] (review × constraint) without R×C Python calls. The
+match predicate (matcher.py, mirroring pkg/target/regolib/src.rego) reads
+only a small projection of each review:
 
-Semantics delegate to the differentially-tested predicate in matcher.py.
+  * kinds clause            → (kind.group, kind.kind)
+  * namespaces / excluded   → the effective namespace name (get_ns_name)
+  * namespaceSelector       → raw review.namespace (cache lookup key) plus
+                              the object/oldObject label state for
+                              Namespace-kind reviews
+  * labelSelector           → object/oldObject label state
+
+Reviews are therefore grouped ONCE by the full signature of all those
+components; each constraint declares which components it depends on, and
+`constraint_matches` runs once per (constraint, projected signature) —
+for selector-free constraints that is once per (group, kind) in the whole
+cluster. Semantics still delegate to the differentially-tested predicate
+in matcher.py; this module only memoizes it (correctness asserted by the
+brute-force differential in tests/test_target_matcher.py).
+
+Reviews carrying `_unstable` (webhook namespace sideload) fall back to
+per-review evaluation — the sideloaded namespace object is not part of
+the signature.
 """
 
 from __future__ import annotations
@@ -16,53 +30,115 @@ from typing import Optional
 
 import numpy as np
 
-from .matcher import NamespaceLookup, constraint_matches
+from ..utils.values import freeze
+from .matcher import NamespaceLookup, _get_ns_name, _has_field, _MISSING, \
+    constraint_matches
 
 
-def _has_label_selector(constraint: dict) -> bool:
+def _dependence(constraint: dict) -> tuple:
+    """(name_dep, nssel_dep, lblsel_dep) — which signature components the
+    constraint's match clauses read beyond (group, kind)."""
     spec = constraint.get("spec")
     spec = spec if isinstance(spec, dict) else {}
     match = spec.get("match")
     match = match if isinstance(match, dict) else {}
-    return "labelSelector" in match
+    name_dep = _has_field(match, "namespaces") or \
+        _has_field(match, "excludedNamespaces")
+    return (name_dep, "namespaceSelector" in match, "labelSelector" in match)
+
+
+def _label_state(review: dict, field: str):
+    """(is-empty, frozen labels) of review.object/.oldObject — everything
+    _any_labelselector_match can observe."""
+    v = review.get(field)
+    v = v if isinstance(v, dict) else {}
+    if not v:
+        return (True, None)
+    meta = v.get("metadata")
+    labels = meta.get("labels") if isinstance(meta, dict) else None
+    return (False, freeze(labels) if isinstance(labels, dict) else None)
 
 
 def _signature(review: dict) -> Optional[tuple]:
-    """Grouping key, or None if the review needs per-object matching."""
+    """Full match-relevant signature, or None for per-review fallback."""
+    if "_unstable" in review:
+        return None
     kind = review.get("kind")
     kind = kind if isinstance(kind, dict) else {}
-    if kind.get("group", "") in ("", None) and kind.get("kind") == "Namespace":
-        return None  # object labels/name feed the match; keep per-object
-    if "_unstable" in review:
-        return None  # sideloaded namespace object varies per review
-    ns = review.get("namespace") if "namespace" in review else "\x00absent"
-    return (kind.get("group"), kind.get("kind"), ns)
+    eff_ns = _get_ns_name(review)
+    if eff_ns is _MISSING:
+        eff_ns = "\x00missing"
+    return (
+        kind.get("group"), kind.get("kind"),
+        ("namespace" in review, review.get("namespace")),
+        eff_ns,
+        _label_state(review, "object"),
+        _label_state(review, "oldObject"),
+    )
+
+
+def _project(sig: tuple, dep: tuple) -> tuple:
+    name_dep, nssel_dep, lblsel_dep = dep
+    key = (sig[0], sig[1])
+    if name_dep:
+        key += (sig[3],)
+    if nssel_dep:
+        key += (sig[2], sig[4], sig[5])
+    if lblsel_dep:
+        key += (sig[4], sig[5])
+    return key
 
 
 def match_masks(constraints: list[dict], reviews: list[dict],
-                lookup_ns: NamespaceLookup) -> np.ndarray:
+                lookup_ns: NamespaceLookup,
+                sig_cache: Optional[dict] = None) -> np.ndarray:
+    """mask[R, C]. sig_cache (id(review) -> signature) lets one audit
+    reuse signatures across per-kind calls over the same review list."""
     R, C = len(reviews), len(constraints)
     mask = np.zeros((R, C), dtype=bool)
-    label_dep = [_has_label_selector(c) for c in constraints]
 
-    group_cache: dict[tuple, dict[int, bool]] = {}
+    groups: dict[tuple, list[int]] = {}
+    fallback: list[int] = []
     for r, review in enumerate(reviews):
-        sig = _signature(review)
+        if sig_cache is not None:
+            sig = sig_cache.get(id(review), _MISSING)
+            if sig is _MISSING:
+                sig = _signature(review)
+                sig_cache[id(review)] = sig
+        else:
+            sig = _signature(review)
         if sig is None:
-            for c, constraint in enumerate(constraints):
-                mask[r, c] = constraint_matches(constraint, review, lookup_ns)
-            continue
-        cached = group_cache.get(sig)
-        if cached is None:
-            cached = {}
-            group_cache[sig] = cached
-        for c, constraint in enumerate(constraints):
-            if label_dep[c]:
-                mask[r, c] = constraint_matches(constraint, review, lookup_ns)
-                continue
-            hit = cached.get(c)
-            if hit is None:
-                hit = constraint_matches(constraint, review, lookup_ns)
-                cached[c] = hit
-            mask[r, c] = hit
+            fallback.append(r)
+        else:
+            groups.setdefault(sig, []).append(r)
+
+    # constraints bucketed by dependence class (usually 1-2 classes per
+    # audit); the expensive group->projection collapse runs once per class,
+    # NOT once per constraint — selector-free constraints then cost one
+    # matcher call per (group, kind) in the whole cluster
+    classes: dict[tuple, list[int]] = {}
+    for c, constraint in enumerate(constraints):
+        classes.setdefault(_dependence(constraint), []).append(c)
+
+    for dep, cidxs in classes.items():
+        proj: dict[tuple, list] = {}
+        rep: dict[tuple, int] = {}
+        for sig, rows in groups.items():
+            key = _project(sig, dep)
+            bucket = proj.get(key)
+            if bucket is None:
+                proj[key] = list(rows)
+                rep[key] = rows[0]
+            else:
+                bucket.extend(rows)
+        proj_rows = [(np.asarray(rows), reviews[rep[key]])
+                     for key, rows in proj.items()]
+        for c in cidxs:
+            constraint = constraints[c]
+            for rows, review in proj_rows:
+                if constraint_matches(constraint, review, lookup_ns):
+                    mask[rows, c] = True
+            for r in fallback:
+                mask[r, c] = constraint_matches(constraint, reviews[r],
+                                                lookup_ns)
     return mask

@@ -1,0 +1,250 @@
+"""Differential conformance for the codegen materializer (rego/codegen.py).
+
+The generated Python evaluators must be bit-identical to the reference
+interpreter wherever compilation succeeds — they share its value model and
+builtins, so any divergence is a codegen bug. Tier-1 analog of the
+reference's opa-test discipline (SURVEY.md §4), run over the same harvested
+corpus the device-filter conformance uses.
+"""
+
+from __future__ import annotations
+
+import glob
+from pathlib import Path
+
+import pytest
+
+from gatekeeper_tpu.client import Backend, RegoDriver
+from gatekeeper_tpu.rego.codegen import Unsupported, compile_module
+from gatekeeper_tpu.rego.interp import Interpreter, RegoError, UNDEF
+from gatekeeper_tpu.rego.parser import parse_module
+from gatekeeper_tpu.target import K8sValidationTarget
+from gatekeeper_tpu.utils.values import freeze, thaw
+
+from .conftest import REFERENCE, requires_reference
+from .test_ir_corpus import LIB_DIRS, harvest_cases
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+
+@requires_reference
+@pytest.mark.parametrize("dirpath", LIB_DIRS)
+def test_codegen_matches_interpreter_on_reference_corpus(dirpath):
+    src = (REFERENCE / dirpath / "src.rego").read_text()
+    test_src = (REFERENCE / dirpath / "src_test.rego").read_text()
+    module = parse_module(src)
+    fn = compile_module(module)  # all 23 library templates must compile
+    cases = harvest_cases(src, test_src)
+    assert cases
+    interp = Interpreter({"m": module})
+    checked = fired = 0
+    for doc, inv in cases:
+        inv = inv if inv is not None else {}
+        a = fn(freeze(doc), freeze(inv))
+        b = interp.eval_rule(module.package, "violation", doc,
+                             overrides={("inventory",): inv})
+        assert a == b, f"{dirpath}: codegen diverged\n cg: {thaw(a)!r}\n" \
+                       f" in: {thaw(b) if b is not UNDEF else UNDEF!r}"
+        checked += 1
+        if b is not UNDEF and len(b):
+            fired += 1
+    assert checked > 0 and fired > 0, f"{dirpath}: corpus vacuous"
+
+
+def test_driver_uses_codegen_for_library_template():
+    """The wiring, not just the compiler: RegoDriver must route violation
+    materialization through the generated evaluator."""
+    src = (REFERENCE / "library/general/requiredlabels/src.rego").read_text()
+    d = RegoDriver()
+    client = Backend(d).new_client([K8sValidationTarget()])
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8srequiredlabels"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sRequiredLabels"}}},
+            "targets": [{"target": TARGET, "rego": src}],
+        },
+    })
+    assert d._codegen_for(TARGET, "K8sRequiredLabels") is not None
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels", "metadata": {"name": "c"},
+        "spec": {"parameters": {"labels": [{"key": "owner"}]}},
+    })
+    client.add_data({"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": "x"}})
+    msgs = [r.msg for r in client.audit().results()]
+    assert msgs and "owner" in msgs[0]
+
+
+def test_codegen_runtime_failure_falls_back_loudly(caplog):
+    """A generated evaluator that crashes must log, permanently disable
+    itself for the kind, and still answer via the interpreter."""
+    import logging
+
+    src = (REFERENCE / "library/general/requiredlabels/src.rego").read_text()
+    d = RegoDriver()
+    client = Backend(d).new_client([K8sValidationTarget()])
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8srequiredlabels"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sRequiredLabels"}}},
+            "targets": [{"target": TARGET, "rego": src}],
+        },
+    })
+
+    def boom(_inp, _inv):
+        raise IndexError("synthetic codegen bug")
+
+    d._codegen[(TARGET, "K8sRequiredLabels")] = boom
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels", "metadata": {"name": "c"},
+        "spec": {"parameters": {"labels": [{"key": "owner"}]}},
+    })
+    client.add_data({"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": "x"}})
+    with caplog.at_level(logging.WARNING, "gatekeeper_tpu.client.drivers"):
+        msgs = [r.msg for r in client.audit().results()]
+    assert msgs and "owner" in msgs[0]
+    assert any("falling back" in r.message for r in caplog.records)
+    assert d._codegen[(TARGET, "K8sRequiredLabels")] is None
+
+
+# ------------------------------------------------ focused semantics units
+
+
+def _fn(src: str):
+    return compile_module(parse_module(src))
+
+
+def _run(src: str, inp, inv=None):
+    module = parse_module(src)
+    fn = compile_module(module)
+    a = fn(freeze(inp), freeze(inv if inv is not None else {}))
+    interp = Interpreter({"m": module})
+    b = interp.eval_rule(module.package, "violation", inp,
+                         overrides={("inventory",): inv}
+                         if inv is not None else None)
+    assert a == b, f"cg {thaw(a)!r} != in {b!r}"
+    return a
+
+
+def test_negation_scoping_and_wildcards():
+    out = _run("""
+package t
+violation[{"msg": "m"}] {
+  not input.review.object.spec.ok
+  input.review.object.spec.items[_] == "x"
+}
+""", {"review": {"object": {"spec": {"items": ["y", "x"]}}}})
+    assert len(out) == 1
+
+
+def test_function_multiple_defs_and_undefined_args():
+    out = _run("""
+package t
+mode(x) = "big" { x > 10 }
+mode(x) = "small" { x <= 10 }
+violation[{"msg": m}] {
+  m := mode(input.review.object.n)
+}
+""", {"review": {"object": {"n": 3}}})
+    assert thaw(out) == [{"msg": "small"}]
+    # undefined arg -> undefined call -> no violation
+    out = _run("""
+package t
+mode(x) = "big" { x > 10 }
+violation[{"msg": m}] { m := mode(input.review.object.missing) }
+""", {"review": {"object": {}}})
+    assert out == frozenset()
+
+
+def test_complete_rule_default_and_conflict():
+    out = _run("""
+package t
+default level = "none"
+level = "high" { input.review.object.x > 5 }
+violation[{"msg": level}] { level != "none" }
+""", {"review": {"object": {"x": 9}}})
+    assert thaw(out) == [{"msg": "high"}]
+    src = """
+package t
+both = "a" { input.review.object.x > 0 }
+both = "b" { input.review.object.x > 1 }
+violation[{"msg": both}] { true }
+"""
+    fn = _fn(src)
+    with pytest.raises(RegoError):
+        fn(freeze({"review": {"object": {"x": 2}}}), freeze({}))
+
+
+def test_partial_object_rule():
+    out = _run("""
+package t
+sizes[name] = n {
+  c := input.review.object.spec.containers[_]
+  name := c.name
+  n := c.n
+}
+violation[{"msg": name}] {
+  sizes[name] > 2
+}
+""", {"review": {"object": {"spec": {"containers": [
+        {"name": "a", "n": 1}, {"name": "b", "n": 5}]}}}})
+    assert thaw(out) == [{"msg": "b"}]
+
+
+def test_object_comprehension_and_set_ops():
+    out = _run("""
+package t
+violation[{"msg": msg, "details": d}] {
+  provided := {l | input.review.object.metadata.labels[l]}
+  required := {l | l := input.parameters.labels[_]}
+  missing := required - provided
+  count(missing) > 0
+  d := {k: true | k := missing[_]}
+  msg := sprintf("missing: %v", [missing])
+}
+""", {"review": {"object": {"metadata": {"labels": {"a": "1"}}}},
+      "parameters": {"labels": ["a", "b"]}})
+    assert len(out) == 1
+
+
+def test_inventory_access():
+    out = _run("""
+package t
+violation[{"msg": h}] {
+  other := data.inventory.cluster["v1"]["Svc"][name]
+  h := other.host
+  h == input.review.object.host
+}
+""", {"review": {"object": {"host": "x.example"}}},
+        inv={"cluster": {"v1": {"Svc": {"s1": {"host": "x.example"},
+                                        "s2": {"host": "y.example"}}}}})
+    assert thaw(out) == [{"msg": "x.example"}]
+
+
+def test_with_modifier_is_unsupported():
+    with pytest.raises(Unsupported):
+        _fn("""
+package t
+helper = x { x := input.a }
+violation[{"msg": "m"}] { helper with input as {"a": 1} }
+""")
+
+
+def test_array_destructure_and_arith():
+    out = _run("""
+package t
+violation[{"msg": msg}] {
+  [cpu, mem] := input.review.object.pair
+  total := cpu + mem * 2
+  total > 10
+  msg := sprintf("%v", [total])
+}
+""", {"review": {"object": {"pair": [3, 4]}}})
+    assert thaw(out) == [{"msg": "11"}]

@@ -14,6 +14,7 @@ tier 1 — the same suites the interpreter conformance tests run).
 
 from __future__ import annotations
 
+import functools
 import glob
 from dataclasses import replace as dc_replace
 from pathlib import Path
@@ -48,8 +49,19 @@ def _kind_for(pkg_name: str) -> str:
     return "T" + pkg_name.capitalize()
 
 
-def harvest_inputs(src: str, test_src: str, pkg: tuple) -> list[dict]:
-    """Evaluate each test rule's `... with input as X` document."""
+def harvest_inputs(src: str, test_src: str, pkg: tuple = None) -> list[dict]:
+    """Evaluate each test rule's `... with input as X` document.
+    Cached per (src, test_src): several suites replay the same corpus."""
+    return [doc for doc, _ in _harvest_cached(src, test_src)]
+
+
+def harvest_cases(src: str, test_src: str) -> list[tuple[dict, dict]]:
+    """(input document, data.inventory with-value or None) pairs."""
+    return list(_harvest_cached(src, test_src))
+
+
+@functools.lru_cache(maxsize=64)
+def _harvest_cached(src: str, test_src: str) -> tuple:
     src_mod = parse_module(src)
     test_mod = parse_module(test_src)
     docs = []
@@ -60,14 +72,22 @@ def harvest_inputs(src: str, test_src: str, pkg: tuple) -> list[dict]:
             continue
         for i, lit in enumerate(r.body):
             wv = None
+            iv = None
             for w in lit.withs:
                 if tuple(w.target) == ("input",):
                     wv = w.value
+                elif tuple(w.target) == ("data", "inventory"):
+                    iv = w.value
             if wv is None:
                 continue
             n += 1
+            head = A.ObjectLit((
+                (A.Scalar("input"), wv),
+                (A.Scalar("inventory"),
+                 iv if iv is not None else A.Scalar(None)),
+            ))
             harvest_rules.append(A.Rule(
-                name=f"__harvest_{n}", kind="complete", value=wv,
+                name=f"__harvest_{n}", kind="complete", value=head,
                 body=tuple(dc_replace(l, withs=()) for l in r.body[:i]),
             ))
             break
@@ -80,10 +100,11 @@ def harvest_inputs(src: str, test_src: str, pkg: tuple) -> list[dict]:
             continue
         if v is UNDEF:
             continue
-        doc = thaw(freeze(v))
+        case = thaw(freeze(v))
+        doc = case.get("input")
         if isinstance(doc, dict) and "review" in doc:
-            docs.append(_complete_review(doc))
-    return docs
+            docs.append((_complete_review(doc), case.get("inventory")))
+    return tuple(docs)
 
 
 def _complete_review(doc: dict) -> dict:

@@ -208,3 +208,32 @@ def test_native_autoreject_agrees_with_reference_rego():
                 (rname, sorted(rego_rejected ^ native_rejected))
             )
     assert not mismatches, f"autoreject disagreements: {mismatches}"
+
+
+def test_match_masks_equals_bruteforce_grid():
+    """The grouped/memoized batch matcher (target/batch.py) must agree
+    cell-for-cell with per-pair constraint_matches over the full edge-case
+    grid — including _unstable sideloads and Namespace-kind reviews."""
+    import numpy as np
+
+    from gatekeeper_tpu.target.batch import match_masks
+
+    cons = list(_constraints().values())
+    reviews = list(_reviews().values())
+
+    def lookup(name):
+        return NS_OBJECTS.get(name)
+
+    want = np.zeros((len(reviews), len(cons)), dtype=bool)
+    for r, review in enumerate(reviews):
+        for c, constraint in enumerate(cons):
+            want[r, c] = constraint_matches(constraint, review, lookup)
+
+    got = match_masks(cons, reviews, lookup)
+    assert (got == want).all(), np.argwhere(got != want)[:10]
+
+    # shared signature cache across calls (the per-kind audit loop)
+    cache: dict = {}
+    got1 = match_masks(cons[:5], reviews, lookup, cache)
+    got2 = match_masks(cons[5:], reviews, lookup, cache)
+    assert (np.concatenate([got1, got2], axis=1) == want).all()
