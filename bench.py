@@ -5,11 +5,13 @@ Workload: 500 K8sRequiredLabels constraints × 100k namespace objects — the
 throughput path the reference evaluates one object at a time through the
 interpreted Rego engine (pkg/audit/manager.go:250-271 → topdown eval).
 
-Headline metric: end-to-end audit wall-clock in the steady state (the
-recurring --audit-interval sweep of a resident engine): constraint
-matching + device filter sweep + exact host materialization of every
-firing pair's messages. Extraction (host JSON → feature tensors) is
-cached across audits and reported separately, as are the phase times.
+Headline metric: wall-clock of one complete `client.audit()` in the steady
+state (the recurring --audit-interval sweep of a resident engine): review
+flattening + constraint matching + device filter sweep (sparse pair
+extraction) + exact host materialization of every firing pair's message.
+Inventory extraction and match-signature caches are warm, exactly as they
+are between sweeps of a resident audit manager; the cold first sweep is
+reported as first_audit_s.
 
 Baseline caveat: vs_baseline compares against this framework's own Python
 reference interpreter (a local-OPA stand-in that passes the reference
@@ -31,63 +33,69 @@ N_OBJECTS = int(os.environ.get("BENCH_OBJECTS", 100_000))
 N_CONSTRAINTS = int(os.environ.get("BENCH_CONSTRAINTS", 500))
 SAMPLE_OBJECTS = int(os.environ.get("BENCH_BASELINE_OBJECTS", 40))
 SAMPLE_CONSTRAINTS = int(os.environ.get("BENCH_BASELINE_CONSTRAINTS", 40))
-CHUNK = int(os.environ.get("BENCH_CHUNK", 8192))
 TARGET = "admission.k8s.gatekeeper.sh"
 
 
 def main() -> None:
     t_setup = time.time()
-    import numpy as np
+    from gatekeeper_tpu.client import Backend
+    from gatekeeper_tpu.ir import TpuDriver
+    from gatekeeper_tpu.parallel.workload import (
+        REQUIRED_LABELS_TEMPLATE, synth_constraints, synth_objects)
+    from gatekeeper_tpu.target import K8sValidationTarget
 
-    from gatekeeper_tpu.parallel.workload import build_eval_setup
-
-    n_bucket = ((N_OBJECTS + CHUNK - 1) // CHUNK) * CHUNK
-    driver, ct, feats, params, table, derived, reviews, cons = \
-        build_eval_setup(N_OBJECTS, N_CONSTRAINTS, n_bucket=n_bucket)
+    driver = TpuDriver()
+    client = Backend(driver).new_client([K8sValidationTarget()])
+    client.add_template(REQUIRED_LABELS_TEMPLATE)
+    for c in synth_constraints(N_CONSTRAINTS, seed=1):
+        client.add_constraint(c)
+    for o in synth_objects(N_OBJECTS, violate_frac=0.01, seed=0):
+        client.add_data(o)
     setup_s = time.time() - t_setup
 
-    import jax
-
-    # features/params live on device (steady state of a resident audit
-    # engine; incremental inventory updates maintain them there)
-    feats = jax.tree_util.tree_map(jax.device_put, feats)
-    params = jax.tree_util.tree_map(jax.device_put, params)
-    table = jax.device_put(table)
-
-    # ---- phase 1: device filter sweep (one real chip) -----------------
+    # ---- full audit through the public client API ---------------------
     t0 = time.time()
-    fires = ct.fires_chunked(feats, params, table, derived, chunk=CHUNK)
-    warm_s = time.time() - t0  # includes jit compile
+    resp = client.audit()
+    first_audit_s = time.time() - t0  # includes jit compile + extraction
     t0 = time.time()
     iters = 3
     for _ in range(iters):
-        fires = ct.fires_chunked(feats, params, table, derived, chunk=CHUNK)
-    sweep_s = (time.time() - t0) / iters
+        resp = client.audit()
+    audit_s = (time.time() - t0) / iters
+    n_results = len(resp.results())
     evals = N_OBJECTS * N_CONSTRAINTS
-    fires = fires[:N_OBJECTS]
-    hits = int(fires.sum())
+    evals_per_sec = evals / audit_s
 
-    # ---- phase 2: constraint matching (host, grouped) -----------------
+    # ---- phase breakdown (same warm caches, driver internals) ---------
+    import numpy as np
+
     from gatekeeper_tpu.target.batch import match_masks
 
+    reviews = driver._inventory_reviews(TARGET)
+    cons = driver._constraints(TARGET)
     lookup_ns = driver._namespace_lookup(TARGET)
+    sig_cache = driver._audit_sig_cache(TARGET)
     t0 = time.time()
-    mask = match_masks(cons, reviews, lookup_ns)
+    mask = match_masks(cons, reviews, lookup_ns, sig_cache)
     match_s = time.time() - t0
-
-    # ---- phase 3: exact message materialization (host JIT) ------------
+    ct = driver.compiled_for("K8sRequiredLabels")
+    cand = np.flatnonzero(mask.any(axis=1))
+    feat_key = (driver._data_gen, hash(cand.tobytes()))
+    cand_reviews = [reviews[int(i)] for i in cand]
+    t0 = time.time()
+    rows, cols = driver.eval_compiled_pairs(ct, "K8sRequiredLabels",
+                                            cand_reviews, cons,
+                                            feat_key=feat_key)
+    sweep_s = time.time() - t0
     inventory = driver._inventory_tree(TARGET)
-    pairs = np.nonzero(np.logical_and(fires, mask))
+    keep = mask[cand[rows], cols]
     t0 = time.time()
     results = []
-    for ri, ci in zip(*pairs):
+    for ri, ci in zip(rows[keep], cols[keep]):
         results.extend(driver._eval_template_violations(
-            TARGET, cons[int(ci)], reviews[int(ri)], "deny", inventory,
+            TARGET, cons[int(ci)], cand_reviews[int(ri)], "deny", inventory,
             None))
     mat_s = time.time() - t0
-
-    audit_s = sweep_s + match_s + mat_s
-    evals_per_sec = evals / audit_s
 
     # ---- interpreter baseline (local-OPA stand-in) --------------------
     from gatekeeper_tpu.client.drivers import RegoDriver
@@ -112,8 +120,8 @@ def main() -> None:
     out = {
         "metric": "full_audit_wall_clock_s",
         "value": round(audit_s, 3),
-        "unit": "s (match + device sweep + exact message materialization; "
-                "500 constraints x 100k objects)",
+        "unit": "s (one client.audit(): match + device sparse sweep + exact "
+                "message materialization; 500 constraints x 100k objects)",
         "vs_baseline": round(base_full_audit_s / audit_s, 1),
         "baseline_note": "baseline is this repo's own Python reference "
                          "interpreter (local-OPA stand-in), subsampled and "
@@ -123,11 +131,11 @@ def main() -> None:
         "match_s": round(match_s, 3),
         "materialize_s": round(mat_s, 3),
         "evals_per_sec_per_chip": round(evals_per_sec),
-        "first_call_s": round(warm_s, 2),
+        "first_audit_s": round(first_audit_s, 2),
         "objects": N_OBJECTS,
         "constraints": N_CONSTRAINTS,
-        "violating_pairs": hits,
-        "violations_materialized": len(results),
+        "violating_pairs": int(keep.sum()),
+        "violations_materialized": n_results,
         "baseline_evals_per_sec": round(base_evals_per_sec),
         "baseline_full_audit_s": round(base_full_audit_s),
         "setup_s": round(setup_s, 1),

@@ -330,8 +330,9 @@ class Client:
                     hook_violation_path(name), {"review": review},
                     tracing=tracing,
                 )
+                memo: dict = {}
                 for r in resp.results:
-                    handler.handle_violation(r)
+                    handler.handle_violation(r, memo)
             except Exception as e:
                 errs[name] = e
                 continue
@@ -352,8 +353,9 @@ class Client:
             try:
                 resp = self.driver.query(hook_audit_path(name), None,
                                          tracing=tracing)
+                memo: dict = {}
                 for r in resp.results:
-                    handler.handle_violation(r)
+                    handler.handle_violation(r, memo)
             except Exception as e:
                 errs[name] = e
                 continue

@@ -90,6 +90,13 @@ class RegoDriver:
         self._frz_params: dict[int, tuple] = {}
         self._frz_inv: tuple = (None, None)
         self._plain_constraint: dict[int, tuple] = {}
+        # steady-state audit caches: flattening 100k inventory objects into
+        # review dicts (and computing their match signatures) each sweep
+        # costs seconds; both are stable until the data tree changes
+        self._data_rev = 0
+        self._inv_reviews_cache: dict[str, tuple] = {}  # target -> (rev, l)
+        self._sig_cache: dict[str, tuple] = {}  # target -> (rev, dict)
+        self._inv_tree_cache: dict[str, tuple] = {}  # target -> (rev, tree)
 
     # ------------------------------------------------------------- modules
 
@@ -142,6 +149,10 @@ class RegoDriver:
         self._frz_params.clear()
         self._plain_constraint.clear()
         self._frz_inv = (None, None)
+        if path[0] != "constraints":
+            # constraint churn leaves the inventory-review/signature/tree
+            # caches valid — only actual inventory writes invalidate them
+            self._data_rev += 1
 
     def delete_data(self, path: tuple) -> bool:
         if not path:
@@ -150,6 +161,8 @@ class RegoDriver:
         self._frz_params.clear()
         self._plain_constraint.clear()
         self._frz_inv = (None, None)
+        if path[0] != "constraints":
+            self._data_rev += 1
         return out
 
     def get_data(self, path: tuple) -> Any:
@@ -390,14 +403,36 @@ class RegoDriver:
         return lookup
 
     def _inventory_tree(self, target: str) -> Any:
+        cached = self._inv_tree_cache.get(target)
+        if cached is not None and cached[0] == self._data_rev:
+            return cached[1]
         v = self._interp.get_data(("external", target))
-        if v is UNDEF:
-            return {}
-        return freeze(_deep_plain(v))
+        tree = {} if v is UNDEF else freeze(_deep_plain(v))
+        self._inv_tree_cache[target] = (self._data_rev, tree)
+        return tree
 
     def _inventory_reviews(self, target: str) -> list[dict]:
         """Flatten the inventory into make_review-shaped dicts
-        (reference regolib src.rego:40-61)."""
+        (reference regolib src.rego:40-61). Cached until the data tree
+        changes — the recurring audit sweep's steady state."""
+        cached = self._inv_reviews_cache.get(target)
+        if cached is not None and cached[0] == self._data_rev:
+            return cached[1]
+        reviews = self._build_inventory_reviews(target)
+        self._inv_reviews_cache[target] = (self._data_rev, reviews)
+        return reviews
+
+    def _audit_sig_cache(self, target: str) -> dict:
+        """Match-signature cache (id(review) -> signature) valid for the
+        cached review list of the current data revision."""
+        cached = self._sig_cache.get(target)
+        if cached is not None and cached[0] == self._data_rev:
+            return cached[1]
+        sigs: dict = {}
+        self._sig_cache[target] = (self._data_rev, sigs)
+        return sigs
+
+    def _build_inventory_reviews(self, target: str) -> list[dict]:
         reviews: list[dict] = []
         root = self._interp.get_data(("external", target))
         if root is UNDEF or not isinstance(root, dict):

@@ -987,7 +987,9 @@ class _ClauseCompiler:
             targs = pattern_t.args
             if len(targs) == 2 and isinstance(targs[1], A.Scalar) and \
                     isinstance(targs[1].value, str):
-                op = f"{op}@{_PATTERN_TRANSFORMS[pattern_t.fn[0]]}:{targs[1].value}"
+                from ..ops.strtab import escape_transform_arg
+                op = (f"{op}@{_PATTERN_TRANSFORMS[pattern_t.fn[0]]}:"
+                      f"{escape_transform_arg(targs[1].value)}")
                 pattern_t = targs[0]
             elif len(targs) == 1:
                 op = f"{op}@{_PATTERN_TRANSFORMS[pattern_t.fn[0]]}:"

@@ -144,6 +144,10 @@ class TpuDriver(RegoDriver):
         # invalidate with the bare kind
         self._param_cache: dict[str, dict] = {}
         self._feat_cache: dict[str, dict] = {}
+        # host ndarray (by identity) -> device buffer: steady-state sweeps
+        # must not re-upload cached tensors every audit (H2D costs seconds
+        # when the chip sits behind a network tunnel)
+        self._dev_cache: dict[int, tuple] = {}
 
     # ------------------------------------------------------------- modules
 
@@ -253,6 +257,34 @@ class TpuDriver(RegoDriver):
         else:
             self._data_gen += 1
             self._feat_cache.clear()
+        self._dev_cache.clear()  # drop device buffers for dead host arrays
+
+    def _dev(self, tree):
+        """Device-resident view of a tree of host ndarrays, cached by leaf
+        identity. Entries hold the host array WEAKLY and self-evict when
+        the producing cache drops it — a strong ref would pin superseded
+        arrays (and their device buffers) until the next data mutation,
+        an unbounded leak on a long-running webhook whose vocab grows."""
+        import weakref
+
+        import jax
+
+        cache = self._dev_cache
+
+        def put(arr):
+            key = id(arr)
+            hit = cache.get(key)
+            if hit is not None and hit[0]() is arr:
+                return hit[1]
+            d = jax.device_put(arr)
+            try:
+                ref = weakref.ref(arr, lambda _r, k=key: cache.pop(k, None))
+            except TypeError:
+                return d  # unweakrefable leaf: use without caching
+            cache[key] = (ref, d)
+            return d
+
+        return jax.tree_util.tree_map(put, tree)
 
     # --------------------------------------------------------------- audit
 
@@ -267,7 +299,9 @@ class TpuDriver(RegoDriver):
         for c in constraints:
             by_kind.setdefault(c.get("kind"), []).append(c)
         results: list[Result] = []
-        sig_cache: dict = {}  # review match-signatures shared across kinds
+        # review match-signatures shared across kinds AND across audits
+        # (valid for the cached review list of this data revision)
+        sig_cache = self._audit_sig_cache(target)
         for kind in sorted(by_kind):
             cons = by_kind[kind]
             ct = self.compiled_for(kind)
@@ -309,8 +343,8 @@ class TpuDriver(RegoDriver):
         # change membership keeps the (expensive) extraction cached
         feat_key = (self._data_gen, hash(cand.tobytes()))
         try:
-            fires = self.eval_compiled(ct, kind, cand_reviews, cons,
-                                       feat_key=feat_key)
+            rows, cols = self.eval_compiled_pairs(ct, kind, cand_reviews,
+                                                  cons, feat_key=feat_key)
         except Exception as e:
             # eval-time failures (shapes/ops outside the evaluator's
             # envelope) demote the template to the interpreter path
@@ -318,9 +352,9 @@ class TpuDriver(RegoDriver):
             self._compiled[kind] = None
             return self._audit_interp(target, kind, cons, reviews,
                                       lookup_ns, inventory, trace, sig_cache)
-        hits = np.logical_and(fires, mask[cand])
+        keep = mask[cand[rows], cols]
         out: list[Result] = []
-        for ri, ci in zip(*np.nonzero(hits)):
+        for ri, ci in zip(rows[keep], cols[keep]):
             review = cand_reviews[int(ri)]
             constraint = cons[int(ci)]
             spec = constraint.get("spec")
@@ -337,6 +371,41 @@ class TpuDriver(RegoDriver):
                       feat_key=None) -> np.ndarray:
         """fires[len(reviews), len(cons)] via the device program.
         feat_key, when given, caches extraction until inventory changes."""
+        feats, enc, table, derived = self._prepare_eval(ct, kind, reviews,
+                                                        cons, feat_key)
+        # chunked: keeps [N, axes..., C] intermediates bounded on large
+        # audits; falls through to a single dispatch for small batches
+        fires = ct.fires_chunked(feats, enc, table, derived)
+        return fires[: len(reviews)]
+
+    def eval_compiled_pairs(self, ct: CompiledTemplate, kind: str,
+                            reviews: list[dict], cons: list[dict],
+                            feat_key=None) -> tuple:
+        """(rows, cols) firing pairs, row-major — the sparse form of
+        eval_compiled (audits are ~99% rejects; see fires_pairs)."""
+        feats, enc, table, derived = self._prepare_eval(ct, kind, reviews,
+                                                        cons, feat_key)
+        rows, cols = ct.fires_pairs(feats, enc, table, derived,
+                                    n_true=len(reviews))
+        # a parameterless program has no C axis on device (verdicts are
+        # [N, 1], constraint-independent); expand each firing row to every
+        # constraint, preserving row-major order, exactly as the dense
+        # [N, 1] & mask[N, C] broadcast did
+        c_dev = 1
+        for arrs in enc.values():
+            for a in arrs.values():
+                c_dev = a.shape[0]
+                break
+            break
+        if c_dev == 1 and len(cons) > 1:
+            C = len(cons)
+            n_pairs = len(rows)
+            rows = np.repeat(rows, C)
+            cols = np.tile(np.arange(C, dtype=cols.dtype), n_pairs)
+        return rows, cols
+
+    def _prepare_eval(self, ct: CompiledTemplate, kind: str,
+                      reviews: list[dict], cons: list[dict], feat_key):
         params_key = (self._constraint_gen,
                       tuple((c.get("metadata") or {}).get("name", "")
                             for c in cons))
@@ -364,10 +433,12 @@ class TpuDriver(RegoDriver):
                 fcache[feat_key] = feats
         derived = self._derived_arrays(kind, ct)
         table = self.match_tables.materialize_packed()
-        # chunked: keeps [N, axes..., C] intermediates bounded on large
-        # audits; falls through to a single dispatch for small batches
-        fires = ct.fires_chunked(feats, enc, table, derived)
-        return fires[: len(reviews)]
+        if feat_key is not None:
+            # steady-state audit: keep the cached tensors device-resident.
+            # One-shot feats (webhook micro-batches) stay host-side — the
+            # identity cache would grow one dead entry per request.
+            feats = self._dev(feats)
+        return feats, self._dev(enc), self._dev(table), self._dev(derived)
 
     def _derived_arrays(self, kind: str, ct: CompiledTemplate) -> dict:
         """Program-local derived columns, extended to the current vocab.

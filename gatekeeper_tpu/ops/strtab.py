@@ -28,6 +28,16 @@ import numpy as np
 PAD_ID = 0  # id 0 is reserved: "absent"; real strings start at 1
 
 
+def escape_transform_arg(arg: str) -> str:
+    """Escape a pattern-transform argument for embedding in an
+    "<op>@<tag>:<arg>" op string ("@" delimits tags)."""
+    return arg.replace("%", "%25").replace("@", "%40")
+
+
+def _unescape_transform_arg(arg: str) -> str:
+    return arg.replace("%40", "@").replace("%25", "%")
+
+
 def canon_num(v) -> str:
     """Canonical string form of a number, interned so numeric equality on
     device is exact (f32 cells are approximate past 2^24)."""
@@ -86,9 +96,12 @@ class MatchTables:
 
     # pattern-side transforms: "<op>@trim:<cutset>" applies the transform
     # to the pattern string at row-creation time (rego trim/trim_prefix/…
-    # wrapped around a parameter pattern, e.g. forbidden-sysctls)
+    # wrapped around a parameter pattern, e.g. forbidden-sysctls). Args are
+    # %-escaped (see escape_transform_arg) so cutsets containing "@" can't
+    # corrupt the tag encoding. Rego trim(s, "") strips nothing, so an
+    # empty cutset is the identity (not Python's whitespace strip).
     TRANSFORMS = {
-        "trim": lambda v, arg: v.strip(arg) if arg else v.strip(),
+        "trim": lambda v, arg: v.strip(arg) if arg else v,
         "lower": lambda v, arg: v.lower(),
         "upper": lambda v, arg: v.upper(),
         "trim_prefix": lambda v, arg: v[len(arg):]
@@ -124,7 +137,7 @@ class MatchTables:
                 if fn is None:
                     raise ValueError(f"unknown pattern transform {name!r}")
                 if isinstance(pattern, str):
-                    pattern = fn(pattern, arg)
+                    pattern = fn(pattern, _unescape_transform_arg(arg))
         key = (op, pattern)
         r = self._rows.get(key)
         if r is None:

@@ -23,6 +23,7 @@ template's harvested corpus through both paths and asserts equality.
 
 from __future__ import annotations
 
+import operator as _operator
 from typing import Any, Callable, Optional
 
 from . import ast as A
@@ -92,6 +93,52 @@ def _bin(op, a, b):
     if a is UNDEF or b is UNDEF:
         return UNDEF
     return _binop(op, a, b)
+
+
+def _bin_eq(a, b):
+    if a is UNDEF or b is UNDEF:
+        return UNDEF
+    return rego_eq(a, b)
+
+
+def _bin_neq(a, b):
+    if a is UNDEF or b is UNDEF:
+        return UNDEF
+    return not rego_eq(a, b)
+
+
+def _bin_minus(a, b):
+    """Mirrors _binop("-"): numeric difference or set difference."""
+    if a is UNDEF or b is UNDEF:
+        return UNDEF
+    if isinstance(a, (int, float)) and not isinstance(a, bool) and \
+            isinstance(b, (int, float)) and not isinstance(b, bool):
+        return a - b
+    if isinstance(a, frozenset) and isinstance(b, frozenset):
+        return a - b
+    return UNDEF
+
+
+def _mk_bin_cmp(py_op):
+    def f(a, b, _cmp=py_op):
+        if a is UNDEF or b is UNDEF:
+            return UNDEF
+        return _cmp(sort_key(a), sort_key(b))
+    return f
+
+
+# codegen-time specialization of the hottest comparison/difference ops:
+# one closure call instead of the generic op-string dispatch chain
+# (identical semantics to _binop; everything else falls through to _bin)
+_BIN_SPECIAL = {
+    "==": _bin_eq,
+    "!=": _bin_neq,
+    "-": _bin_minus,
+    "<": _mk_bin_cmp(_operator.lt),
+    "<=": _mk_bin_cmp(_operator.le),
+    ">": _mk_bin_cmp(_operator.gt),
+    ">=": _mk_bin_cmp(_operator.ge),
+}
 
 
 def _neg(a):
@@ -171,6 +218,7 @@ class ModuleCompiler:
             self.rules.setdefault(r.name, []).append(r)
         self.em = _Emit()
         self.builtin_bindings: dict[tuple, str] = {}
+        self.bin_bindings: dict[str, str] = {}
         self._pat_n = 0
 
     # ------------------------------------------------------------- naming
@@ -187,6 +235,16 @@ class ModuleCompiler:
             b = "_b" + str(len(self.builtin_bindings))
             self.builtin_bindings[fn] = b
         return b
+
+    def _bin_expr(self, op: str, a: str, b: str) -> str:
+        """Binary-op call, specialized for the hot ops (_BIN_SPECIAL)."""
+        if op not in _BIN_SPECIAL:
+            return f"_bin({op!r}, {a}, {b})"
+        bound = self.bin_bindings.get(op)
+        if bound is None:
+            bound = "_c" + str(len(self.bin_bindings))
+            self.bin_bindings[op] = bound
+        return f"{bound}({a}, {b})"
 
     # -------------------------------------------------------- deterministic
 
@@ -205,7 +263,7 @@ class ModuleCompiler:
         if isinstance(t, A.BinOp):
             a = self.value(t.lhs, scope, ind)
             b = self.value(t.rhs, scope, ind)
-            return f"_bin({t.op!r}, {a}, {b})"
+            return self._bin_expr(t.op, a, b)
         if isinstance(t, A.UnaryMinus):
             return f"_neg({self.value(t.term, scope, ind)})"
         if isinstance(t, A.ArrayLit):
@@ -341,7 +399,8 @@ class ModuleCompiler:
         if isinstance(t, A.BinOp):
             def fin(i, names):
                 v = self.em.tmp()
-                self.em.w(i, f"{v} = _bin({t.op!r}, {names[0]}, {names[1]})")
+                self.em.w(i, f"{v} = "
+                             f"{self._bin_expr(t.op, names[0], names[1])}")
                 self.em.w(i, f"if {v} is not UNDEF:")
                 cont(i + 1, v)
             self._iter_args([t.lhs, t.rhs], [], scope, ind, fin)
@@ -693,16 +752,18 @@ class ModuleCompiler:
                   "_stepv", "_call", "_callu", "_bin", "_neg", "_arr",
                   "_setl", "_obj"]
         bparams = list(self.builtin_bindings.values())
-        src = (f"def __make__({', '.join(params + bparams)}):\n"
+        cparams = list(self.bin_bindings.values())
+        src = (f"def __make__({', '.join(params + bparams + cparams)}):\n"
                + "\n".join("    " + l for l in self.em.lines)
                + "\n    return __evaluate__\n")
         g: dict = {}
         exec(compile(src, f"<codegen:{'.'.join(self.module.package)}>",
                      "exec"), g)
         bvals = [BUILTINS[fn] for fn in self.builtin_bindings]
+        cvals = [_BIN_SPECIAL[op] for op in self.bin_bindings]
         fn = g["__make__"](UNDEF, FrozenDict, RegoError, rego_eq, _enum,
                            _stepv, _call, _callu, _bin, _neg, _arr, _setl,
-                           _obj, *bvals)
+                           _obj, *bvals, *cvals)
         fn.__source__ = src  # for debugging
         return fn
 

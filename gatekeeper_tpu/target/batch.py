@@ -48,15 +48,25 @@ def _dependence(constraint: dict) -> tuple:
 
 
 def _label_state(review: dict, field: str):
-    """(is-empty, frozen labels) of review.object/.oldObject — everything
-    _any_labelselector_match can observe."""
+    """(is-empty, hashable labels key) of review.object/.oldObject —
+    everything _any_labelselector_match can observe."""
     v = review.get(field)
     v = v if isinstance(v, dict) else {}
     if not v:
         return (True, None)
     meta = v.get("metadata")
     labels = meta.get("labels") if isinstance(meta, dict) else None
-    return (False, freeze(labels) if isinstance(labels, dict) else None)
+    if not isinstance(labels, dict):
+        return (False, None)
+    try:
+        # labels are dict[str, str] in practice: a sorted-items tuple is a
+        # ~4x cheaper signature key than a recursive freeze (hash() probes
+        # for unhashable values so malformed labels fall back cleanly)
+        t = tuple(sorted(labels.items()))
+        hash(t)
+        return (False, t)
+    except TypeError:
+        return (False, freeze(labels))
 
 
 def _signature(review: dict) -> Optional[tuple]:
@@ -133,12 +143,22 @@ def match_masks(constraints: list[dict], reviews: list[dict],
                 bucket.extend(rows)
         proj_rows = [(np.asarray(rows), reviews[rep[key]])
                      for key, rows in proj.items()]
-        for c in cidxs:
-            constraint = constraints[c]
-            for rows, review in proj_rows:
-                if constraint_matches(constraint, review, lookup_ns):
-                    mask[rows, c] = True
-            for r in fallback:
-                mask[r, c] = constraint_matches(constraint, reviews[r],
+        cidx_arr = np.asarray(cidxs)
+        # assign per (projection group, matched-constraint set) BLOCK:
+        # one np.ix_ write instead of |groups|×|constraints| fancy-index
+        # writes (the all-match case — selector-free constraints — is a
+        # single [R, C] block memset)
+        for rows, review in proj_rows:
+            matched = [c for c in cidxs
+                       if constraint_matches(constraints[c], review,
+                                             lookup_ns)]
+            if not matched:
+                continue
+            cols = cidx_arr if len(matched) == len(cidxs) \
+                else np.asarray(matched)
+            mask[np.ix_(rows, cols)] = True
+        for r in fallback:
+            for c in cidxs:
+                mask[r, c] = constraint_matches(constraints[c], reviews[r],
                                                 lookup_ns)
     return mask

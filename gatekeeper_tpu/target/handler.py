@@ -124,9 +124,15 @@ class K8sValidationTarget:
 
     # ----------------------------------------------------------- violations
 
-    def handle_violation(self, result: Result) -> None:
+    def handle_violation(self, result: Result,
+                         memo: Optional[dict] = None) -> None:
         """Re-extract the violating resource from the review
-        (reference target.go:193-244)."""
+        (reference target.go:193-244).
+
+        memo (scoped to one response batch by the caller) dedupes the
+        deep copy across the many results one object produces in a large
+        audit; like Result.constraint, the resource dict is then shared
+        between those results."""
         review = result.review
         if not isinstance(review, dict):
             raise TargetError(f"could not cast review as object: {review!r}")
@@ -145,9 +151,14 @@ class K8sValidationTarget:
             obj = review.get("oldObject")
         if not isinstance(obj, dict):
             raise TargetError("no object or oldObject returned in review")
-        resource = json.loads(json.dumps(obj))
-        resource["apiVersion"] = api_version
-        resource["kind"] = kname
+        key = (id(obj), api_version, kname)
+        resource = memo.get(key) if memo is not None else None
+        if resource is None:
+            resource = json.loads(json.dumps(obj))
+            resource["apiVersion"] = api_version
+            resource["kind"] = kname
+            if memo is not None:
+                memo[key] = resource
         result.resource = resource
 
     # -------------------------------------------------------------- schema

@@ -24,6 +24,17 @@ os.environ.setdefault(
     os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
+# a sitecustomize hook (PYTHONPATH site injection) may have imported jax at
+# interpreter startup and captured JAX_PLATFORMS from the outer environment
+# (e.g. a remote-TPU plugin); the env assignments above are then too late.
+# Backends initialize lazily, so updating the config here still wins as
+# long as no test ran a computation yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+if not jax.config.jax_num_cpu_devices or jax.config.jax_num_cpu_devices < 8:
+    jax.config.update("jax_num_cpu_devices", 8)
+
 import pathlib
 
 import pytest
