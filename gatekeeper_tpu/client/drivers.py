@@ -84,6 +84,9 @@ class RegoDriver:
         # per-template codegen'd materializers (rego/codegen.py): None =
         # outside the compilable subset, fall through to the interpreter
         self._codegen: dict[tuple, Any] = {}
+        # kind -> (review, dict): per-review memo for review-pure
+        # comprehensions in the codegen'd evaluator
+        self._rmemo: dict[str, tuple] = {}
         # identity-keyed freeze caches for the audit materialization loop
         # (consecutive firing pairs share the review; constraints repeat)
         self._frz_review: tuple = (None, None)
@@ -327,8 +330,15 @@ class RegoDriver:
                 ("review", self._freeze_review(review)),
                 ("parameters", self._freeze_params(constraint, parameters)),
             ))
+            # review-pure comprehension memo: audit materialization is
+            # row-major, so consecutive calls share the review — reuse its
+            # review-only subresults across the constraints it fired
+            ent = self._rmemo.get(kind)
+            if ent is None or ent[0] is not review:
+                ent = (review, {})
+                self._rmemo[kind] = ent
             try:
-                out = fn(finp, self._freeze_inv(inventory))
+                out = fn(finp, self._freeze_inv(inventory), ent[1])
             except RegoError as e:
                 raise DriverError(
                     f"evaluating {kind} violation: {e}"

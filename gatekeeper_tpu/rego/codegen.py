@@ -220,6 +220,7 @@ class ModuleCompiler:
         self.builtin_bindings: dict[tuple, str] = {}
         self.bin_bindings: dict[str, str] = {}
         self._pat_n = 0
+        self._rmemo_n = 0  # review-pure comprehension memo slots
 
     # ------------------------------------------------------------- naming
 
@@ -334,7 +335,109 @@ class ModuleCompiler:
         b = self._builtin(fn)
         return f"_call({b}, {', '.join(args)})"
 
+    # ------------------------------------------------- review-pure analysis
+
+    def _review_pure(self, t, scope: _Scope) -> bool:
+        """True when a comprehension's value depends ONLY on input.review:
+        no outer-scope variable reads, no data/inventory refs, no user
+        rule/function calls (they may read input.parameters), and every
+        input reference steps through "review" first. Such comprehensions
+        are identical across the many constraints one review is evaluated
+        against in an audit, so their results are memoized per review."""
+        outer = set(scope.names)
+
+        def ok(x, bound: set) -> bool:
+            if isinstance(x, A.Scalar):
+                return True
+            if isinstance(x, A.Var):
+                if x.name in bound or x.name.startswith("$wc"):
+                    return True
+                # outer-scope binding (or a rule reference): impure
+                return False
+            if isinstance(x, A.Ref):
+                if isinstance(x.base, A.Var) and x.base.name == "input" \
+                        and "input" not in bound and "input" not in outer:
+                    if not x.args or not (isinstance(x.args[0], A.Scalar)
+                                          and x.args[0].value == "review"):
+                        return False
+                    return all(ok(a, bound) for a in x.args[1:])
+                return ok(x.base, bound) and \
+                    all(ok(a, bound) for a in x.args)
+            if isinstance(x, A.Call):
+                fn = tuple(x.fn)
+                if fn not in BUILTINS:
+                    return False  # user fn / data fn: may read parameters
+                return all(ok(a, bound) for a in x.args)
+            if isinstance(x, A.BinOp):
+                return ok(x.lhs, bound) and ok(x.rhs, bound)
+            if isinstance(x, A.UnaryMinus):
+                return ok(x.term, bound)
+            if isinstance(x, (A.ArrayLit, A.SetLit)):
+                return all(ok(i, bound) for i in x.items)
+            if isinstance(x, A.ObjectLit):
+                return all(ok(k, bound) and ok(v, bound)
+                           for k, v in x.items)
+            return False  # nested comprehensions etc.: be conservative
+
+        def collect_vars(x, into: set) -> None:
+            """All vars a pattern-position term could bind."""
+            if isinstance(x, A.Var):
+                into.add(x.name)
+            elif isinstance(x, (A.ArrayLit, A.SetLit)):
+                for i in x.items:
+                    collect_vars(i, into)
+            elif isinstance(x, A.ObjectLit):
+                for _k, v in x.items:
+                    collect_vars(v, into)
+            elif isinstance(x, A.Ref):
+                for a in x.args:
+                    collect_vars(a, into)
+
+        bound: set = set()
+        body = getattr(t, "body", ())
+        # first pass: everything the body can bind (iteration vars,
+        # unification targets, some-decls) counts as locally bound
+        for lit in body:
+            if lit.withs:
+                return False
+            e = lit.expr
+            if isinstance(e, A.SomeDecl):
+                bound.update(e.names)
+            elif isinstance(e, (A.Assign, A.Unify)):
+                collect_vars(e.lhs, bound)
+                collect_vars(e.rhs, bound)
+            else:
+                collect_vars(e, bound)
+        bound -= outer  # outer bindings shadow nothing here: reads of them
+        # are what makes the comprehension constraint-dependent
+        for lit in body:
+            e = lit.expr
+            if isinstance(e, A.SomeDecl):
+                continue
+            if isinstance(e, (A.Assign, A.Unify)):
+                if not (ok(e.lhs, bound) and ok(e.rhs, bound)):
+                    return False
+            elif not ok(e, bound):
+                return False
+        heads = [h for h in (getattr(t, "head", None),
+                             getattr(t, "key", None),
+                             getattr(t, "value", None)) if h is not None]
+        return all(ok(h, bound) for h in heads)
+
     def _compr(self, t, scope: _Scope, ind: int) -> str:
+        if self._review_pure(t, scope):
+            slot = self._rmemo_n
+            self._rmemo_n += 1
+            out = self.em.tmp()
+            self.em.w(ind, f"{out} = _J['rmemo'].get({slot})")
+            self.em.w(ind, f"if {out} is None:")
+            out2 = self._compr_emit(t, scope, ind + 1)
+            self.em.w(ind + 1, f"{out} = {out2}")
+            self.em.w(ind + 1, f"_J['rmemo'][{slot}] = {out}")
+            return out
+        return self._compr_emit(t, scope, ind)
+
+    def _compr_emit(self, t, scope: _Scope, ind: int) -> str:
         acc = self.em.tmp()
         sub = scope.child()
         if isinstance(t, A.ObjectCompr):
@@ -742,8 +845,9 @@ class ModuleCompiler:
             raise Unsupported(f"no {entry} rule")
         for name in self.rules:
             self._emit_rule(name)
-        self.em.w(0, "def __evaluate__(_input, _inv):")
-        self.em.w(1, "_J = {'input': _input, 'inv': _inv, 'memo': {}}")
+        self.em.w(0, "def __evaluate__(_input, _inv, _rmemo=None):")
+        self.em.w(1, "_J = {'input': _input, 'inv': _inv, 'memo': {}, "
+                     "'rmemo': _rmemo if _rmemo is not None else {}}")
         if self.rules[entry][0].kind == "function":
             raise Unsupported(f"{entry} is a function")
         self.em.w(1, f"return rule_{entry}(_J)")
