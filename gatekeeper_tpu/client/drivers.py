@@ -110,6 +110,7 @@ class RegoDriver:
         self._interp.put_module(name, module)
         self._module_names.add(name)
         self._codegen.clear()
+        self._rmemo.clear()
 
     def put_modules(self, prefix: str, modules: Iterable[A.Module]) -> None:
         # mirror of PutModules upsert semantics (local.go:124-148): existing
@@ -126,6 +127,7 @@ class RegoDriver:
             self._interp.put_module(name, m)
             self._module_names.add(name)
         self._codegen.clear()
+        self._rmemo.clear()
 
     def delete_module(self, name: str) -> bool:
         if name not in self._module_names:
@@ -133,6 +135,7 @@ class RegoDriver:
         self._interp.delete_module(name)
         self._module_names.discard(name)
         self._codegen.clear()
+        self._rmemo.clear()
         return True
 
     def delete_modules(self, prefix: str) -> int:
@@ -141,6 +144,7 @@ class RegoDriver:
             self._interp.delete_module(n)
             self._module_names.discard(n)
         self._codegen.clear()
+        self._rmemo.clear()
         return len(doomed)
 
     # ---------------------------------------------------------------- data

@@ -1,0 +1,21 @@
+"""CLI entry: python -m gatekeeper_tpu.service [--address A] [--driver D]"""
+
+import argparse
+import logging
+
+from .server import serve
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="gatekeeper_tpu policy service")
+    p.add_argument("--address", default="127.0.0.1:50061",
+                   help="bind address (host:port)")
+    p.add_argument("--driver", default="tpu", choices=["tpu", "rego"],
+                   help="evaluation backend")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    serve(address=args.address, driver=args.driver)
+
+
+if __name__ == "__main__":
+    main()

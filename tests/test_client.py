@@ -136,9 +136,25 @@ def admission_request(o, operation="CREATE", old=None, namespace=None):
     return req
 
 
-@pytest.fixture
-def client() -> Client:
-    return Backend(RegoDriver()).new_client([K8sValidationTarget()])
+@pytest.fixture(params=["local", "grpc"])
+def client(request):
+    """Every conformance case runs twice: against the in-process Client
+    and against a live localhost gRPC service (service/) through
+    RemoteClient — the wire protocol must not change any semantics."""
+    if request.param == "local":
+        yield Backend(RegoDriver()).new_client([K8sValidationTarget()])
+        return
+    pytest.importorskip("grpc")
+    from gatekeeper_tpu.service import RemoteClient, make_server
+
+    server, port = make_server(driver="rego")
+    server.start()
+    rc = RemoteClient(f"127.0.0.1:{port}")
+    try:
+        yield rc
+    finally:
+        rc.close()
+        server.stop(grace=None)
 
 
 def test_deny_all(client):

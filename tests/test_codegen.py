@@ -248,3 +248,50 @@ violation[{"msg": msg}] {
 }
 """, {"review": {"object": {"pair": [3, 4]}}})
     assert thaw(out) == [{"msg": "11"}]
+
+
+def test_template_update_invalidates_review_memo():
+    """Updating a template must drop the per-review comprehension memo:
+    the recompiled evaluator's memo slots are numbered for the NEW module
+    (r3 code-review finding, confirmed stale-result repro)."""
+    from gatekeeper_tpu.client import Backend, RegoDriver
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    def tmpl(rego):
+        return {
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "k8smemo"},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": "K8sMemo"}}},
+                "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                             "rego": rego}],
+            },
+        }
+
+    v1 = tmpl("""
+package k8smemo
+violation[{"msg": msg}] {
+  ls := {l | input.review.object.metadata.labels[l]}
+  count(ls) > 0
+  msg := sprintf("labels: %v", [ls])
+}
+""")
+    v2 = tmpl("""
+package k8smemo
+violation[{"msg": msg}] {
+  ans := {a | input.review.object.metadata.annotations[a]}
+  count(ans) > 0
+  msg := sprintf("annotations: %v", [ans])
+}
+""")
+    client = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    client.add_template(v1)
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sMemo", "metadata": {"name": "c"}, "spec": {}})
+    client.add_data({"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": "n", "labels": {"x": "y"}}})
+    assert [r.msg for r in client.audit().results()] == ['labels: {"x"}']
+    client.add_template(v2)  # same data revision; review identity reused
+    assert client.audit().results() == []  # no annotations -> no violation
