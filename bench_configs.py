@@ -1,0 +1,383 @@
+#!/usr/bin/env python
+"""BASELINE.md configs #1, #2, #3, #5 (config #4 is bench.py's headline).
+
+One JSON line per config:
+  #1 requiredlabels x 1k Namespaces     — full audit wall-clock + the
+     measured local interpreter (local-OPA stand-in) audit baseline
+  #2 full shipped general library x 10k mixed objects — full audit
+  #3 full shipped pod-security-policy library x 50k Pods (regex-heavy)
+     — full audit
+  #5 streaming admission through the MicroBatcher — sustained
+     requests/s and p50/p99 latency under an open-loop arrival process
+
+All audits run steady-state through client.audit() (warm caches), same
+contract as bench.py. Run: python bench_configs.py [1 2 3 5]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+TARGET = "admission.k8s.gatekeeper.sh"
+SCALE = float(os.environ.get("BENCH_SCALE", 1.0))  # shrink for smoke runs
+
+
+def new_client(driver=None):
+    from gatekeeper_tpu.client import Backend
+    from gatekeeper_tpu.ir import TpuDriver
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    driver = driver or TpuDriver()
+    return driver, Backend(driver).new_client([K8sValidationTarget()])
+
+
+def steady_audit(client, iters=3):
+    t0 = time.time()
+    resp = client.audit()
+    first = time.time() - t0
+    t0 = time.time()
+    for _ in range(iters):
+        resp = client.audit()
+    return (time.time() - t0) / iters, first, len(resp.results())
+
+
+# --------------------------------------------------------------- config 1
+
+
+def config1():
+    from gatekeeper_tpu import policies
+    from gatekeeper_tpu.client import RegoDriver
+    from gatekeeper_tpu.parallel.workload import synth_objects
+
+    n = int(1000 * SCALE)
+    constraint = {
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels", "metadata": {"name": "must-own"},
+        "spec": {"parameters": {"labels": [
+            {"key": "owner", "allowedRegex": "^[a-z]+.corp.example$"}]}},
+    }
+    objs = synth_objects(n, violate_frac=0.02, seed=7)
+
+    _, client = new_client()
+    client.add_template(policies.load("general/requiredlabels"))
+    client.add_constraint(constraint)
+    for o in objs:
+        client.add_data(o)
+    audit_s, first, nres = steady_audit(client)
+
+    # the local-OPA stand-in baseline, measured on the SAME workload
+    # (pure interpreter: codegen disabled)
+    base_driver = RegoDriver()
+    base_driver._codegen_for = lambda *a, **k: None
+    _, base_client = new_client(base_driver)
+    base_client.add_template(policies.load("general/requiredlabels"))
+    base_client.add_constraint(constraint)
+    for o in objs:
+        base_client.add_data(o)
+    t0 = time.time()
+    base_n = len(base_client.audit().results())
+    base_s = time.time() - t0
+    assert base_n == nres
+    print(json.dumps({
+        "config": 1, "metric": "audit_wall_clock_s", "value": round(audit_s, 4),
+        "unit": f"s (requiredlabels x {n} namespaces, steady state)",
+        "baseline_interpreter_s": round(base_s, 3),
+        "vs_baseline": round(base_s / audit_s, 1),
+        "first_audit_s": round(first, 2), "violations": nres,
+    }))
+
+
+# --------------------------------------------------------------- config 2
+
+
+def synth_mixed_objects(n: int, seed: int = 0) -> list[dict]:
+    """Pods/Deployments/Ingresses/Services with fields the general
+    library examines (images, limits/requests, labels, tls/hosts,
+    selectors). ~2% violate something."""
+    rng = random.Random(seed)
+    repos = ["registry.corp.example/", "gcr.io/corp/"]
+    out = []
+    for i in range(n):
+        kind = ("Pod", "Pod", "Pod", "Deployment", "Ingress",
+                "Service")[i % 6]
+        name = f"{kind.lower()}-{i}"
+        labels = {"owner": "team.corp.example", "app": f"app{i % 50}"}
+        bad = rng.random() < 0.02
+        if kind == "Pod":
+            image = (rng.choice(repos) + f"svc{i % 20}:v1"
+                     if not bad else f"docker.io/evil{i}:latest")
+            cpu = "900m" if not bad else "4"
+            out.append({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": f"ns{i % 20}",
+                             "labels": labels},
+                "spec": {"containers": [{
+                    "name": "main", "image": image,
+                    "resources": {
+                        "limits": {"cpu": cpu, "memory": "512Mi"},
+                        "requests": {"cpu": "250m", "memory": "256Mi"}},
+                }]},
+            })
+        elif kind == "Deployment":
+            out.append({
+                "apiVersion": "apps/v1", "kind": "Deployment",
+                "metadata": {"name": name, "namespace": f"ns{i % 20}",
+                             "labels": labels},
+                "spec": {"replicas": 2,
+                         "selector": {"matchLabels": {"app": f"app{i}"}}},
+            })
+        elif kind == "Ingress":
+            spec = {"rules": [{"host": f"h{i}.corp.example"}]}
+            meta = {"name": name, "namespace": f"ns{i % 20}",
+                    "labels": labels}
+            if not bad:
+                spec["tls"] = [{"hosts": [f"h{i}.corp.example"]}]
+                meta["annotations"] = {
+                    "kubernetes.io/ingress.allow-http": "false"}
+            out.append({"apiVersion": "networking.k8s.io/v1beta1",
+                        "kind": "Ingress", "metadata": meta, "spec": spec})
+        else:
+            out.append({
+                "apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": name, "namespace": f"ns{i % 20}",
+                             "labels": labels},
+                "spec": {"selector": {"app": f"app{i}"},
+                         "ports": [{"port": 80}]},
+            })
+    return out
+
+
+GENERAL_CONSTRAINTS = [
+    ("K8sAllowedRepos", "repos-allowed",
+     {"repos": ["registry.corp.example/", "gcr.io/corp/"]}),
+    ("K8sContainerLimits", "limits-capped", {"cpu": "2", "memory": "1Gi"}),
+    ("K8sContainerRatios", "ratio-capped", {"ratio": "4"}),
+    ("K8sHttpsOnly", "https-only", None),
+    ("K8sRequiredLabels", "must-own",
+     {"labels": [{"key": "owner",
+                  "allowedRegex": "^[a-z]+.corp.example$"}]}),
+    ("K8sUniqueIngressHost", "unique-hosts", None),
+    ("K8sUniqueServiceSelector", "unique-selectors", None),
+]
+
+
+def config2():
+    from gatekeeper_tpu import policies
+
+    n = int(10_000 * SCALE)
+    _, client = new_client()
+    for name in policies.names():
+        if name.startswith("general/"):
+            client.add_template(policies.load(name))
+    for kind, cname, params in GENERAL_CONSTRAINTS:
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind, "metadata": {"name": cname},
+            "spec": ({"parameters": params} if params else {}),
+        })
+    for o in synth_mixed_objects(n):
+        client.add_data(o)
+    audit_s, first, nres = steady_audit(client)
+    print(json.dumps({
+        "config": 2, "metric": "audit_wall_clock_s",
+        "value": round(audit_s, 3),
+        "unit": f"s (full general library, {len(GENERAL_CONSTRAINTS)} "
+                f"constraints x {n} mixed objects, steady state)",
+        "first_audit_s": round(first, 2), "violations": nres,
+    }))
+
+
+# --------------------------------------------------------------- config 3
+
+
+def synth_pods_psp(n: int, seed: int = 0) -> list[dict]:
+    """Pod specs exercising the PSP library's fields; ~3% violate."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        bad = rng.random() < 0.03
+        ctx = {"allowPrivilegeEscalation": False,
+               "readOnlyRootFilesystem": True,
+               "runAsUser": 1000 + (i % 1000),
+               "capabilities": {"drop": ["ALL"]}}
+        if bad:
+            kind_of_bad = rng.randrange(5)
+            if kind_of_bad == 0:
+                ctx["privileged"] = True
+            elif kind_of_bad == 1:
+                ctx["runAsUser"] = 0
+            elif kind_of_bad == 2:
+                ctx["capabilities"] = {"add": ["SYS_ADMIN"], "drop": []}
+            elif kind_of_bad == 3:
+                ctx.pop("readOnlyRootFilesystem")
+            else:
+                ctx["allowPrivilegeEscalation"] = True
+        pod = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": f"pod-{i}", "namespace": f"ns{i % 40}",
+                "annotations": {
+                    "seccomp.security.alpha.kubernetes.io/pod":
+                        "runtime/default",
+                    "container.apparmor.security.beta.kubernetes.io/main":
+                        "runtime/default",
+                },
+            },
+            "spec": {
+                "securityContext": {"fsGroup": 2000,
+                                    "sysctls": ([{"name": "net.ipv4.ip_local_port_range", "value": "1024 65535"}]
+                                                if i % 7 else [{"name": "kernel.msgmax", "value": "1"}])},
+                "containers": [{
+                    "name": "main",
+                    "image": f"registry.corp.example/app{i % 100}:v1",
+                    "securityContext": ctx,
+                    "ports": ([{"hostPort": 8080 + (i % 100)}]
+                              if i % 11 == 0 else []),
+                }],
+                "volumes": [{"name": "cfg", "configMap": {"name": "c"}}] +
+                           ([{"name": "h", "hostPath":
+                              {"path": f"/var/log/app{i}"}}]
+                            if i % 13 == 0 else []),
+            },
+        }
+        out.append(pod)
+    return out
+
+
+PSP_CONSTRAINTS = [
+    ("K8sPSPAllowPrivilegeEscalationContainer", "no-escalation", None),
+    ("K8sPSPAppArmor", "apparmor-default",
+     {"allowedProfiles": ["runtime/default"]}),
+    ("K8sPSPCapabilities", "caps",
+     {"allowedCapabilities": ["NET_BIND_SERVICE"],
+      "requiredDropCapabilities": ["ALL"]}),
+    ("K8sPSPFlexVolumes", "flex", {"allowedFlexVolumes": []}),
+    ("K8sPSPForbiddenSysctls", "sysctls",
+     {"forbiddenSysctls": ["kernel.*", "vm.swappiness"]}),
+    ("K8sPSPFSGroup", "fsgroup",
+     {"rule": "MustRunAs", "ranges": [{"min": 1000, "max": 65535}]}),
+    ("K8sPSPHostFilesystem", "hostfs",
+     {"allowedHostPaths": [{"pathPrefix": "/var/log", "readOnly": True}]}),
+    ("K8sPSPHostNamespace", "no-host-ns", None),
+    ("K8sPSPHostNetworkingPorts", "host-ports",
+     {"hostNetwork": False, "min": 8000, "max": 9000}),
+    ("K8sPSPPrivilegedContainer", "no-privileged", None),
+    ("K8sPSPProcMount", "procmount", {"procMount": "Default"}),
+    ("K8sPSPReadOnlyRootFilesystem", "ro-root", None),
+    ("K8sPSPSeccomp", "seccomp",
+     {"allowedProfiles": ["runtime/default", "docker/default"]}),
+    ("K8sPSPSELinux", "selinux",
+     {"allowedSELinuxOptions": {"level": "s0:c123,c456"}}),
+    ("K8sPSPAllowedUsers", "users",
+     {"runAsUser": {"rule": "MustRunAsNonRoot"}}),
+    ("K8sPSPVolumeTypes", "volumes",
+     {"volumes": ["configMap", "secret", "emptyDir", "hostPath"]}),
+]
+
+
+def config3():
+    from gatekeeper_tpu import policies
+
+    n = int(50_000 * SCALE)
+    drv, client = new_client()
+    for name in policies.names():
+        if name.startswith("pod-security-policy/"):
+            client.add_template(policies.load(name))
+    for kind, cname, params in PSP_CONSTRAINTS:
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind, "metadata": {"name": cname},
+            "spec": ({"parameters": params} if params else {}),
+        })
+    for o in synth_pods_psp(n):
+        client.add_data(o)
+    audit_s, first, nres = steady_audit(client)
+    compiled = drv.compiled_kinds() if hasattr(drv, "compiled_kinds") else []
+    device = [k for k in compiled if drv.compiled_for(k) is not None]
+    print(json.dumps({
+        "config": 3, "metric": "audit_wall_clock_s",
+        "value": round(audit_s, 3),
+        "unit": f"s (full pod-security-policy library, "
+                f"{len(PSP_CONSTRAINTS)} constraints x {n} pods, "
+                f"steady state)",
+        "first_audit_s": round(first, 2), "violations": nres,
+        "device_compiled_kinds": len(device),
+    }))
+
+
+# --------------------------------------------------------------- config 5
+
+
+def config5():
+    from gatekeeper_tpu import policies
+    from gatekeeper_tpu.control.webhook import MicroBatcher
+    from gatekeeper_tpu.parallel.workload import synth_objects
+    import threading
+
+    _, client = new_client()
+    client.add_template(policies.load("general/requiredlabels"))
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels", "metadata": {"name": "must-own"},
+        "spec": {"parameters": {"labels": [
+            {"key": "owner", "allowedRegex": "^[a-z]+.corp.example$"}]}},
+    })
+    objs = synth_objects(512, violate_frac=0.05, seed=3)
+    reviews = [{"kind": {"group": "", "version": "v1", "kind": "Namespace"},
+                "name": o["metadata"]["name"], "object": o,
+                "operation": "CREATE"} for o in objs]
+    batcher = MicroBatcher(client, max_wait=0.003, max_batch=256)
+    # warm the device path
+    batcher.submit(reviews[0])
+
+    n_requests = int(10_000 * SCALE)
+    n_threads = 32
+    latencies: list[float] = []
+    lock = threading.Lock()
+
+    def worker(k: int):
+        lats = []
+        for j in range(n_requests // n_threads):
+            r = reviews[(k * 131 + j) % len(reviews)]
+            t0 = time.time()
+            batcher.submit(r)
+            lats.append(time.time() - t0)
+        with lock:
+            latencies.extend(lats)
+
+    t0 = time.time()
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    batcher.stop()
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2]
+    p99 = latencies[int(len(latencies) * 0.99)]
+    print(json.dumps({
+        "config": 5, "metric": "admission_requests_per_sec",
+        "value": round(len(latencies) / wall),
+        "unit": f"req/s ({len(latencies)} reviews, {n_threads} concurrent "
+                f"clients, micro-batched)",
+        "p50_ms": round(p50 * 1000, 2), "p99_ms": round(p99 * 1000, 2),
+        "batches": batcher.batches,
+        "avg_batch": round(batcher.batched_requests /
+                           max(1, batcher.batches), 1),
+    }))
+
+
+def main() -> None:
+    which = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 5]
+    for c in which:
+        {1: config1, 2: config2, 3: config3, 5: config5}[c]()
+
+
+if __name__ == "__main__":
+    main()

@@ -552,11 +552,15 @@ class _ClauseCompiler:
                         if same_root and tuple(owner.segs) == here:
                             sym = replace(sym, segs=here)
                             continue
-                        raise Uncompilable(
-                            "correlated indexing by a bound key across "
-                            "collections is not supported"
-                        )
-                    raise Uncompilable("indexing by bound var")
+                        # different collection: desugar to a fresh axis
+                        # with a key(new) == key(bound) guard — the joint
+                        # ∃-reduction is exactly the correlated lookup
+                        sym = self._computed_key_bracket(sym, bound)
+                        continue
+                    # var bound to a scalar value: coll[k] with k computed
+                    # elsewhere — desugar like any computed key
+                    sym = self._computed_key_bracket(sym, bound)
+                    continue
                 # fresh var or wildcard -> iteration axis
                 axis = self.ctx.new_axis("obj")
                 is_param = sym.root == "params"
@@ -565,9 +569,29 @@ class _ClauseCompiler:
                 self._register_axis(axis, kind, sym)
                 if not name.startswith("$wc"):
                     self.env[name] = SKey(axis=axis, kind=kind)
+            elif isinstance(arg, (A.Ref, A.Call)):
+                # coll[<computed key>] (labels[spec.key], ...): desugar to
+                # iteration over the collection plus a key == value guard
+                sym = self._computed_key_bracket(sym, self.to_symbolic(arg))
             else:
                 raise Uncompilable("composite bracket pattern")
         return sym
+
+    def _computed_key_bracket(self, sym: SPath, key_sym) -> SPath:
+        """m[<computed>] -> iterate m's entries on a fresh axis, guarded by
+        key(axis) == <computed>. The ∃-reduction over the axis then yields
+        exactly the map-lookup semantics (absent key -> no binding)."""
+        key_expr = self.value_expr(key_sym)
+        if not isinstance(key_expr, _CELL_EXPRS):
+            raise Uncompilable("unsupported computed bracket key")
+        axis = self.ctx.new_axis("obj")
+        kind = "param" if sym.root == "params" else "obj"
+        out = replace(sym, segs=sym.segs + (Seg("iter", axis=axis),))
+        self._register_axis(axis, kind, out)
+        key_of_axis = self.value_expr(SKey(axis=axis, kind=kind))
+        self.guards.append(Guard(expr=Cmp("eq", key_of_axis, key_expr,
+                                          dtype="auto")))
+        return out
 
     def set_bracket(self, s: SSet, arg, rest: tuple) -> Symbolic:
         """boundset[x]: membership test (const) or element iteration

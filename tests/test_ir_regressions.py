@@ -335,3 +335,59 @@ violation[{"msg": "no owner"}] {
                for r in tc.audit().results())
     assert a == b
     assert len(b) == 80  # every (object, constraint) pair
+
+
+def test_vocab_stabilizes_across_audits():
+    """Derived-column materialization must not intern new vocab entries
+    forever (r3 finding: each audit re-derived the previous audit's
+    outputs, growing the vocab 32 strings/audit, reshaping the match
+    table, and forcing a full XLA recompile EVERY audit)."""
+    from gatekeeper_tpu import policies
+
+    d = TpuDriver()
+    c = Backend(d).new_client([K8sValidationTarget()])
+    c.add_template(policies.load("general/containerlimits"))
+    c.add_constraint(constraint("K8sContainerLimits", "cl",
+                                {"cpu": "2", "memory": "1Gi"}))
+    for i in range(30):
+        c.add_data({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"p{i}", "namespace": "d"},
+                    "spec": {"containers": [{
+                        "name": "m", "image": "img",
+                        "resources": {"limits": {
+                            "cpu": f"{100 + i}m",
+                            "memory": f"{i + 1}Gi"}}}]}})
+    sizes = []
+    for _ in range(4):
+        c.audit()
+        sizes.append(len(d.strtab))
+    # growth must stop (bounded chain depth), not continue per audit
+    assert sizes[-1] == sizes[-2], sizes
+
+
+def test_computed_key_bracket_compiles_and_matches():
+    """m[<computed key>] (labels[spec.key]) desugars to iteration + key
+    equality on the device path and must agree with the interpreter."""
+    rego = """
+package k8stest
+violation[{"msg": "bad value"}] {
+  spec := input.parameters.entries[_]
+  val := input.review.object.metadata.labels[spec.key]
+  val != spec.want
+}
+"""
+    objs = [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": "ok", "labels": {"env": "prod", "x": "y"}}},
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": "wrong", "labels": {"env": "dev"}}},
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": "absent", "labels": {"x": "y"}}},
+    ]
+    cons = [constraint("K8sTest", "c",
+                       {"entries": [{"key": "env", "want": "prod"}]})]
+    (rd, td), (rc, tc) = both_clients(mk(rego), cons, objs)
+    assert td.compiled_for("K8sTest") is not None, \
+        "computed-key bracket must device-compile"
+    assert names(rc.audit().results()) == names(tc.audit().results()) == \
+        ["wrong"]
