@@ -1,0 +1,17 @@
+# gatekeeper_tpu control plane image.
+# Counterpart of the reference /Dockerfile (go build -> distroless): a
+# JAX-enabled Python base carrying the framework and its policy library.
+# For TPU nodes use a libtpu-bundled base (e.g. a jax[tpu] image) so the
+# device path runs on the hosts' accelerators; on CPU-only clusters the
+# same image evaluates through the XLA CPU backend unchanged.
+FROM python:3.12-slim AS base
+
+RUN pip install --no-cache-dir "jax[cpu]" pyyaml grpcio \
+    && useradd --uid 1000 --gid 0 gatekeeper
+
+WORKDIR /app
+COPY gatekeeper_tpu/ gatekeeper_tpu/
+
+USER 1000:999
+ENV PYTHONUNBUFFERED=1
+ENTRYPOINT ["python", "-m", "gatekeeper_tpu.control.main"]

@@ -148,6 +148,10 @@ class TpuDriver(RegoDriver):
         # must not re-upload cached tensors every audit (H2D costs seconds
         # when the chip sits behind a network tunnel)
         self._dev_cache: dict[int, tuple] = {}
+        # cost-based review_batch dispatch EMAs (_use_device_for_batch)
+        self._dev_batch_lat_s: Optional[float] = None
+        self._host_pair_rate: float = 20_000.0
+        self._dev_skips = 0
 
     # ------------------------------------------------------------- modules
 
@@ -453,15 +457,42 @@ class TpuDriver(RegoDriver):
 
     # ----------------------------------------------------- batched reviews
 
-    # batches below this size run on the interpreter: a handful of reviews
-    # is cheaper there than a (possibly cold) device dispatch
+    # batches below this size never pay a device dispatch
     MIN_DEVICE_BATCH = 4
+
+    def _use_device_for_batch(self, n_masked_pairs: int) -> bool:
+        """Cost-based dispatch: a device sweep has a fixed per-call
+        latency (milliseconds on local chips, ~100ms over a network
+        tunnel) while the host codegen path costs per evaluated pair.
+        Both are measured as EMAs at runtime, so the crossover adapts to
+        wherever the chip actually is."""
+        if self._dev_batch_lat_s is None:
+            return True  # measure the device once, then decide from data
+        host_est = n_masked_pairs / self._host_pair_rate
+        if self._dev_batch_lat_s < host_est:
+            self._dev_skips = 0
+            return True
+        # periodic re-probe: the first device sample may carry a one-off
+        # jit compile (or the chip may have gotten closer); without this
+        # a skewed EMA would shun the device forever
+        self._dev_skips += 1
+        if self._dev_skips >= 256:
+            self._dev_skips = 0
+            return True
+        return False
+
+    def _observe(self, attr: str, value: float, alpha: float = 0.3) -> None:
+        prev = getattr(self, attr)
+        setattr(self, attr, value if prev is None
+                else prev + alpha * (value - prev))
 
     def review_batch(self, target: str, reviews: list[dict]
                      ) -> list[list[Result]]:
         """Evaluate many admission reviews at once (the webhook
-        micro-batcher's entry point). Compiled kinds go through the device;
-        the rest through the interpreter per review."""
+        micro-batcher's entry point). Compiled kinds go through the device
+        when the measured device-dispatch latency beats the measured host
+        per-pair rate for this batch's workload; the rest through the
+        interpreter per review."""
         constraints = self._constraints(target)
         lookup_ns = self._namespace_lookup(target)
         inventory = self._inventory_tree(target)
@@ -489,6 +520,8 @@ class TpuDriver(RegoDriver):
                         enforcement_action=spec.get("enforcementAction")
                         or "deny",
                     ))
+        import time as _time
+
         for kind in sorted(by_kind):
             cons = by_kind[kind]
             mask = match_masks(cons, reviews, lookup_ns)
@@ -496,12 +529,16 @@ class TpuDriver(RegoDriver):
             # fails them (unresolvable namespaceSelector), so no extra work
             ct = self.compiled_for(kind)
             pairs = None
-            if ct is not None and mask.any() and \
-                    len(reviews) >= self.MIN_DEVICE_BATCH:
+            n_masked = int(mask.sum())
+            if ct is not None and n_masked and \
+                    len(reviews) >= self.MIN_DEVICE_BATCH and \
+                    self._use_device_for_batch(n_masked):
                 cand = np.flatnonzero(mask.any(axis=1))
                 cand_reviews = [reviews[int(i)] for i in cand]
                 try:
+                    t0 = _time.time()
                     fires = self.eval_compiled(ct, kind, cand_reviews, cons)
+                    self._observe("_dev_batch_lat_s", _time.time() - t0)
                     hits = np.logical_and(fires, mask[cand])
                     pairs = [(int(cand[ri]), int(ci))
                              for ri, ci in zip(*np.nonzero(hits))]
@@ -511,6 +548,9 @@ class TpuDriver(RegoDriver):
             if pairs is None:
                 pairs = [(r, c) for r in range(len(reviews))
                          for c in range(len(cons)) if mask[r, c]]
+                t0 = _time.time()
+            else:
+                t0 = None
             for r, ci in pairs:
                 constraint = cons[ci]
                 spec = constraint.get("spec")
@@ -519,4 +559,8 @@ class TpuDriver(RegoDriver):
                 out[r].extend(self._eval_template_violations(
                     target, constraint, reviews[r], enforcement, inventory,
                     None))
+            if t0 is not None and pairs:
+                host_s = _time.time() - t0
+                if host_s > 0:
+                    self._observe("_host_pair_rate", len(pairs) / host_s)
         return out
