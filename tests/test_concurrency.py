@@ -1,0 +1,375 @@
+"""Concurrency + REST-path coverage (VERDICT r2 weak #7/#8).
+
+The control plane is threaded everywhere (micro-batcher flusher, watch
+fan-out, audit loop, cert refresh) but was only tested single-threaded
+happy-path; and RestKubeClient had zero coverage (everything ran on
+FakeKube). These tests drive:
+  * RestKubeClient end-to-end against a stub apiserver (discovery, CRUD,
+    conflict/apply, not-found, poll-watch event diffing);
+  * MicroBatcher under concurrent submitters with per-request verdicts;
+  * WatchManager add/remove/replace races across threads;
+  * AuditManager sweeps overlapping constraint churn.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu.client import Backend, RegoDriver
+from gatekeeper_tpu.control.kube import (
+    Conflict,
+    FakeKube,
+    NotFound,
+    RestKubeClient,
+    WatchEvent,
+)
+from gatekeeper_tpu.control.watch import Registrar, WatchManager
+from gatekeeper_tpu.control.webhook import MicroBatcher
+from gatekeeper_tpu.target import K8sValidationTarget
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+
+# ----------------------------------------------------- stub apiserver
+
+
+class _StubApi(http.server.BaseHTTPRequestHandler):
+    """Just enough apiserver: /api/v1 discovery + namespaced pod CRUD."""
+
+    store: dict  # {(ns, name): obj}; assigned per-instance via class attr
+    rv = [1]
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code: int, body):
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _pod_path(self):
+        # /api/v1/namespaces/<ns>/pods[/<name>]
+        parts = self.path.strip("/").split("/")
+        if len(parts) >= 4 and parts[2] == "namespaces" and \
+                parts[4] == "pods":
+            name = parts[5] if len(parts) > 5 else None
+            return parts[3], name
+        if len(parts) >= 3 and parts[2] == "pods":
+            return None, (parts[3] if len(parts) > 3 else None)
+        return None, None
+
+    def do_GET(self):
+        if self.path == "/api/v1":
+            self._send(200, {"resources": [
+                {"name": "pods", "kind": "Pod", "namespaced": True},
+                {"name": "pods/status", "kind": "Pod", "namespaced": True},
+            ]})
+            return
+        if self.path == "/apis":
+            self._send(200, {"groups": []})
+            return
+        ns, name = self._pod_path()
+        if name is not None:
+            obj = self.store.get((ns, name))
+            if obj is None:
+                self._send(404, {"message": "not found"})
+            else:
+                self._send(200, obj)
+            return
+        items = [o for (o_ns, _), o in sorted(self.store.items())
+                 if ns is None or o_ns == ns]
+        self._send(200, {"kind": "PodList", "items": items})
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        ns = (body.get("metadata") or {}).get("namespace") or ""
+        name = (body.get("metadata") or {}).get("name")
+        if (ns, name) in self.store:
+            self._send(409, {"message": "exists"})
+            return
+        self.rv[0] += 1
+        body.setdefault("metadata", {})["resourceVersion"] = str(self.rv[0])
+        self.store[(ns, name)] = body
+        self._send(201, body)
+
+    def do_PUT(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        ns, name = self._pod_path()
+        cur = self.store.get((ns, name))
+        if cur is None:
+            self._send(404, {"message": "not found"})
+            return
+        sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+        if sent_rv != cur["metadata"]["resourceVersion"]:
+            self._send(409, {"message": "conflict"})
+            return
+        self.rv[0] += 1
+        body["metadata"]["resourceVersion"] = str(self.rv[0])
+        self.store[(ns, name)] = body
+        self._send(200, body)
+
+    def do_DELETE(self):
+        ns, name = self._pod_path()
+        if self.store.pop((ns, name), None) is None:
+            self._send(404, {"message": "not found"})
+        else:
+            self._send(200, {})
+
+
+@pytest.fixture
+def stub_api():
+    handler = type("H", (_StubApi,), {"store": {}, "rv": [1]})
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    client = RestKubeClient(base_url=f"http://127.0.0.1:{srv.server_port}",
+                            token="test-token")
+    try:
+        yield client, handler
+    finally:
+        srv.shutdown()
+
+
+POD_GVK = ("", "v1", "Pod")
+
+
+def pod(name, ns="d", labels=None):
+    meta = {"name": name, "namespace": ns}
+    if labels:
+        meta["labels"] = labels
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
+            "spec": {}}
+
+
+def test_rest_client_crud_and_discovery(stub_api):
+    kube, handler = stub_api
+    created = kube.create(pod("a"))
+    assert created["metadata"]["resourceVersion"]
+    assert kube.get(POD_GVK, "a", "d")["metadata"]["name"] == "a"
+    with pytest.raises(NotFound):
+        kube.get(POD_GVK, "missing", "d")
+    with pytest.raises(Conflict):
+        kube.create(pod("a"))
+    # apply: create-conflict -> get + update with current resourceVersion
+    updated = kube.apply(pod("a", labels={"x": "y"}))
+    assert updated["metadata"]["labels"] == {"x": "y"}
+    kube.create(pod("b"))
+    names = sorted(o["metadata"]["name"] for o in kube.list(POD_GVK, "d"))
+    assert names == ["a", "b"]
+    # list() fills apiVersion/kind for unstructured consumers
+    assert all(o["kind"] == "Pod" for o in kube.list(POD_GVK, "d"))
+    kube.delete(POD_GVK, "b", "d")
+    assert [o["metadata"]["name"] for o in kube.list(POD_GVK, "d")] == ["a"]
+    # stale-resourceVersion update surfaces Conflict
+    stale = kube.get(POD_GVK, "a", "d")
+    kube.apply(pod("a", labels={"v": "2"}))
+    with pytest.raises(Conflict):
+        kube.update(stale)
+
+
+def test_rest_client_poll_watch_diffs(stub_api):
+    kube, handler = stub_api
+    kube.create(pod("w1"))
+    events: list[WatchEvent] = []
+    got_initial = threading.Event()
+
+    def cb(ev):
+        events.append(ev)
+        got_initial.set()
+
+    cancel = kube.watch(POD_GVK, cb)
+    try:
+        assert got_initial.wait(5)
+        assert events[0].type == "ADDED"
+        assert events[0].object["metadata"]["name"] == "w1"
+        kube.create(pod("w2"))
+        kube.delete(POD_GVK, "w1", "d")
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            types = {(e.type, e.object["metadata"]["name"]) for e in events}
+            if ("ADDED", "w2") in types and ("DELETED", "w1") in types:
+                break
+            time.sleep(0.2)
+        types = {(e.type, e.object["metadata"]["name"]) for e in events}
+        assert ("ADDED", "w2") in types and ("DELETED", "w1") in types
+    finally:
+        cancel()
+
+
+# ------------------------------------------------- micro-batcher stress
+
+
+def test_microbatcher_concurrent_submitters():
+    client = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sneedowner"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sNeedOwner"}}},
+            "targets": [{"target": TARGET, "rego": """
+package k8sneedowner
+violation[{"msg": "no owner"}] {
+  not input.review.object.metadata.labels.owner
+}
+"""}]},
+    })
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sNeedOwner", "metadata": {"name": "c"}, "spec": {}})
+    batcher = MicroBatcher(client, max_wait=0.002, max_batch=64)
+    errs: list = []
+
+    def review(i, labeled):
+        labels = {"owner": "me"} if labeled else {}
+        return {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "name": f"p{i}", "namespace": "d", "operation": "CREATE",
+                "object": {"apiVersion": "v1", "kind": "Pod",
+                           "metadata": {"name": f"p{i}", "namespace": "d",
+                                        "labels": labels}}}
+
+    def worker(w):
+        try:
+            for j in range(40):
+                i = w * 100 + j
+                labeled = (i % 3 == 0)
+                results = batcher.submit(review(i, labeled))
+                want = 0 if labeled else 1
+                assert len(results) == want, (i, labeled, results)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    batcher.stop()
+    assert not errs, errs[:3]
+    assert batcher.batched_requests == 8 * 40
+    assert batcher.batches < 8 * 40  # batching actually happened
+
+
+# ----------------------------------------------- watch manager races
+
+
+def test_watch_manager_add_remove_races():
+    kube = FakeKube()
+    gvks = [("", "v1", k) for k in
+            ("Pod", "Service", "ConfigMap", "Secret")]
+    for g in gvks:
+        kube.register_kind(g)
+        kube.create({"apiVersion": "v1", "kind": g[2],
+                     "metadata": {"name": "seed", "namespace": "d"}})
+    wm = WatchManager(kube)
+    errs: list = []
+    stop = threading.Event()
+
+    def churn(seed):
+        reg = Registrar(f"r{seed}", wm)
+        try:
+            k = 0
+            while not stop.is_set():
+                g = gvks[(seed + k) % len(gvks)]
+                reg.add_watch(g)
+                reg.replace_watches([gvks[(seed + k + 1) % len(gvks)]])
+                reg.remove_watch(gvks[(seed + k + 1) % len(gvks)])
+                k += 1
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    def producer():
+        i = 0
+        try:
+            while not stop.is_set():
+                kube.create({"apiVersion": "v1", "kind": "Pod",
+                             "metadata": {"name": f"p{i}",
+                                          "namespace": "d"}})
+                i += 1
+                time.sleep(0.001)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=churn, args=(s,)) for s in range(6)]
+    threads.append(threading.Thread(target=producer))
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(5)
+    assert not errs, errs[:3]
+    # every registrar released its refs: no leaked live watches with zero
+    # registrars keeping their caches warm forever
+    for gvk, rec in wm._records.items():
+        assert rec.cancel is None or rec.registrars, gvk
+
+
+# ------------------------------------------------- audit loop overlap
+
+
+def test_audit_sweeps_overlap_constraint_churn():
+    from gatekeeper_tpu.control.audit import AuditManager
+    from gatekeeper_tpu.control.kube import FakeKube
+
+    kube = FakeKube()
+    kube.register_kind(("constraints.gatekeeper.sh", "v1beta1", "K8sNeed"),
+                       namespaced=False)
+    client = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sneed"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sNeed"}}},
+            "targets": [{"target": TARGET, "rego": """
+package k8sneed
+violation[{"msg": "always"}] { input.review.object.metadata.name }
+"""}]},
+    })
+    for i in range(10):
+        client.add_data({"apiVersion": "v1", "kind": "Namespace",
+                         "metadata": {"name": f"n{i}"}})
+    mgr = AuditManager(kube, client, interval=0.05)
+    errs: list = []
+    stop = threading.Event()
+
+    def churn():
+        i = 0
+        try:
+            while not stop.is_set():
+                con = {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                       "kind": "K8sNeed",
+                       "metadata": {"name": f"c{i % 3}"}, "spec": {}}
+                client.add_constraint(con)
+                kube.apply(con)
+                if i % 4 == 3:
+                    client.remove_constraint(con)
+                    try:
+                        kube.delete(("constraints.gatekeeper.sh", "v1beta1",
+                                     "K8sNeed"), f"c{i % 3}")
+                    except Exception:
+                        pass
+                i += 1
+                time.sleep(0.01)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    mgr.start()
+    t = threading.Thread(target=churn)
+    t.start()
+    time.sleep(1.0)
+    stop.set()
+    t.join(5)
+    mgr.stop()
+    assert not errs, errs[:3]
