@@ -5,14 +5,18 @@ Turns ragged JSON into the dense arrays the device program consumes
 number / kind code) shaped [N, K...] with per-axis pow2 bucketing so jit
 recompiles are bounded (shapes only change when a bucket grows).
 
-This is the ingest hot path that the C++ flattener (native/) accelerates;
-this numpy implementation is the reference and fallback.
+This is the ingest hot path; the C flattener (native/flatten.c) walks
+the review dicts and fills the cell arrays ~an order of magnitude faster,
+interning directly into the shared StringTable. This Python
+implementation is the semantic reference and the fallback when no
+compiler is available (differential tests pin exact equivalence,
+including intern-id assignment order).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Any, Iterable
+from typing import Any, Iterable, Optional
 
 import numpy as np
 
@@ -113,10 +117,22 @@ def _entries(node: Any):
 class Extractor:
     """Extracts one Program's object slots from a batch of reviews."""
 
-    def __init__(self, program: Program, table: StringTable):
+    def __init__(self, program: Program, table: StringTable,
+                 native: Optional[bool] = None):
         self.program = program
         self.table = table
         # axis -> list position per slot computed from segs on the fly
+        if native is False:
+            self._native = None
+        else:
+            from ..native import flatten_ext
+
+            self._native = flatten_ext()
+
+    @staticmethod
+    def _segs_wire(segs) -> tuple:
+        return tuple((1, None) if s.kind == "iter" else (0, s.name)
+                     for s in segs)
 
     def _root(self, review: dict, root: str) -> Any:
         if root == "review":
@@ -130,6 +146,13 @@ class Extractor:
         for spec in self.program.obj_slots:
             iters = [s for s in spec.segs if s.kind == "iter"]
             if not iters:
+                continue
+            if self._native is not None and isinstance(reviews, list):
+                maxes = self._native.slot_sizes(
+                    reviews, spec.root, self._segs_wire(spec.segs))
+                for s, m in zip(iters, maxes):
+                    if m > sizes.get(s.axis, 0):
+                        sizes[s.axis] = m
                 continue
             for review in reviews:
                 node = self._root(review, spec.root)
@@ -157,26 +180,51 @@ class Extractor:
         for spec in self.program.obj_slots:
             iter_axes = [s.axis for s in spec.segs if s.kind == "iter"]
             dims = tuple(axis_buckets.get(a, 1) for a in iter_axes)
+            native = self._native if isinstance(reviews, list) else None
             if spec.mode == "count":
                 counts = np.zeros((n_pad,), dtype=np.float32)
                 kinds = np.zeros((n_pad,), dtype=np.int8)
-                for n, review in enumerate(reviews):
-                    node, i = _descend_fields(
-                        self._root(review, spec.root), spec.segs, 0)
-                    if node is _MISSING or i < len(spec.segs):
-                        continue
-                    k = kind_of(node)
-                    kinds[n] = k
-                    if k in (K_ARR, K_OBJ):
-                        counts[n] = len(node)
-                    elif k == K_STR:
-                        counts[n] = len(node)
+                if native is not None:
+                    if len(reviews) > n_pad:
+                        raise IndexError(
+                            f"{len(reviews)} reviews exceed n_pad={n_pad}")
+                    native.fill_count(reviews, spec.root,
+                                      self._segs_wire(spec.segs), counts,
+                                      kinds)
+                else:
+                    for n, review in enumerate(reviews):
+                        node, i = _descend_fields(
+                            self._root(review, spec.root), spec.segs, 0)
+                        if node is _MISSING or i < len(spec.segs):
+                            continue
+                        k = kind_of(node)
+                        kinds[n] = k
+                        if k in (K_ARR, K_OBJ, K_STR):
+                            counts[n] = len(node)
                 out[spec.slot] = {"count": counts, "kind": kinds}
                 continue
             cells = Cells((n_pad,) + dims, with_keys=bool(iter_axes))
-            for n, review in enumerate(reviews):
-                self._fill(cells, (n,), self._root(review, spec.root),
-                           spec.segs, 0, dims, 0)
+            if native is not None:
+                if len(reviews) > n_pad:
+                    raise IndexError(
+                        f"{len(reviews)} reviews exceed n_pad={n_pad}")
+                # epoch syncs from the actual table growth even if the
+                # fill raises mid-batch (partial interns must not leave a
+                # stale materialize_packed cache key behind)
+                before = len(self.table._strs)
+                try:
+                    native.fill_slot(
+                        reviews, spec.root, self._segs_wire(spec.segs),
+                        tuple(int(d) for d in dims),
+                        cells.ids, cells.nums, cells.nids, cells.kinds,
+                        cells.keys, cells.key_nums, cells.key_nids,
+                        self.table._ids, self.table._strs)
+                finally:
+                    self.table.epoch += len(self.table._strs) - before
+            else:
+                for n, review in enumerate(reviews):
+                    self._fill(cells, (n,), self._root(review, spec.root),
+                               spec.segs, 0, dims, 0)
             out[spec.slot] = cells.arrays()
         return out
 

@@ -92,7 +92,12 @@ class StringTable:
 
 class MatchTables:
     """Cache of boolean match vectors over the vocab, one row per
-    (op, pattern) pair. Rows extend lazily as the vocab grows."""
+    (op, pattern) pair. Rows extend lazily as the vocab grows.
+
+    Large regex extensions (many re_match rows × many new vocab strings —
+    BASELINE config #3's shape) are batched through the device byte-NFA
+    scan (ops/regex_nfa.py) in ONE dispatch; everything else, and any
+    pattern outside the NFA subset, keeps the host re.search path."""
 
     # pattern-side transforms: "<op>@trim:<cutset>" applies the transform
     # to the pattern string at row-creation time (rego trim/trim_prefix/…
@@ -147,6 +152,56 @@ class MatchTables:
             self._data.append(np.zeros(0, dtype=bool))
             self._built_len.append(0)
         return r
+
+    def _extend_regex_rows_batched(self, V: int) -> None:
+        """Fill pending re_match row extensions through the device NFA
+        scan when the (rows × new strings) workload justifies a dispatch.
+        Rows left untouched fall through to the host path in
+        materialize()'s per-row loop."""
+        groups: dict[int, list[int]] = {}
+        for r, (op, pattern) in enumerate(self._patterns):
+            if op == "re_match" and isinstance(pattern, str) and \
+                    self._built_len[r] < V:
+                groups.setdefault(self._built_len[r], []).append(r)
+        if not groups:
+            return
+        from . import regex_nfa
+
+        for built, rows in groups.items():
+            n_new = V - built
+            if n_new * len(rows) < regex_nfa.DEVICE_CROSSOVER:
+                continue
+            progs = []
+            prog_rows = []
+            for r in rows:
+                prog = regex_nfa.try_compile_device(self._patterns[r][1])
+                if prog is not None:
+                    progs.append(prog)
+                    prog_rows.append(r)
+            if n_new * len(prog_rows) < regex_nfa.DEVICE_CROSSOVER:
+                continue
+            strings = [self.table.string(i) for i in range(built, V)]
+            # strings the byte matrix can't represent faithfully (NUL
+            # markers like the pad entry / canon-num prefix are fine to
+            # blank here and fix below; oversize or non-ascii strings
+            # veto the whole batch)
+            special_set = {k for k, s in enumerate(strings)
+                           if "\x00" in s or "\x01" in s or "\n" in s}
+            special = sorted(special_set)
+            clean = ["" if k in special_set else s
+                     for k, s in enumerate(strings)]
+            if not regex_nfa.strings_scannable(clean):
+                continue
+            res = regex_nfa.scan_device(progs, regex_nfa.bytes_matrix(clean))
+            for j, r in enumerate(prog_rows):
+                row = np.array(res[j])  # jax outputs are read-only
+                pattern = self._patterns[r][1]
+                for k in special:
+                    row[k] = re.search(pattern, strings[k]) is not None
+                if built == 0:
+                    row[0] = False  # pad entry never matches
+                self._data[r] = np.concatenate([self._data[r], row])
+                self._built_len[r] = V
 
     def _eval(self, op: str, pattern: str, strings: list[str]) -> np.ndarray:
         if op in self._custom:
@@ -208,6 +263,7 @@ class MatchTables:
         """
         V = len(self.table)
         R = max(1, len(self._patterns))
+        self._extend_regex_rows_batched(V)
         out = np.zeros((R, V), dtype=bool)
         for r, (op, pattern) in enumerate(self._patterns):
             built = self._built_len[r]

@@ -1,0 +1,182 @@
+"""Native flattener conformance: the C extractor must produce
+bit-identical feature tensors AND identical intern-id assignment order
+to the Python reference — across scalar/entries/count slots, nested
+axes, numeric keys, bucket overflow, and absent paths."""
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.client import Backend
+from gatekeeper_tpu.ir import TpuDriver
+from gatekeeper_tpu.ir.features import Extractor, extract_batch
+from gatekeeper_tpu.native import flatten_ext
+from gatekeeper_tpu.ops.strtab import StringTable
+from gatekeeper_tpu.target import K8sValidationTarget
+
+pytestmark = pytest.mark.skipif(flatten_ext() is None,
+                                reason="no C compiler for the native path")
+
+TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1beta1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "k8sfeat"},
+    "spec": {
+        "crd": {"spec": {"names": {"kind": "K8sFeat"}}},
+        "targets": [{"target": "admission.k8s.gatekeeper.sh", "rego": """
+package k8sfeat
+violation[{"msg": "m"}] {
+  c := input.review.object.spec.containers[_]
+  startswith(c.image, "bad/")
+}
+violation[{"msg": "labels"}] {
+  input.review.object.metadata.labels[k] == "no"
+}
+violation[{"msg": "count"}] {
+  count(input.review.object.spec.volumes) > 3
+}
+violation[{"msg": "ports"}] {
+  c := input.review.object.spec.containers[_]
+  c.ports[_].hostPort > 100
+}
+"""}],
+    },
+}
+
+
+def reviews_fixture():
+    objs = [
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "p0", "namespace": "d",
+                      "labels": {"a": "yes", "b": "no", "n": 7}},
+         "spec": {"containers": [
+             {"name": "c1", "image": "bad/x",
+              "ports": [{"hostPort": 80}, {"hostPort": 8080}]},
+             {"name": "c2", "image": "ok/y", "ports": []},
+         ], "volumes": [{"name": f"v{i}"} for i in range(5)]}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "p1", "namespace": "d"},
+         "spec": {"containers": [], "volumes": "notalist"}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "p2", "namespace": "d", "labels": {}},
+         "spec": {"containers": [
+             {"name": "x", "image": True,
+              "ports": [{"hostPort": 3.5}, {"hostPort": None}]}]}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "p3"},
+         "spec": None},
+    ]
+    return [{"kind": {"group": "", "version": "v1", "kind": "Pod"},
+             "name": o["metadata"]["name"], "object": o} for o in objs]
+
+
+def program():
+    d = TpuDriver()
+    Backend(d).new_client([K8sValidationTarget()]).add_template(TEMPLATE)
+    prog = d._programs["K8sFeat"]
+    assert prog is not None
+    return prog
+
+
+def extract_with(native: bool):
+    prog = program()
+    table = StringTable()
+    ex = Extractor(prog, table, native=native)
+    reviews = reviews_fixture()
+    sizes = ex.axis_sizes(reviews)
+    from gatekeeper_tpu.ir.features import _bucket
+
+    buckets = {a: _bucket(s) for a, s in sizes.items()}
+    feats = ex.extract(reviews, 4, buckets)
+    return feats, table, sizes
+
+
+def test_native_matches_python_exactly():
+    f_py, t_py, s_py = extract_with(native=False)
+    f_c, t_c, s_c = extract_with(native=True)
+    assert s_py == s_c
+    # identical intern tables, including assignment ORDER
+    assert t_py._strs == t_c._strs
+    assert f_py.keys() == f_c.keys()
+    for slot in f_py:
+        for name in f_py[slot]:
+            a, b = f_py[slot][name], f_c[slot][name]
+            if a.dtype == np.float32:
+                assert ((a == b) | (np.isnan(a) & np.isnan(b))).all(), \
+                    (slot, name)
+            else:
+                assert (a == b).all(), (slot, name)
+
+
+def test_native_end_to_end_audit_parity():
+    """Full audit through the TpuDriver must agree with the native
+    extractor disabled (same firing pairs, same messages)."""
+    import os
+
+    def run(disable: bool):
+        if disable:
+            os.environ["GATEKEEPER_TPU_NATIVE"] = "0"
+        try:
+            import gatekeeper_tpu.native as nat
+
+            nat._tried = False
+            nat._flatten = None
+            d = TpuDriver()
+            c = Backend(d).new_client([K8sValidationTarget()])
+            c.add_template(TEMPLATE)
+            c.add_constraint({
+                "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": "K8sFeat", "metadata": {"name": "c"}, "spec": {}})
+            for r in reviews_fixture():
+                c.add_data(r["object"])
+            return sorted((x.resource["metadata"]["name"], x.msg)
+                          for x in c.audit().results())
+        finally:
+            os.environ.pop("GATEKEEPER_TPU_NATIVE", None)
+            nat._tried = False
+            nat._flatten = None
+
+    with_native = run(disable=False)
+    without = run(disable=True)
+    assert with_native == without and len(with_native) >= 3
+
+
+def test_extract_batch_smoke_large():
+    """Randomized wider batch: native path equals Python on every array."""
+    import random
+
+    rng = random.Random(5)
+    objs = []
+    for i in range(200):
+        containers = [{"name": f"c{j}",
+                       "image": rng.choice(["a/x", "b/y", f"u/{i}-{j}"]),
+                       "ports": [{"hostPort": rng.randrange(2000)}
+                                 for _ in range(rng.randrange(3))]}
+                      for j in range(rng.randrange(4))]
+        objs.append({"apiVersion": "v1", "kind": "Pod",
+                     "metadata": {"name": f"p{i}", "namespace": "d",
+                                  "labels": {f"k{rng.randrange(6)}":
+                                             rng.choice(["yes", "no", "7"])
+                                             for _ in range(3)}},
+                     "spec": {"containers": containers,
+                              "volumes": [{"name": "v"}] *
+                              rng.randrange(6)}})
+    reviews = [{"kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "name": o["metadata"]["name"], "object": o} for o in objs]
+    prog = program()
+    outs = []
+    for native in (False, True):
+        table = StringTable()
+        ex = Extractor(prog, table, native=native)
+        sizes = ex.axis_sizes(reviews)
+        from gatekeeper_tpu.ir.features import _bucket
+
+        buckets = {a: _bucket(s) for a, s in sizes.items()}
+        outs.append((ex.extract(reviews, 256, buckets), table._strs))
+    (f_py, strs_py), (f_c, strs_c) = outs
+    assert strs_py == strs_c
+    for slot in f_py:
+        for name in f_py[slot]:
+            a, b = f_py[slot][name], f_c[slot][name]
+            if a.dtype == np.float32:
+                assert ((a == b) | (np.isnan(a) & np.isnan(b))).all()
+            else:
+                assert (a == b).all()

@@ -1,0 +1,140 @@
+"""Device regex NFA conformance: byte-NFA subset simulation must agree
+with Python re.search on every corpus pattern (the patterns the policy
+library actually uses) plus adversarial constructions — on both the host
+reference simulation and the single-dispatch device scan."""
+
+import re
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.ops.regex_nfa import (
+    Unsupported,
+    compile_pattern,
+    scan_vocab,
+)
+
+# every re_match/allowedRegex pattern appearing in the reference library
+# + the shipped policy library + workload generators
+CORPUS_PATTERNS = [
+    "^[0-9]+$",
+    "^[0-9]+[.][0-9]+$",
+    "^(extensions|networking.k8s.io)$",
+    "^(extensions|networking.k8s.io)/.+$",
+    "^[a-z]+.corp.example$",
+    "^[a-z]+$",
+    "^prod$|^dev$",
+    "^us-",
+    "^[a-z0-9-]+$",
+    "^cc-[0-9]+$",
+    "^[ab]$",
+    "^[a-zA-Z]+.agilebank.demo$",
+]
+
+ADVERSARIAL_PATTERNS = [
+    "", "a", "abc", "a*", "a+b?", "(ab)+c", "a|", "(a|b)*c$",
+    "^$", "x^a|b", "a$b|c", "[^a-z]", "[-a]", "[a-]", "[\\]]",
+    "\\d+\\.\\d+", "\\w+@\\w+", "ab|cd|ef", "((a|b)(c|d))+",
+    ".*middle.*", "end$", "^start", "[A-Fa-f0-9]+$",
+]
+
+STRINGS = [
+    "", "a", "b", "ab", "abc", "abcabc", "prod", "dev", "production",
+    "extensions", "networking.k8s.io", "networking.k8s.io/v1beta1",
+    "extensionsX", "1", "123", "1.5", "12.34", "..", "us-east1",
+    "team.corp.example", "teamXcorpXexample", "cc-100", "cc-",
+    "kernel.msgmax", "net.ipv4.ip_local_port_range", "middle",
+    "has middle here", "end", "the end", "endx", "start", "xstart",
+    "user@host", "DEADbeef", "a-z", "-", "]", "^", "$", "aa|bb",
+    "registry.corp.example/app:v1", "\x01n123", "runtime/default",
+]
+
+
+@pytest.mark.parametrize("pattern", CORPUS_PATTERNS + ADVERSARIAL_PATTERNS)
+def test_host_simulation_matches_re(pattern):
+    prog = compile_pattern(pattern)
+    for s in STRINGS:
+        want = re.search(pattern, s) is not None
+        got = prog.match_host(s)
+        assert got == want, (pattern, s, got, want)
+
+
+def test_device_scan_matches_re():
+    patterns = CORPUS_PATTERNS + ADVERSARIAL_PATTERNS
+    got = scan_vocab(patterns, STRINGS, force_device=True)
+    assert got is not None
+    want = np.array([[re.search(p, s) is not None for s in STRINGS]
+                     for p in patterns])
+    mism = np.argwhere(got != want)
+    assert not len(mism), [(patterns[i], STRINGS[j], bool(got[i, j]))
+                           for i, j in mism[:5]]
+
+
+def test_host_and_device_paths_agree():
+    pats = CORPUS_PATTERNS[:4]
+    host = scan_vocab(pats, STRINGS, force_device=False)
+    dev = scan_vocab(pats, STRINGS, force_device=True)
+    assert (host == dev).all()
+
+
+def test_unsupported_patterns_fall_back():
+    for pattern in ("a{3}", "(?i)abc", "(?P<x>a)", "a\\b", "é+"):
+        with pytest.raises(Unsupported):
+            compile_pattern(pattern)
+    assert scan_vocab(["a{3}"], ["aaa"]) is None
+
+
+def test_non_ascii_strings_fall_back():
+    assert scan_vocab(["^.$"], ["é"]) is None  # byte-vs-char '.' semantics
+
+
+def test_long_strings_fall_back():
+    assert scan_vocab(["^a+$"], ["a" * 300]) is None
+
+
+def test_match_tables_batched_extension_parity(monkeypatch):
+    """MatchTables' batched NFA extension must produce bit-identical
+    rows to the host re.search path (pad entry, canon-num markers, and
+    unsupported-pattern rows included)."""
+    import re as _re
+
+    from gatekeeper_tpu.ops import regex_nfa
+    from gatekeeper_tpu.ops.strtab import MatchTables, StringTable, canon_num
+
+    monkeypatch.setattr(regex_nfa, "DEVICE_CROSSOVER", 1)
+
+    def build(batched: bool):
+        st = StringTable()
+        mt = MatchTables(st)
+        for s in STRINGS:
+            st.intern(s or "x")
+        st.intern(canon_num(123))
+        pats = CORPUS_PATTERNS + ["a{3}"]  # last one: host-only fallback
+        for p in pats:
+            mt.row("re_match", p)
+        if not batched:
+            # force per-row host path by vetoing the batch
+            monkeypatch.setattr(regex_nfa, "try_compile_device",
+                                lambda p: None)
+        return mt.materialize()
+
+    # device build FIRST: the host build's monkeypatch (vetoing
+    # try_compile_device) must not leak into it
+    dev = build(batched=True)
+    host = build(batched=False)
+    assert host.shape == dev.shape
+    assert (host == dev).all()
+
+
+def test_newline_and_nul_strings_fall_back():
+    """re gives '.' and '$' special newline behavior the byte NFA does
+    not model, and NUL is the scan terminator — both must veto the
+    device path (r3 code-review findings)."""
+    import re as _re
+
+    assert scan_vocab(["a.b"], ["a\nb"]) is None
+    assert scan_vocab(["end$"], ["the end\n"]) is None
+    assert scan_vocab(["a$"], ["a\x00b"]) is None
+    # sanity on what re actually does there
+    assert _re.search("a.b", "a\nb") is None
+    assert _re.search("end$", "the end\n") is not None
