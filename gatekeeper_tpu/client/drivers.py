@@ -87,6 +87,8 @@ class RegoDriver:
         # kind -> (review, dict): per-review memo for review-pure
         # comprehensions in the codegen'd evaluator
         self._rmemo: dict[str, tuple] = {}
+        # kind -> (frozen inventory, dict): arg-pure function memo
+        self._fmemo: dict[str, tuple] = {}
         # identity-keyed freeze caches for the audit materialization loop
         # (consecutive firing pairs share the review; constraints repeat)
         self._frz_review: tuple = (None, None)
@@ -111,6 +113,7 @@ class RegoDriver:
         self._module_names.add(name)
         self._codegen.clear()
         self._rmemo.clear()
+        self._fmemo.clear()
 
     def put_modules(self, prefix: str, modules: Iterable[A.Module]) -> None:
         # mirror of PutModules upsert semantics (local.go:124-148): existing
@@ -128,6 +131,7 @@ class RegoDriver:
             self._module_names.add(name)
         self._codegen.clear()
         self._rmemo.clear()
+        self._fmemo.clear()
 
     def delete_module(self, name: str) -> bool:
         if name not in self._module_names:
@@ -136,6 +140,7 @@ class RegoDriver:
         self._module_names.discard(name)
         self._codegen.clear()
         self._rmemo.clear()
+        self._fmemo.clear()
         return True
 
     def delete_modules(self, prefix: str) -> int:
@@ -145,6 +150,7 @@ class RegoDriver:
             self._module_names.discard(n)
         self._codegen.clear()
         self._rmemo.clear()
+        self._fmemo.clear()
         return len(doomed)
 
     # ---------------------------------------------------------------- data
@@ -341,8 +347,17 @@ class RegoDriver:
             if ent is None or ent[0] is not review:
                 ent = (review, {})
                 self._rmemo[kind] = ent
+            # arg-pure function memo: scoped to the frozen inventory tree,
+            # so inventory-join projections (selector flattening etc.)
+            # evaluate once per inventory object, not once per (review ×
+            # object) pair
+            frozen_inv = self._freeze_inv(inventory)
+            fent = self._fmemo.get(kind)
+            if fent is None or fent[0] is not frozen_inv:
+                fent = (frozen_inv, {})
+                self._fmemo[kind] = fent
             try:
-                out = fn(finp, self._freeze_inv(inventory), ent[1])
+                out = fn(finp, frozen_inv, ent[1], fent[1])
             except RegoError as e:
                 raise DriverError(
                     f"evaluating {kind} violation: {e}"

@@ -37,6 +37,9 @@ class Unsupported(Exception):
     pass
 
 
+_MISS = object()  # fmemo miss sentinel (UNDEF is a legitimate result)
+
+
 # ----------------------------------------------------------- runtime helpers
 
 
@@ -209,6 +212,47 @@ class _Scope:
         return name in self.names
 
 
+def _term_vars(t, into: set) -> None:
+    """All Var names + called function names appearing in a term."""
+    if isinstance(t, A.Var):
+        into.add(t.name)
+    elif isinstance(t, A.Ref):
+        _term_vars(t.base, into)
+        for a in t.args:
+            _term_vars(a, into)
+    elif isinstance(t, A.Call):
+        if len(t.fn) == 1:
+            into.add(t.fn[0])
+        else:
+            into.add(t.fn[0])  # e.g. "data" roots mark impurity
+        for a in t.args:
+            _term_vars(a, into)
+    elif isinstance(t, A.BinOp):
+        _term_vars(t.lhs, into)
+        _term_vars(t.rhs, into)
+    elif isinstance(t, A.UnaryMinus):
+        _term_vars(t.term, into)
+    elif isinstance(t, (A.ArrayLit, A.SetLit)):
+        for x in t.items:
+            _term_vars(x, into)
+    elif isinstance(t, A.ObjectLit):
+        for k, v in t.items:
+            _term_vars(k, into)
+            _term_vars(v, into)
+    elif isinstance(t, (A.ArrayCompr, A.SetCompr)):
+        _term_vars(t.head, into)
+        for lit in t.body:
+            _term_vars(lit.expr, into)
+    elif isinstance(t, A.ObjectCompr):
+        _term_vars(t.key, into)
+        _term_vars(t.value, into)
+        for lit in t.body:
+            _term_vars(lit.expr, into)
+    elif isinstance(t, (A.Assign, A.Unify)):
+        _term_vars(t.lhs, into)
+        _term_vars(t.rhs, into)
+
+
 class ModuleCompiler:
     def __init__(self, module: A.Module):
         module = reorder_module(module)
@@ -216,11 +260,56 @@ class ModuleCompiler:
         self.rules: dict[str, list[A.Rule]] = {}
         for r in module.rules:
             self.rules.setdefault(r.name, []).append(r)
+        self.arg_pure = self._arg_pure_fns()
         self.em = _Emit()
         self.builtin_bindings: dict[tuple, str] = {}
         self.bin_bindings: dict[str, str] = {}
         self._pat_n = 0
         self._rmemo_n = 0  # review-pure comprehension memo slots
+
+    def _arg_pure_fns(self) -> set:
+        """Functions whose result depends ONLY on their arguments: no
+        input/data references, no calls to non-arg-pure user rules.
+        Their calls memoize on the (frozen, hashable) argument tuple —
+        the inventory-join hot loops re-apply the same projection
+        function to the same inventory objects once per review, so a
+        memo turns O(reviews × inventory) evaluations into O(inventory).
+        """
+        fns = {name: rules for name, rules in self.rules.items()
+               if rules[0].kind == "function"}
+        deps: dict[str, set] = {}
+        for name, rules in fns.items():
+            names: set = set()
+            for r in rules:
+                if any(lit.withs for lit in r.body):
+                    names.add("input")  # `with`: treat as impure
+                for lit in r.body:
+                    _term_vars(lit.expr, names)
+                if r.value is not None:
+                    _term_vars(r.value, names)
+                for a in r.args:
+                    _term_vars(a, names)
+            deps[name] = names
+        pure = set(fns)
+        changed = True
+        while changed:
+            changed = False
+            for name in list(pure):
+                names = deps[name]
+                if "input" in names or "data" in names:
+                    pure.discard(name)
+                    changed = True
+                    continue
+                for n in names:
+                    if n in self.rules and n not in fns:
+                        pure.discard(name)  # reads a document rule
+                        changed = True
+                        break
+                    if n in fns and n not in pure:
+                        pure.discard(name)
+                        changed = True
+                        break
+        return pure
 
     # ------------------------------------------------------------- naming
 
@@ -811,6 +900,15 @@ class ModuleCompiler:
         arity = len(rules[0].args)
         formals = [f"_a{i}" for i in range(arity)]
         self.em.w(0, f"def fn_{name}(_J, {', '.join(formals)}):")
+        memo = name in self.arg_pure
+        if memo:
+            self.em.w(1, f"_mk = ({name!r}, {', '.join(formals)})")
+            self.em.w(1, "try:")
+            self.em.w(2, "_mv = _J['fmemo'].get(_mk, _MISS)")
+            self.em.w(1, "except TypeError:")  # unhashable arg: skip memo
+            self.em.w(2, "_mk = None")
+            self.em.w(2, "_mv = _MISS")
+            self.em.w(1, "if _mv is not _MISS: return _mv")
         self.em.w(1, "_outs = []")
         for r in rules:
             if len(r.args) != arity:
@@ -835,7 +933,12 @@ class ModuleCompiler:
             chain(1, 0)
         self.em.w(1, f"if len(_outs) > 1: raise RegoError("
                      f"'function {name}: conflicting outputs')")
-        self.em.w(1, "return _outs[0] if _outs else UNDEF")
+        if memo:
+            self.em.w(1, "_mv = _outs[0] if _outs else UNDEF")
+            self.em.w(1, "if _mk is not None: _J['fmemo'][_mk] = _mv")
+            self.em.w(1, "return _mv")
+        else:
+            self.em.w(1, "return _outs[0] if _outs else UNDEF")
         self.em.w(0, "")
 
     # ----------------------------------------------------------- top level
@@ -845,16 +948,18 @@ class ModuleCompiler:
             raise Unsupported(f"no {entry} rule")
         for name in self.rules:
             self._emit_rule(name)
-        self.em.w(0, "def __evaluate__(_input, _inv, _rmemo=None):")
+        self.em.w(0, "def __evaluate__(_input, _inv, _rmemo=None, "
+                     "_fmemo=None):")
         self.em.w(1, "_J = {'input': _input, 'inv': _inv, 'memo': {}, "
-                     "'rmemo': _rmemo if _rmemo is not None else {}}")
+                     "'rmemo': _rmemo if _rmemo is not None else {}, "
+                     "'fmemo': _fmemo if _fmemo is not None else {}}")
         if self.rules[entry][0].kind == "function":
             raise Unsupported(f"{entry} is a function")
         self.em.w(1, f"return rule_{entry}(_J)")
 
         params = ["UNDEF", "FrozenDict", "RegoError", "rego_eq", "_enum",
                   "_stepv", "_call", "_callu", "_bin", "_neg", "_arr",
-                  "_setl", "_obj"]
+                  "_setl", "_obj", "_MISS"]
         bparams = list(self.builtin_bindings.values())
         cparams = list(self.bin_bindings.values())
         src = (f"def __make__({', '.join(params + bparams + cparams)}):\n"
@@ -867,7 +972,7 @@ class ModuleCompiler:
         cvals = [_BIN_SPECIAL[op] for op in self.bin_bindings]
         fn = g["__make__"](UNDEF, FrozenDict, RegoError, rego_eq, _enum,
                            _stepv, _call, _callu, _bin, _neg, _arr, _setl,
-                           _obj, *bvals, *cvals)
+                           _obj, _MISS, *bvals, *cvals)
         fn.__source__ = src  # for debugging
         return fn
 

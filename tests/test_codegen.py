@@ -295,3 +295,60 @@ violation[{"msg": msg}] {
     assert [r.msg for r in client.audit().results()] == ['labels: {"x"}']
     client.add_template(v2)  # same data revision; review identity reused
     assert client.audit().results() == []  # no annotations -> no violation
+
+
+def test_arg_pure_fn_memo_invalidates_with_inventory():
+    """Arg-pure function results memoize per frozen-inventory lifetime;
+    an inventory change must produce fresh results, and input-reading
+    functions must never be memoized across constraints."""
+    from gatekeeper_tpu.client import Backend, RegoDriver
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    client = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sdupsel"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sDupSel"}}},
+            "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                         "rego": """
+package k8sdupsel
+
+flat(svc) = out {
+  pairs := [p | v := svc.spec.selector[k]; p := concat(":", [k, v])]
+  out := concat(",", sort(pairs))
+}
+
+violation[{"msg": msg}] {
+  input.review.kind.kind == "Service"
+  mine := flat(input.review.object)
+  other := data.inventory.namespace[ns][_][_][name]
+  other.metadata.name != input.review.object.metadata.name
+  theirs := flat(other)
+  theirs == mine
+  msg := sprintf("dup of %v", [name])
+}
+"""}],
+        },
+    })
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sDupSel", "metadata": {"name": "c"}, "spec": {}})
+
+    def svc(name, sel):
+        return {"apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": name, "namespace": "d"},
+                "spec": {"selector": sel}}
+
+    client.add_data(svc("a", {"app": "x"}))
+    client.add_data(svc("b", {"app": "x"}))
+    client.add_data(svc("c", {"app": "y"}))
+    msgs = sorted(r.msg for r in client.audit().results())
+    assert msgs == ["dup of a", "dup of b"]
+    # inventory change: service c now collides too — stale memo entries
+    # must not hide it
+    client.add_data(svc("c", {"app": "x"}))
+    msgs = sorted(r.msg for r in client.audit().results())
+    assert msgs == ["dup of a", "dup of a", "dup of b", "dup of b",
+                    "dup of c", "dup of c"]
