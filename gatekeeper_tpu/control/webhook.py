@@ -32,6 +32,7 @@ from typing import Any, Optional
 from ..client import Client, ClientError
 from ..target.handler import AugmentedReview
 from . import metrics
+from .config_types import trace_enabled
 from .kube import NotFound
 from .logging import logger
 from .util import DEFAULT_ENFORCEMENT_ACTION, validate_enforcement_action
@@ -198,7 +199,22 @@ class ValidationHandler:
                 AugmentedReview(review, ns_obj))
         if not handled:
             return {"allowed": True}
-        results = self.batcher.submit(gk_review)
+        want_trace, want_dump = trace_enabled(
+            self.traces_provider(), username,
+            (group, kind.get("version") or "", kind.get("kind") or ""))
+        if want_trace:
+            # traced requests bypass the batcher: the trace is per-request
+            # (reference policy.go:290-309)
+            resps = self.opa.review(AugmentedReview(review, ns_obj),
+                                    tracing=True)
+            for name, resp in sorted(resps.by_target.items()):
+                log.info("request trace", target=name,
+                         trace=resp.trace_dump())
+            if want_dump:
+                log.info("state dump", dump=self.opa.dump())
+            results = resps.results()
+        else:
+            results = self.batcher.submit(gk_review)
         denies = []
         for r in results:
             if self.log_denies:

@@ -1,0 +1,78 @@
+"""Profiling + compiler introspection.
+
+The reference exposes pprof endpoints through controller-runtime; the
+TPU-native analogs are (a) the XLA program itself — dump the HLO of any
+compiled template to see exactly what the device executes — and (b)
+jax.profiler traces viewable in TensorBoard/Perfetto for device
+timelines. Host-side audit phases get a lightweight timer registry that
+feeds the metrics exposition.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+
+def compiled_hlo(ct, feats, params, table, derived=None,
+                 stage: str = "hlo") -> str:
+    """The compiled device program for one template's dense sweep.
+    stage: "jaxpr" | "hlo" (StableHLO text) | "optimized" (post-XLA)."""
+    import jax
+
+    args = (feats, params, table, derived or {})
+    if stage == "jaxpr":
+        return str(jax.make_jaxpr(ct._eval)(*args))
+    lowered = jax.jit(ct._eval).lower(*args)
+    if stage == "optimized":
+        return lowered.compile().as_text()
+    return lowered.as_text()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """jax.profiler trace (TensorBoard/Perfetto) around a block:
+
+        with device_trace("/tmp/gk-trace"):
+            client.audit()
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PhaseTimers:
+    """Named wall-clock phase accumulators (audit: match/sweep/
+    materialize), exposed via control.metrics when wired."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.totals[name] = self.totals.get(name, 0.0) + \
+                (time.time() - t0)
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def snapshot(self) -> dict[str, tuple[float, int]]:
+        return {k: (self.totals[k], self.counts[k]) for k in self.totals}
+
+
+_timers: Optional[PhaseTimers] = None
+
+
+def timers() -> PhaseTimers:
+    global _timers
+    if _timers is None:
+        _timers = PhaseTimers()
+    return _timers

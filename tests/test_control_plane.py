@@ -432,3 +432,63 @@ def test_deleted_constraint_not_resurrected_by_stale_event(runtime):
     out = runtime.webhook.validation.handle(admission_review(ns("anything")))
     assert out["response"]["allowed"] is True, \
         "deleted constraint still denying admissions"
+
+
+def test_webhook_tracing_via_config(caplog):
+    """Config CRD traces opt (user, kind) pairs into per-request tracing
+    (reference policy.go:290-309): the traced request bypasses the
+    batcher, its trace is logged, dump: All logs the engine state, and
+    the verdict is unchanged (r2 weak #4: the plumbing existed but
+    nothing ever called it)."""
+    import logging as _logging
+
+    from gatekeeper_tpu.client import Backend, RegoDriver
+    from gatekeeper_tpu.control.webhook import ValidationHandler
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    client = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8strace"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sTrace"}}},
+            "targets": [{"target": "admission.k8s.gatekeeper.sh", "rego": """
+package k8strace
+violation[{"msg": "traced deny"}] { input.review.object.metadata.name }
+"""}]},
+    })
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sTrace", "metadata": {"name": "c"}, "spec": {}})
+    traces = [{"user": "alice", "kind": {"group": "", "kind": "Pod"},
+               "dump": "All"}]
+    handler = ValidationHandler(client, traces_provider=lambda: traces)
+    review = {
+        "apiVersion": "admission.k8s.io/v1beta1", "kind": "AdmissionReview",
+        "request": {
+            "uid": "u1", "operation": "CREATE",
+            "userInfo": {"username": "alice"},
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "namespace": "d", "name": "p",
+            "object": {"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "p", "namespace": "d"}},
+        },
+    }
+    with caplog.at_level(_logging.INFO):
+        out = handler.handle(review)
+    assert out["response"]["allowed"] is False
+    text = "\n".join(r.message for r in caplog.records)
+    assert "request trace" in text and "state dump" in text
+    traced = [getattr(r, "structured", {}) for r in caplog.records
+              if r.message == "request trace"]
+    assert traced and "traced deny" in traced[0]["trace"]
+    # a non-matching user goes through the batcher, no trace logged
+    caplog.clear()
+    review["request"]["userInfo"]["username"] = "bob"
+    with caplog.at_level(_logging.INFO):
+        out = handler.handle(review)
+    assert out["response"]["allowed"] is False
+    assert "request trace" not in "\n".join(
+        r.message for r in caplog.records)
+    handler.batcher.stop()

@@ -379,3 +379,34 @@ def test_f32_tie_negated_exact_violation_found():
          "spec": {"replicas": 16777217}},
     ]
     run_both(NEGATED_BIGNUM_TEMPLATE, [constraint], objs)
+
+
+def test_compiled_hlo_introspection():
+    """The device program of any compiled template can be dumped at
+    jaxpr / StableHLO / optimized-HLO stages (aux-subsystem parity with
+    the reference's pprof-style introspection)."""
+    from gatekeeper_tpu.parallel.workload import build_eval_setup
+    from gatekeeper_tpu.utils.profiling import compiled_hlo
+
+    _, ct, feats, params, table, derived, _, _ = build_eval_setup(8, 2)
+    jx = compiled_hlo(ct, feats, params, table, derived, stage="jaxpr")
+    assert "lambda" in jx or "let" in jx
+    hlo = compiled_hlo(ct, feats, params, table, derived, stage="hlo")
+    assert "func" in hlo or "HloModule" in hlo
+    opt = compiled_hlo(ct, feats, params, table, derived,
+                       stage="optimized")
+    assert "HloModule" in opt or "func" in opt
+
+
+def test_phase_timers():
+    import time as _t
+
+    from gatekeeper_tpu.utils.profiling import PhaseTimers
+
+    pt = PhaseTimers()
+    with pt.phase("sweep"):
+        _t.sleep(0.01)
+    with pt.phase("sweep"):
+        pass
+    snap = pt.snapshot()
+    assert snap["sweep"][1] == 2 and snap["sweep"][0] >= 0.01
