@@ -253,3 +253,166 @@ BUILTINS: dict[tuple, Any] = {
     ("trace",): lambda *a: True,
     ("print",): lambda *a: True,
 }
+
+
+# ------------------------------------------------- breadth batch (r3)
+# Builtins beyond the reference corpus' needs, for policy portability:
+# the OPA v0.2x surface k8s policies most commonly reach for.
+
+
+def _bi_json_marshal(v):
+    import json as _json
+
+    from ..utils.values import thaw
+
+    try:
+        return _json.dumps(thaw(v), sort_keys=True,
+                           separators=(",", ":"))
+    except (TypeError, ValueError) as e:
+        raise BuiltinError(f"json.marshal: {e}") from None
+
+
+def _bi_json_unmarshal(s):
+    import json as _json
+
+    from ..utils.values import freeze
+
+    try:
+        return freeze(_json.loads(_need_str(s, "json.unmarshal")))
+    except ValueError as e:
+        raise BuiltinError(f"json.unmarshal: {e}") from None
+
+
+def _b64(codec, name):
+    import base64 as _b
+
+    fn = getattr(_b, codec)
+
+    def run(s):
+        try:
+            return fn(_need_str(s, name).encode()).decode()
+        except Exception as e:  # noqa: BLE001
+            raise BuiltinError(f"{name}: {e}") from None
+
+    return run
+
+
+def _b64dec(codec, name):
+    import base64 as _b
+
+    fn = getattr(_b, codec)
+
+    def run(s):
+        try:
+            return fn(_need_str(s, name).encode()).decode()
+        except Exception as e:  # noqa: BLE001
+            raise BuiltinError(f"{name}: {e}") from None
+
+    return run
+
+
+def _bi_glob_match(pattern, delimiters, value):
+    """OPA glob.match subset: *, **, ?, [classes], {alt,ernates};
+    bare * and ? do not cross a delimiter (default ".")."""
+    pattern = _need_str(pattern, "glob.match")
+    value = _need_str(value, "glob.match")
+    if delimiters is None:
+        delims = ["."]
+    else:
+        delims = [_need_str(d, "glob.match")
+                  for d in _iterable(delimiters, "glob.match")] or ["."]
+    d = re.escape("".join(delims))
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "*":
+            if pattern[i:i + 2] == "**":
+                out.append(".*")
+                i += 2
+            else:
+                out.append(f"[^{d}]*")
+                i += 1
+        elif c == "?":
+            out.append(f"[^{d}]")
+            i += 1
+        elif c == "[":
+            j = pattern.find("]", i + 1)
+            if j < 0:
+                raise BuiltinError("glob.match: unterminated class")
+            out.append(pattern[i:j + 1])
+            i = j + 1
+        elif c == "{":
+            j = pattern.find("}", i + 1)
+            if j < 0:
+                raise BuiltinError("glob.match: unterminated alternates")
+            alts = pattern[i + 1:j].split(",")
+            out.append("(" + "|".join(re.escape(a) for a in alts) + ")")
+            i = j + 1
+        else:
+            out.append(re.escape(c))
+            i += 1
+    return re.fullmatch("".join(out), value) is not None
+
+
+def _bi_numbers_range(a, b):
+    lo = int(_need_num(a, "numbers.range"))
+    hi = int(_need_num(b, "numbers.range"))
+    step = 1 if hi >= lo else -1
+    return tuple(range(lo, hi + step, step))
+
+
+def _bi_union(sets):
+    out: frozenset = frozenset()
+    for s in _iterable(sets, "union"):
+        out |= _need(s, "set", "union")
+    return out
+
+
+def _bi_intersection(sets):
+    items = [_need(s, "set", "intersection")
+             for s in _iterable(sets, "intersection")]
+    if not items:
+        return frozenset()
+    out = items[0]
+    for s in items[1:]:
+        out &= s
+    return out
+
+
+def _trim_side(side):
+    def run(s, cutset):
+        v = _need_str(s, f"trim_{side}")
+        cut = _need_str(cutset, f"trim_{side}")
+        if not cut:
+            return v
+        return v.lstrip(cut) if side == "left" else v.rstrip(cut)
+
+    return run
+
+
+BUILTINS.update({
+    ("json", "marshal"): _bi_json_marshal,
+    ("json", "unmarshal"): _bi_json_unmarshal,
+    ("base64", "encode"): _b64("b64encode", "base64.encode"),
+    ("base64", "decode"): _b64dec("b64decode", "base64.decode"),
+    ("base64url", "encode"): _b64("urlsafe_b64encode", "base64url.encode"),
+    ("base64url", "decode"): _b64dec("urlsafe_b64decode",
+                                     "base64url.decode"),
+    ("glob", "match"): _bi_glob_match,
+    ("numbers", "range"): _bi_numbers_range,
+    ("union",): _bi_union,
+    ("intersection",): _bi_intersection,
+    ("type_name",): type_name,
+    ("trim_left",): _trim_side("left"),
+    ("trim_right",): _trim_side("right"),
+    ("trim_prefix",): lambda s, p: _need_str(s, "trim_prefix")[
+        len(_need_str(p, "trim_prefix")):]
+    if _need_str(s, "trim_prefix").startswith(_need_str(p, "trim_prefix"))
+    else _need_str(s, "trim_prefix"),
+    ("trim_suffix",): lambda s, p: _need_str(s, "trim_suffix")[
+        : len(_need_str(s, "trim_suffix")) - len(_need_str(p, "trim_suffix"))]
+    if _need_str(p, "trim_suffix")
+    and _need_str(s, "trim_suffix").endswith(_need_str(p, "trim_suffix"))
+    else _need_str(s, "trim_suffix"),
+})

@@ -232,3 +232,45 @@ def test_reference_target_matcher_suites():
     # suites, the regolib tests are not run by the reference's CI.
     failed = [n for n in failed if not n.endswith(":test_with_undefined_ns")]
     assert not failed, f"{len(failed)}/{len(all_results)} matcher tests failed: {failed}"
+
+
+def test_breadth_builtins():
+    """r3 breadth batch: json/base64/urlquery-free glob/range/sets/trim
+    builtins match OPA-documented semantics."""
+    from gatekeeper_tpu.rego.interp import Interpreter, UNDEF
+    from gatekeeper_tpu.rego.parser import parse_module
+    from gatekeeper_tpu.utils.values import thaw
+
+    cases = {
+        "a": ('json.marshal({"b": [1, "x"], "a": true})',
+              '{"a":true,"b":[1,"x"]}'),
+        "b": ('json.unmarshal("[1, {\\"k\\": \\"v\\"}]")',
+              [1, {"k": "v"}]),
+        "c": ('base64.encode("hi")', "aGk="),
+        "d": ('base64.decode("aGk=")', "hi"),
+        "e": ('glob.match("*.example.com", [], "api.example.com")', True),
+        "f": ('glob.match("*.example.com", [], "a.b.example.com")', False),
+        "g": ('glob.match("**.example.com", [], "a.b.example.com")', True),
+        "h": ('glob.match("{api,web}.corp", [], "web.corp")', True),
+        "i": ("numbers.range(1, 4)", [1, 2, 3, 4]),
+        "j": ("numbers.range(3, 1)", [3, 2, 1]),
+        "k": ("union({{1, 2}, {2, 3}})", [1, 2, 3]),  # thaw: set -> list
+        "l": ("intersection({{1, 2}, {2, 3}})", [2]),
+        "m": ('type_name([1])', "array"),
+        "n": ('trim_left("xxabcxx", "x")', "abcxx"),
+        "o": ('trim_right("xxabcxx", "x")', "xxabc"),
+        "p": ('trim_prefix("k8s.io/foo", "k8s.io/")', "foo"),
+        "q": ('trim_suffix("name.yaml", ".yaml")', "name"),
+        "r": ('trim_suffix("name.yaml", ".json")', "name.yaml"),
+    }
+    rules = "\n".join(f"{name} = out {{ out := {expr} }}"
+                      for name, (expr, _) in cases.items())
+    mod = parse_module("package t\n" + rules)
+    interp = Interpreter({"m": mod})
+    for name, (expr, want) in cases.items():
+        got = interp.eval_rule(mod.package, name, {})
+        assert got is not UNDEF, (name, expr)
+        got = thaw(got)
+        if isinstance(got, (list, tuple, set, frozenset)):
+            got = sorted(got, key=repr)
+        assert got == want, (name, expr, got, want)
