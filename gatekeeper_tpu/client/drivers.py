@@ -89,6 +89,15 @@ class RegoDriver:
         self._rmemo: dict[str, tuple] = {}
         # kind -> (frozen inventory, dict): arg-pure function memo
         self._fmemo: dict[str, tuple] = {}
+        # kind -> {id(constraint): (constraint, dict)}: params-pure
+        # comprehension memo, one dict per constraint (valid for its
+        # lifetime; identity-checked so a replaced constraint re-derives)
+        self._pmemo: dict[str, dict] = {}
+        # kind -> dict: head-witness memo — (slot, *witness values) ->
+        # materialized head tuple. Values-keyed over pure computation, so
+        # never invalidated by data churn; cleared on module changes and
+        # capped for boundedness
+        self._hmemo: dict[str, dict] = {}
         # identity-keyed freeze caches for the audit materialization loop
         # (consecutive firing pairs share the review; constraints repeat)
         self._frz_review: tuple = (None, None)
@@ -114,6 +123,8 @@ class RegoDriver:
         self._codegen.clear()
         self._rmemo.clear()
         self._fmemo.clear()
+        self._pmemo.clear()
+        self._hmemo.clear()
 
     def put_modules(self, prefix: str, modules: Iterable[A.Module]) -> None:
         # mirror of PutModules upsert semantics (local.go:124-148): existing
@@ -132,6 +143,8 @@ class RegoDriver:
         self._codegen.clear()
         self._rmemo.clear()
         self._fmemo.clear()
+        self._pmemo.clear()
+        self._hmemo.clear()
 
     def delete_module(self, name: str) -> bool:
         if name not in self._module_names:
@@ -141,6 +154,8 @@ class RegoDriver:
         self._codegen.clear()
         self._rmemo.clear()
         self._fmemo.clear()
+        self._pmemo.clear()
+        self._hmemo.clear()
         return True
 
     def delete_modules(self, prefix: str) -> int:
@@ -151,6 +166,8 @@ class RegoDriver:
         self._codegen.clear()
         self._rmemo.clear()
         self._fmemo.clear()
+        self._pmemo.clear()
+        self._hmemo.clear()
         return len(doomed)
 
     # ---------------------------------------------------------------- data
@@ -166,6 +183,11 @@ class RegoDriver:
             # constraint churn leaves the inventory-review/signature/tree
             # caches valid — only actual inventory writes invalidate them
             self._data_rev += 1
+        else:
+            # bound growth: dead constraint objects would pin stale
+            # per-constraint memo dicts (identity checks keep them safe,
+            # clearing keeps them small)
+            self._pmemo.clear()
 
     def delete_data(self, path: tuple) -> bool:
         if not path:
@@ -176,6 +198,8 @@ class RegoDriver:
         self._frz_inv = (None, None)
         if path[0] != "constraints":
             self._data_rev += 1
+        else:
+            self._pmemo.clear()
         return out
 
     def get_data(self, path: tuple) -> Any:
@@ -336,10 +360,8 @@ class RegoDriver:
         out = _MISSING_OUT = object()
         fn = self._codegen_for(target, kind) if trace is None else None
         if fn is not None:
-            finp = FrozenDict((
-                ("review", self._freeze_review(review)),
-                ("parameters", self._freeze_params(constraint, parameters)),
-            ))
+            frz_review = self._freeze_review(review)
+            frz_params = self._freeze_params(constraint, parameters)
             # review-pure comprehension memo: audit materialization is
             # row-major, so consecutive calls share the review — reuse its
             # review-only subresults across the constraints it fired
@@ -356,8 +378,26 @@ class RegoDriver:
             if fent is None or fent[0] is not frozen_inv:
                 fent = (frozen_inv, {})
                 self._fmemo[kind] = fent
+            # params-pure memo: one dict per constraint object
+            pmap = self._pmemo.setdefault(kind, {})
+            pent = pmap.get(id(constraint))
+            if pent is None or pent[0] is not constraint:
+                pent = (constraint, {})
+                pmap[id(constraint)] = pent
+            # head-witness memo: cross-review AND cross-constraint
+            hm = self._hmemo.get(kind)
+            if hm is None:
+                hm = self._hmemo[kind] = {}
+            elif len(hm) > 500_000:
+                hm.clear()
             try:
-                out = fn(finp, frozen_inv, ent[1], fent[1])
+                if fn.__sections__:
+                    out = fn(frz_review, frz_params, frozen_inv, ent[1],
+                             fent[1], pent[1], hm)
+                else:
+                    finp = FrozenDict((("review", frz_review),
+                                       ("parameters", frz_params)))
+                    out = fn(finp, frozen_inv, ent[1], fent[1], pent[1], hm)
             except RegoError as e:
                 raise DriverError(
                     f"evaluating {kind} violation: {e}"
@@ -406,6 +446,138 @@ class RegoDriver:
                 enforcement_action=enforcement,
             ))
         return results
+
+    def materialize_pairs(self, target: str, cons: list, pair_reviews: list,
+                          rows, cols, inventory: Any) -> list[Result]:
+        """Batched exact materialization of firing (review, constraint)
+        pairs, row-major. Semantically identical to calling
+        _eval_template_violations per pair (the audit differential tests
+        assert that), but hoists per-constraint context (frozen params,
+        enforcement, plain copy, params-memo) and per-review context
+        (frozen review, review-memo) out of the pair loop, and caches
+        thawed msg/details per distinct violation object — the
+        head-witness memo makes those shared across pairs, so the
+        million-pair audit tail thaws each distinct witness once.
+        Results share constraint/details structures (callers treat
+        results as read-only, as they already must for .constraint)."""
+        if not len(rows):
+            return []
+        kind = cons[0].get("kind")
+        fn = self._codegen_for(target, kind)
+        if fn is None:
+            out: list[Result] = []
+            for ri, ci in zip(rows, cols):
+                c = cons[int(ci)]
+                spec = c.get("spec")
+                spec = spec if isinstance(spec, dict) else {}
+                out.extend(self._eval_template_violations(
+                    target, c, pair_reviews[int(ri)],
+                    spec.get("enforcementAction") or "deny", inventory,
+                    None))
+            return out
+        # per-constraint context, built once
+        n_c = len(cons)
+        frz_params: list = [None] * n_c
+        enforce: list = [None] * n_c
+        plain: list = [None] * n_c
+        pmemos: list = [None] * n_c
+        pmap = self._pmemo.setdefault(kind, {})
+        for ci in range(n_c):
+            c = cons[ci]
+            spec = c.get("spec")
+            spec = spec if isinstance(spec, dict) else {}
+            p = spec.get("parameters")
+            frz_params[ci] = self._freeze_params(c, p if p is not None
+                                                 else {})
+            enforce[ci] = spec.get("enforcementAction") or "deny"
+            plain[ci] = self._constraint_plain(c)
+            pe = pmap.get(id(c))
+            if pe is None or pe[0] is not c:
+                pe = (c, {})
+                pmap[id(c)] = pe
+            pmemos[ci] = pe[1]
+        frozen_inv = self._freeze_inv(inventory)
+        fent = self._fmemo.get(kind)
+        if fent is None or fent[0] is not frozen_inv:
+            fent = (frozen_inv, {})
+            self._fmemo[kind] = fent
+        fmemo = fent[1]
+        hm = self._hmemo.get(kind)
+        if hm is None:
+            hm = self._hmemo[kind] = {}
+        elif len(hm) > 500_000:
+            hm.clear()
+        sections = fn.__sections__
+        vcache: dict[int, tuple] = {}  # id(violation) -> (msg, details)
+        out = []
+        append = out.append
+        cur_ri = -1
+        frz_review = None
+        review = None
+        rmemo: dict = {}
+        for ri, ci in zip(rows, cols):
+            if ri != cur_ri:
+                cur_ri = ri
+                review = pair_reviews[int(ri)]
+                frz_review = self._freeze_review(review)
+                ent = self._rmemo.get(kind)
+                if ent is None or ent[0] is not review:
+                    ent = (review, {})
+                    self._rmemo[kind] = ent
+                rmemo = ent[1]
+            ci = int(ci)
+            if fn is None:  # demoted mid-batch: stay on the fallback
+                out.extend(self._eval_template_violations(
+                    target, cons[ci], review, enforce[ci], inventory,
+                    None))
+                continue
+            try:
+                if sections:
+                    res = fn(frz_review, frz_params[ci], frozen_inv, rmemo,
+                             fmemo, pmemos[ci], hm)
+                else:
+                    finp = FrozenDict((("review", frz_review),
+                                       ("parameters", frz_params[ci])))
+                    res = fn(finp, frozen_inv, rmemo, fmemo, pmemos[ci], hm)
+            except RegoError as e:
+                raise DriverError(
+                    f"evaluating {kind} violation: {e}") from e
+            except Exception as e:
+                log.warning("codegen evaluator for %s failed (%s: %s); "
+                            "falling back to the interpreter",
+                            kind, type(e).__name__, e)
+                self._codegen[(target, kind)] = None
+                fn = None
+                out.extend(self._eval_template_violations(
+                    target, cons[ci], review, enforce[ci], inventory,
+                    None))
+                continue
+            if res is UNDEF or not res:
+                continue
+            ordered = (tuple(res) if len(res) == 1
+                       else sorted(res, key=sort_key))
+            for r in ordered:
+                ent2 = vcache.get(id(r))
+                if ent2 is None or ent2[0] is not r:
+                    if not isinstance(r, FrozenDict) or "msg" not in r:
+                        raise DriverError(
+                            f"template {kind}: violation output must be "
+                            f"an object with msg, got {thaw(r)!r}")
+                    msg = r["msg"]
+                    if not isinstance(msg, str):
+                        raise DriverError(
+                            f"template {kind}: msg must be a string")
+                    details = thaw(r["details"]) if "details" in r else {}
+                    ent2 = (r, msg, details)
+                    vcache[id(r)] = ent2
+                append(Result(
+                    msg=ent2[1],
+                    metadata={"details": ent2[2]},
+                    constraint=plain[ci],
+                    review=review,
+                    enforcement_action=enforce[ci],
+                ))
+        return out
 
     # ---------------------------------------------------------- store views
 

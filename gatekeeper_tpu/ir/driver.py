@@ -25,7 +25,7 @@ from typing import Any, Iterable, Optional
 
 import numpy as np
 
-from ..client.drivers import RegoDriver
+from ..client.drivers import DriverError, RegoDriver
 from ..client.types import Result
 from ..ops.derived import (
     DerivedTables,
@@ -39,7 +39,7 @@ from ..ops.strtab import MatchTables, StringTable
 from ..rego import ast as A
 from ..target.batch import match_masks
 from .compile import Uncompilable, compile_template
-from .evaljax import CompiledTemplate, EvalError
+from .evaljax import CompiledTemplate, EvalError, _param_c
 from .features import extract_batch
 from .params import ParamEncodeError, encode_params
 
@@ -127,6 +127,18 @@ def merge_template_modules(mods: list) -> Optional[A.Module]:
     return dc_replace(entry, rules=tuple(fixed))
 
 
+def _expand_parameterless(rows, cols, c_dev: int, n_cons: int):
+    """A parameterless program has no C axis on device (verdicts are
+    [N, 1], constraint-independent); expand each firing row to every
+    constraint, preserving row-major order, exactly as the dense
+    [N, 1] & mask[N, C] broadcast did."""
+    if c_dev == 1 and n_cons > 1:
+        n_pairs = len(rows)
+        rows = np.repeat(rows, n_cons)
+        cols = np.tile(np.arange(n_cons, dtype=cols.dtype), n_pairs)
+    return rows, cols
+
+
 class TpuDriver(RegoDriver):
     def __init__(self):
         super().__init__()
@@ -148,6 +160,12 @@ class TpuDriver(RegoDriver):
         # must not re-upload cached tensors every audit (H2D costs seconds
         # when the chip sits behind a network tunnel)
         self._dev_cache: dict[int, tuple] = {}
+        # audit match-mask cache: (target, kind) -> (gen-key, reviews,
+        # mask). The mask is a pure function of (constraints, cached
+        # review list, namespaces), all covered by the generation
+        # counters — steady-state sweeps were rebuilding an identical
+        # [N_reviews x N_cons] bool array every audit
+        self._mask_cache: dict = {}
         # cost-based review_batch dispatch EMAs (_use_device_for_batch)
         self._dev_batch_lat_s: Optional[float] = None
         self._host_pair_rate: float = 20_000.0
@@ -320,10 +338,21 @@ class TpuDriver(RegoDriver):
                                                     sig_cache))
         return results
 
+    def _match_mask(self, target, kind, cons, reviews, lookup_ns,
+                    sig_cache):
+        key = (self._data_rev, self._constraint_gen)
+        ent = self._mask_cache.get((target, kind))
+        if ent is not None and ent[0] == key and ent[1] is reviews:
+            return ent[2]
+        mask = match_masks(cons, reviews, lookup_ns, sig_cache)
+        self._mask_cache[(target, kind)] = (key, reviews, mask)
+        return mask
+
     def _audit_interp(self, target, kind, cons, reviews, lookup_ns,
                       inventory, trace, sig_cache=None) -> list[Result]:
         out: list[Result] = []
-        mask = match_masks(cons, reviews, lookup_ns, sig_cache)
+        mask = self._match_mask(target, kind, cons, reviews, lookup_ns,
+                                sig_cache)
         for r, review in enumerate(reviews):
             for c, constraint in enumerate(cons):
                 if not mask[r, c]:
@@ -338,7 +367,8 @@ class TpuDriver(RegoDriver):
     def _audit_compiled(self, target, kind, ct: CompiledTemplate, cons,
                         reviews, lookup_ns, inventory, trace,
                         sig_cache=None) -> list[Result]:
-        mask = match_masks(cons, reviews, lookup_ns, sig_cache)
+        mask = self._match_mask(target, kind, cons, reviews, lookup_ns,
+                                sig_cache)
         cand = np.flatnonzero(mask.any(axis=1))
         if cand.size == 0:
             return []
@@ -346,6 +376,28 @@ class TpuDriver(RegoDriver):
         # key pins the exact candidate set; constraint churn that does not
         # change membership keeps the (expensive) extraction cached
         feat_key = (self._data_gen, hash(cand.tobytes()))
+        if trace is None:
+            # pipelined: every slab's device sweep+gather is dispatched
+            # up front; the host materializes slab k's messages while the
+            # device computes slab k+1 — the audit costs ~max(sweep,
+            # materialize) instead of their sum
+            out: list[Result] = []
+            try:
+                for rows, cols in self.eval_compiled_pairs_slabbed(
+                        ct, kind, cand_reviews, cons, feat_key=feat_key):
+                    keep = mask[cand[rows], cols]
+                    out.extend(self.materialize_pairs(
+                        target, cons, cand_reviews, rows[keep], cols[keep],
+                        inventory))
+            except DriverError:
+                raise  # template-semantic error: not a device demotion
+            except Exception as e:
+                self._demote(kind, "audit-eval", e)
+                self._compiled[kind] = None
+                return self._audit_interp(target, kind, cons, reviews,
+                                          lookup_ns, inventory, trace,
+                                          sig_cache)
+            return out
         try:
             rows, cols = self.eval_compiled_pairs(ct, kind, cand_reviews,
                                                   cons, feat_key=feat_key)
@@ -357,7 +409,7 @@ class TpuDriver(RegoDriver):
             return self._audit_interp(target, kind, cons, reviews,
                                       lookup_ns, inventory, trace, sig_cache)
         keep = mask[cand[rows], cols]
-        out: list[Result] = []
+        out = []
         for ri, ci in zip(rows[keep], cols[keep]):
             review = cand_reviews[int(ri)]
             constraint = cons[int(ci)]
@@ -391,22 +443,28 @@ class TpuDriver(RegoDriver):
                                                         cons, feat_key)
         rows, cols = ct.fires_pairs(feats, enc, table, derived,
                                     n_true=len(reviews))
-        # a parameterless program has no C axis on device (verdicts are
-        # [N, 1], constraint-independent); expand each firing row to every
-        # constraint, preserving row-major order, exactly as the dense
-        # [N, 1] & mask[N, C] broadcast did
-        c_dev = 1
-        for arrs in enc.values():
-            for a in arrs.values():
-                c_dev = a.shape[0]
-                break
-            break
-        if c_dev == 1 and len(cons) > 1:
-            C = len(cons)
-            n_pairs = len(rows)
-            rows = np.repeat(rows, C)
-            cols = np.tile(np.arange(C, dtype=cols.dtype), n_pairs)
-        return rows, cols
+        return _expand_parameterless(rows, cols, _param_c(enc), len(cons))
+
+    def eval_compiled_pairs_slabbed(self, ct: CompiledTemplate, kind: str,
+                                    reviews: list[dict], cons: list[dict],
+                                    feat_key=None):
+        """Iterator form of eval_compiled_pairs over N-axis slabs, with
+        every slab's device work dispatched before the first yield (see
+        CompiledTemplate.fires_pairs_slabbed) — the audit's
+        sweep/materialize pipeline."""
+        feats, enc, table, derived = self._prepare_eval(ct, kind, reviews,
+                                                        cons, feat_key)
+        c_dev = _param_c(enc)
+        # two slabs: the second sweep overlaps the first slab's host
+        # materialization. More slabs lose to the per-fetch roundtrip on
+        # a network-tunneled chip (~0.1s each)
+        chunk = 8192
+        half = (len(reviews) + 1) // 2
+        slab = max(chunk * 4, ((half + chunk - 1) // chunk) * chunk)
+        for rows, cols in ct.fires_pairs_slabbed(feats, enc, table, derived,
+                                                 chunk=chunk, slab=slab,
+                                                 n_true=len(reviews)):
+            yield _expand_parameterless(rows, cols, c_dev, len(cons))
 
     def _prepare_eval(self, ct: CompiledTemplate, kind: str,
                       reviews: list[dict], cons: list[dict], feat_key):

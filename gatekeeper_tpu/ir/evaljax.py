@@ -580,6 +580,31 @@ def _eval_clause(plan: _ClausePlan, feats, params, table, derived):
     return jnp.broadcast_to(success, (n, c))
 
 
+def _param_c(params: dict) -> int:
+    """Leading C dim of the first param array (1 for parameterless
+    programs, whose device verdicts are constraint-independent)."""
+    for arrs in params.values():
+        for a in arrs.values():
+            return a.shape[0]
+    return 1
+
+
+def _decode_row_blocks(arr: np.ndarray, rcount: int, c: int):
+    """(rows, cols) row-major from a _gather_rows block: unpack each
+    firing row's column bitmask on host (vectorized numpy; sub-ms even
+    for thousands of rows)."""
+    if rcount == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z.copy()
+    body = arr[1:1 + rcount]
+    rows_idx = body[:, 0].astype(np.int64)
+    sub = body[:, 1:]
+    bits = (sub[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    flat = bits.reshape(rcount, -1)[:, :c].astype(bool)
+    r_rep, cols = np.nonzero(flat)
+    return rows_idx[r_rep], cols.astype(np.int64)
+
+
 class CompiledTemplate:
     """Device-evaluable filter for one template."""
 
@@ -593,8 +618,7 @@ class CompiledTemplate:
         self._fn = jax.jit(self._eval)
         self._scan_cache: dict[int, Any] = {}
         self._pairs_cache: dict[tuple, Any] = {}
-        # remembered nonzero capacities (see fires_pairs)
-        self._pairs_cap = 1024
+        # remembered firing-row gather capacity (see _gather_rows)
         self._rows_cap = 256
 
     def _eval(self, feats, params, table, derived):
@@ -632,16 +656,8 @@ class CompiledTemplate:
                 lambda a: jnp.pad(a, [(0, pad_n - n)] + [(0, 0)] *
                                   (a.ndim - 1)), feats)
         out = self._fn_scan(feats, params, match_table, derived, chunk)
-        # slice the bit-unpack padding back to the true C: the first param
-        # array's leading dim, or 1 when the program has no parameters
-        # (_eval_clause broadcasts C=1 then)
-        c = 1
-        for arrs in params.values():
-            for a in arrs.values():
-                c = a.shape[0]
-                break
-            break
-        return np.asarray(out)[:n, :c]
+        # slice the bit-unpack padding back to the true C
+        return np.asarray(out)[:n, :_param_c(params)]
 
     def _fn_scan(self, feats, params, match_table, derived, chunk: int):
         """Verdicts return bit-packed over C (32x smaller device→host
@@ -712,12 +728,7 @@ class CompiledTemplate:
         n = next(iter(next(iter(feats.values())).values())).shape[0]
         if n_true is not None:
             n = min(n, n_true)
-        c = 1
-        for arrs in params.values():
-            for a in arrs.values():
-                c = a.shape[0]
-                break
-            break
+        c = _param_c(params)
         if next(iter(next(iter(feats.values())).values())).shape[0] <= chunk:
             fires = self.fires(feats, params, match_table, derived)
             rows, cols = np.nonzero(fires[:n, :c])
@@ -730,36 +741,139 @@ class CompiledTemplate:
                                   (a.ndim - 1)), feats)
         packed = self._packed_device(feats, params, match_table, derived,
                                      chunk)
-        cap, rcap = self._pairs_cap, self._rows_cap
+        rcap = self._rows_cap
         while True:
-            idx, count, rcount = self._gather_pairs(packed, n, cap, rcap)
-            count, rcount = int(count), int(rcount)
-            if count <= cap and rcount <= rcap:
+            arr = np.asarray(self._gather_rows(packed, n, rcap))
+            rcount = int(arr[0, 0])
+            if rcount <= rcap:
                 break
-            cap = max(cap, 1 << (count - 1).bit_length())
             rcap = max(rcap, 1 << (rcount - 1).bit_length())
-        self._pairs_cap = max(1024, (1 << (count - 1).bit_length())
-                              if count > 1 else 1024)
         self._rows_cap = max(256, (1 << (rcount - 1).bit_length())
                              if rcount > 1 else 256)
-        idx = np.asarray(idx[:count], dtype=np.int64)
-        w32 = int(packed.shape[1]) * 32
-        rows, cols = idx // w32, idx % w32
-        keep = cols < c  # bit-pack padding columns never fire, but be safe
-        if not keep.all():
-            rows, cols = rows[keep], cols[keep]
-        return rows, cols
+        return _decode_row_blocks(arr, rcount, c)
 
-    def _gather_pairs(self, packed, n: int, cap: int, rcap: int):
-        """Device pair gather: flat firing indices (first `cap`, row-major,
-        fill = total), the exact pair count, and the firing-row count.
+    def _slab_pairs_jit(self, chunk: int, slab: int, rcap: int):
+        """One fused jit per (chunk, slab, rcap): clamped dynamic-slice
+        of the FULL device-resident feature tree at a traced `start`,
+        chunked sweep, bit-pack, and firing-row gather, returning one
+        [rcap+1, W+1] row block (see _gather_rows). One device dispatch
+        + one fetch per slab — per-leaf host pad/slice op storms (and
+        scalar count fetches) each cost an RTT on a network-tunneled
+        chip."""
+        key = ("slab", chunk, slab, rcap)
+        fn = self._pairs_cache.get(key)
+        if fn is not None:
+            return fn
 
-        Two-level nonzero: audits are ROW-sparse (~1% of objects violate
-        anything), so first select firing rows (nonzero over [Npad]), then
-        scan only those rows' bits (nonzero over [rcap*W*32]) — orders of
-        magnitude less sort work than a flat nonzero over N*C. Rows >= n
-        are extraction padding and are masked out before counting."""
-        fn = self._pairs_cache.get((cap, rcap))
+        def run(feats, params, table, derived, start, n_valid):
+            leaf = next(iter(next(iter(feats.values())).values()))
+            n_feat = leaf.shape[0]  # static
+            cs = jnp.minimum(start, n_feat - slab)
+            sl = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, cs, slab, axis=0),
+                feats)
+            chunked = jax.tree_util.tree_map(
+                lambda a: a.reshape((-1, chunk) + a.shape[1:]), sl)
+
+            def body(ch):
+                fires = self._eval(ch, params, table, derived)  # [chunk, C]
+                c = fires.shape[-1]
+                w = (c + 31) // 32
+                pad = w * 32 - c
+                if pad:
+                    fires = jnp.pad(fires, ((0, 0), (0, pad)))
+                bits = fires.reshape(fires.shape[0], w, 32)
+                weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+                return jnp.sum(jnp.where(bits, weights, jnp.uint32(0)),
+                               axis=-1, dtype=jnp.uint32)
+
+            packed = jax.lax.map(body, chunked)
+            packed = packed.reshape((slab,) + packed.shape[2:])
+            w = packed.shape[1]
+            rows_global = cs + jnp.arange(slab, dtype=jnp.int32)
+            # mask extraction padding (>= n_valid) AND the clamp overlap
+            # (< start): overlap rows were already emitted by the
+            # previous slab
+            valid = (rows_global < n_valid) & (rows_global >= start)
+            packed = jnp.where(valid[:, None], packed, jnp.uint32(0))
+            per_row = jnp.sum(jax.lax.population_count(packed), axis=1,
+                              dtype=jnp.int32)
+            row_any = per_row > 0
+            rcount = jnp.sum(row_any, dtype=jnp.int32)
+            rows_idx = jnp.nonzero(row_any, size=rcap, fill_value=slab)[0]
+            sel = jnp.where(rows_idx < slab, rows_idx, 0)
+            sub = packed[sel]
+            sub = jnp.where((rows_idx < slab)[:, None], sub, jnp.uint32(0))
+            gr = jnp.where(rows_idx < slab, cs + rows_idx,
+                           jnp.int32(n_feat)).astype(jnp.uint32)
+            body2 = jnp.concatenate([gr[:, None], sub], axis=1)
+            header = jnp.zeros((1, w + 1), jnp.uint32)
+            header = header.at[0, 0].set(rcount.astype(jnp.uint32))
+            return jnp.concatenate([header, body2], axis=0)
+
+        fn = jax.jit(run)
+        self._pairs_cache[key] = fn
+        return fn
+
+    def fires_pairs_slabbed(self, feats: dict, params: dict,
+                            match_table: np.ndarray,
+                            derived: Optional[dict] = None,
+                            chunk: int = 8192,
+                            slab: int = 32768,
+                            n_true: Optional[int] = None):
+        """Yield row-major (rows, cols) firing pairs per N-axis slab.
+
+        ALL slab dispatches (one fused kernel each) go out before the
+        first yield, so the device works ahead on slab k+1 while the
+        host materializes slab k's messages — one audit costs
+        ~max(sweep, materialize) wall-clock instead of their sum. Falls
+        back to one fires_pairs call when a single slab suffices."""
+        derived = derived or {}
+        n_feat = (next(iter(next(iter(feats.values())).values())).shape[0]
+                  if feats else 0)
+        n = n_feat
+        if n_true is not None:
+            n = min(n, n_true)
+        if not feats or n <= slab or n_feat < slab:
+            yield self.fires_pairs(feats, params, match_table, derived,
+                                   chunk=chunk, n_true=n_true)
+            return
+        c = _param_c(params)
+        n_slabs = (n + slab - 1) // slab
+        rcap = self._rows_cap
+        fn = self._slab_pairs_jit(chunk, slab, rcap)
+        pend = []
+        for k in range(n_slabs):
+            pend.append((rcap,
+                         fn(feats, params, match_table, derived,
+                            np.int32(k * slab), np.int32(n))))
+        for k, (used_rcap, dev_arr) in enumerate(pend):
+            arr = np.asarray(dev_arr)  # sync point + single fetch, slab k
+            rcount = int(arr[0, 0])
+            while rcount > used_rcap:
+                used_rcap = max(used_rcap, 1 << (rcount - 1).bit_length())
+                fn2 = self._slab_pairs_jit(chunk, slab, used_rcap)
+                arr = np.asarray(fn2(feats, params, match_table, derived,
+                                     np.int32(k * slab), np.int32(n)))
+                rcount = int(arr[0, 0])
+            self._rows_cap = max(self._rows_cap,
+                                 (1 << (rcount - 1).bit_length())
+                                 if rcount > 1 else 256)
+            yield _decode_row_blocks(arr, rcount, c)
+
+    def _gather_rows(self, packed, n: int, rcap: int):
+        """Device firing-ROW gather: one [rcap+1, W+1] uint32 block —
+        header row carrying the firing-row count, then per firing row
+        its global row index and its bit-packed column verdicts.
+
+        Audits are ROW-sparse (~1% of objects violate anything), so
+        shipping the firing rows' bitmasks is ~rcount x (W+1) words —
+        far below per-pair indices — and the whole result is ONE
+        device->host fetch (a network-tunneled chip pays ~0.1s per
+        roundtrip, so scalar-count-then-data would double the cost).
+        Rows >= n are extraction padding, masked before counting. Host
+        decodes with _decode_row_blocks (vectorized numpy)."""
+        fn = self._pairs_cache.get(("rows", rcap))
         if fn is None:
             def run(packed, n):
                 npad, w = packed.shape
@@ -767,7 +881,6 @@ class CompiledTemplate:
                 packed = jnp.where(valid, packed, jnp.uint32(0))
                 per_row = jnp.sum(jax.lax.population_count(packed), axis=1,
                                   dtype=jnp.int32)  # [Npad]
-                count = jnp.sum(per_row)
                 row_any = per_row > 0
                 rcount = jnp.sum(row_any, dtype=jnp.int32)
                 rows_idx = jnp.nonzero(row_any, size=rcap,
@@ -776,26 +889,11 @@ class CompiledTemplate:
                 sub = packed[sel]  # [rcap, W]
                 sub = jnp.where((rows_idx < npad)[:, None], sub,
                                 jnp.uint32(0))
-                bits = (sub[:, :, None] >>
-                        jnp.arange(32, dtype=jnp.uint32)) & 1
-                flat = bits.reshape(-1).astype(bool)
-                total_loc = flat.shape[0]
-                loc = jnp.nonzero(flat, size=cap, fill_value=total_loc)[0]
-                w32 = w * 32
-                r_loc = loc // w32
-                col = loc % w32
-                # back to global flat coordinates; row-major order is
-                # preserved because rows_idx is ascending and loc is
-                # row-major within the selected rows
-                safe_r = jnp.where(loc < total_loc, r_loc, 0)
-                gidx = jnp.where(loc < total_loc,
-                                 rows_idx[safe_r] * w32 + col,
-                                 npad * w32)
-                # int32 indices halve the transfer; fits for any N*C*32
-                # under 2^31 (a >2-billion-cell sweep would be chunked far
-                # upstream of here)
-                dt = jnp.int32 if npad * w32 < 2**31 else jnp.int64
-                return gidx.astype(dt), count, rcount
+                body = jnp.concatenate(
+                    [rows_idx.astype(jnp.uint32)[:, None], sub], axis=1)
+                header = jnp.zeros((1, w + 1), jnp.uint32)
+                header = header.at[0, 0].set(rcount.astype(jnp.uint32))
+                return jnp.concatenate([header, body], axis=0)
             fn = jax.jit(run)
-            self._pairs_cache[(cap, rcap)] = fn
+            self._pairs_cache[("rows", rcap)] = fn
         return fn(packed, n)

@@ -70,6 +70,41 @@ def _stepv(base, key):
     return UNDEF
 
 
+def _lookupk(base, k):
+    """Keyed lookup with EXACTLY the semantics of enumerating `base` and
+    filtering keys by rego_eq(k, key) — the join-reorder transform's
+    contract (it replaces that enumeration). Differs from _stepv on
+    bool-vs-number keys: rego_eq is type-aware while Python dict lookup
+    aliases True with 1, so numeric/bool keys take the scan path."""
+    if isinstance(base, dict):
+        if isinstance(k, (bool, int, float)):
+            for kk, vv in base.items():
+                if rego_eq(k, kk):
+                    return vv
+            return UNDEF
+        return base.get(k, UNDEF)
+    if isinstance(base, tuple):
+        if isinstance(k, bool):
+            return UNDEF
+        if isinstance(k, float):
+            # builtins can produce integral floats at runtime (results
+            # are not re-frozen); rego_eq(2.0, 2) matches index 2
+            if not k.is_integer():
+                return UNDEF
+            k = int(k)
+        if isinstance(k, int) and 0 <= k < len(base):
+            return base[k]
+        return UNDEF
+    if isinstance(base, frozenset):
+        if isinstance(k, (bool, int, float)):
+            for m in base:
+                if rego_eq(k, m):
+                    return m
+            return UNDEF
+        return k if k in base else UNDEF
+    return UNDEF
+
+
 def _call(fn, *args):
     """Builtin call: undefined args / builtin errors -> undefined
     (mirrors _iter_call's except clauses, interp.py:822-830)."""
@@ -212,6 +247,18 @@ class _Scope:
         return name in self.names
 
 
+def _collect_arg_vars(t, into: set) -> None:
+    if isinstance(t, A.Var):
+        if not t.name.startswith("$wc"):
+            into.add(t.name)
+    elif isinstance(t, (A.ArrayLit, A.SetLit)):
+        for x in t.items:
+            _collect_arg_vars(x, into)
+    elif isinstance(t, A.ObjectLit):
+        for _k, v in t.items:
+            _collect_arg_vars(v, into)
+
+
 def _term_vars(t, into: set) -> None:
     """All Var names + called function names appearing in a term."""
     if isinstance(t, A.Var):
@@ -253,10 +300,91 @@ def _term_vars(t, into: set) -> None:
         _term_vars(t.rhs, into)
 
 
+def _sections_ok(module: A.Module) -> bool:
+    """True when every `input` reference steps through a static
+    "review"/"parameters" first segment (the hook contract), so the
+    compiled evaluator can take the two sections as direct arguments —
+    no per-call input-wrapper construction. A bare `input` anywhere
+    (including as a pattern var) disables the optimization."""
+    ok = True
+
+    def walk(t) -> None:
+        nonlocal ok
+        if not ok:
+            return
+        if isinstance(t, A.Var):
+            if t.name == "input":
+                ok = False
+            return
+        if isinstance(t, A.Ref):
+            if isinstance(t.base, A.Var) and t.base.name == "input":
+                if not (t.args and isinstance(t.args[0], A.Scalar)
+                        and t.args[0].value in ("review", "parameters")):
+                    ok = False
+                for a in t.args:
+                    walk(a)
+                return
+            walk(t.base)
+            for a in t.args:
+                walk(a)
+            return
+        if isinstance(t, A.Call):
+            for a in t.args:
+                walk(a)
+            return
+        if isinstance(t, A.BinOp):
+            walk(t.lhs)
+            walk(t.rhs)
+            return
+        if isinstance(t, A.UnaryMinus):
+            walk(t.term)
+            return
+        if isinstance(t, (A.ArrayLit, A.SetLit)):
+            for x in t.items:
+                walk(x)
+            return
+        if isinstance(t, A.ObjectLit):
+            for k, v in t.items:
+                walk(k)
+                walk(v)
+            return
+        if isinstance(t, (A.ArrayCompr, A.SetCompr)):
+            walk(t.head)
+            for lit in t.body:
+                if not isinstance(lit.expr, A.SomeDecl):
+                    walk(lit.expr)
+            return
+        if isinstance(t, A.ObjectCompr):
+            walk(t.key)
+            walk(t.value)
+            for lit in t.body:
+                if not isinstance(lit.expr, A.SomeDecl):
+                    walk(lit.expr)
+            return
+        if isinstance(t, (A.Assign, A.Unify)):
+            walk(t.lhs)
+            walk(t.rhs)
+            return
+
+    for r in module.rules:
+        for lit in r.body:
+            if not isinstance(lit.expr, A.SomeDecl):
+                walk(lit.expr)
+        for h in (r.key, r.value):
+            if h is not None:
+                walk(h)
+        for a in r.args:
+            walk(a)
+        if not ok:
+            break
+    return ok
+
+
 class ModuleCompiler:
     def __init__(self, module: A.Module):
         module = reorder_module(module)
         self.module = module
+        self._sections = _sections_ok(module)
         self.rules: dict[str, list[A.Rule]] = {}
         for r in module.rules:
             self.rules.setdefault(r.name, []).append(r)
@@ -266,6 +394,17 @@ class ModuleCompiler:
         self.bin_bindings: dict[str, str] = {}
         self._pat_n = 0
         self._rmemo_n = 0  # review-pure comprehension memo slots
+        self._pmemo_n = 0  # params-pure comprehension memo slots
+        self._hmemo_n = 0  # head-witness memo slots
+        # join-reorder bookkeeping: id(literal) -> (key var, pin expr);
+        # _hint_refs pins the literal objects so ids stay valid
+        self._key_hints: dict[int, tuple] = {}
+        self._hint_refs: list = []
+        self._hint_bind: dict[str, str] = {}
+        # static input-path CSE: path tuple -> hoisted temp name, emitted
+        # once at rule entry (pure _stepv chains, so unconditional
+        # evaluation is safe — UNDEF just propagates)
+        self._path_cache: Optional[dict] = None
 
     def _arg_pure_fns(self) -> set:
         """Functions whose result depends ONLY on their arguments: no
@@ -390,7 +529,10 @@ class ModuleCompiler:
 
     def _ref_value(self, t: A.Ref, scope: _Scope, ind: int) -> str:
         args = list(t.args)
-        if isinstance(t.base, A.Var) and t.base.name == "data" and \
+        cached = self._cached_input_prefix(t, scope)
+        if cached is not None:
+            base, args = cached
+        elif isinstance(t.base, A.Var) and t.base.name == "data" and \
                 not scope.bound("data"):
             if args and isinstance(args[0], A.Scalar) and \
                     args[0].value == "inventory":
@@ -427,12 +569,21 @@ class ModuleCompiler:
     # ------------------------------------------------- review-pure analysis
 
     def _review_pure(self, t, scope: _Scope) -> bool:
-        """True when a comprehension's value depends ONLY on input.review:
-        no outer-scope variable reads, no data/inventory refs, no user
-        rule/function calls (they may read input.parameters), and every
-        input reference steps through "review" first. Such comprehensions
-        are identical across the many constraints one review is evaluated
-        against in an audit, so their results are memoized per review."""
+        return self._input_pure(t, scope, "review")
+
+    def _params_pure(self, t, scope: _Scope) -> bool:
+        return self._input_pure(t, scope, "parameters")
+
+    def _input_pure(self, t, scope: _Scope, section: str) -> bool:
+        """True when a comprehension's value depends ONLY on
+        input.<section>: no outer-scope variable reads, no data/inventory
+        refs, no user rule/function calls (they may read other input
+        sections), and every input reference steps through <section>
+        first. section="review" comprehensions are identical across the
+        many constraints one review is evaluated against in an audit and
+        memoize per review; section="parameters" comprehensions are
+        identical across the many reviews one constraint sweeps and
+        memoize per constraint."""
         outer = set(scope.names)
 
         def ok(x, bound: set) -> bool:
@@ -447,7 +598,7 @@ class ModuleCompiler:
                 if isinstance(x.base, A.Var) and x.base.name == "input" \
                         and "input" not in bound and "input" not in outer:
                     if not x.args or not (isinstance(x.args[0], A.Scalar)
-                                          and x.args[0].value == "review"):
+                                          and x.args[0].value == section):
                         return False
                     return all(ok(a, bound) for a in x.args[1:])
                 return ok(x.base, bound) and \
@@ -524,11 +675,22 @@ class ModuleCompiler:
             self.em.w(ind + 1, f"{out} = {out2}")
             self.em.w(ind + 1, f"_J['rmemo'][{slot}] = {out}")
             return out
+        if self._params_pure(t, scope):
+            slot = self._pmemo_n
+            self._pmemo_n += 1
+            out = self.em.tmp()
+            self.em.w(ind, f"{out} = _J['pmemo'].get({slot})")
+            self.em.w(ind, f"if {out} is None:")
+            out2 = self._compr_emit(t, scope, ind + 1)
+            self.em.w(ind + 1, f"{out} = {out2}")
+            self.em.w(ind + 1, f"_J['pmemo'][{slot}] = {out}")
+            return out
         return self._compr_emit(t, scope, ind)
 
     def _compr_emit(self, t, scope: _Scope, ind: int) -> str:
         acc = self.em.tmp()
         sub = scope.child()
+        body = self._schedule_body(t.body, set(scope.names))
         if isinstance(t, A.ObjectCompr):
             self.em.w(ind, f"{acc} = {{}}")
 
@@ -543,7 +705,7 @@ class ModuleCompiler:
                         self.em.w(l, f"{acc}[{kname}] = {vname}")
                     self.iter_emit(t.value, sub, j, vcont)
                 self.iter_emit(t.key, sub, i, kcont)
-            self.solve(t.body, 0, sub, ind, done)
+            self.solve(body, 0, sub, ind, done)
             out = self.em.tmp()
             self.em.w(ind, f"{out} = FrozenDict({acc})")
             return out
@@ -554,7 +716,7 @@ class ModuleCompiler:
         def done2(i):
             self.iter_emit(t.head, sub, i,
                            lambda j, v: self.em.w(j, f"{add}({v})"))
-        self.solve(t.body, 0, sub, ind, done2)
+        self.solve(body, 0, sub, ind, done2)
         out = self.em.tmp()
         self.em.w(ind, f"{out} = {ctor}({acc})")
         return out
@@ -648,6 +810,17 @@ class ModuleCompiler:
 
     def _iter_ref(self, t: A.Ref, scope: _Scope, ind: int, cont) -> None:
         args = list(t.args)
+        cached = self._cached_input_prefix(t, scope)
+        if cached is not None:
+            base, args = cached
+            if not args:
+                # whole ref is the hoisted path: keep iter_emit's
+                # UNDEF-yields-nothing contract
+                self.em.w(ind, f"if {base} is not UNDEF:")
+                cont(ind + 1, base)
+                return
+            self._walk(base, args, scope, ind, cont)
+            return
         if isinstance(t.base, A.Var) and t.base.name == "data" and \
                 not scope.bound("data"):
             if args and isinstance(args[0], A.Scalar) and \
@@ -668,6 +841,17 @@ class ModuleCompiler:
         unbound_var = (isinstance(a, A.Var)
                        and not scope.bound(a.name)
                        and a.name not in ("input", "data"))
+        if unbound_var and a.name in self._hint_bind:
+            # join-reorder hint: a later equality pins this key var, so
+            # replace the enumeration with one keyed lookup
+            te = self._hint_bind.pop(a.name)
+            pn = self._py(scope, a.name)
+            self.em.w(ind, f"{pn} = {te}")
+            v = self.em.tmp()
+            self.em.w(ind, f"{v} = _lookupk({base}, {pn})")
+            self.em.w(ind, f"if {v} is not UNDEF:")
+            self._walk(v, args[1:], scope, ind + 1, cont)
+            return
         if unbound_var:
             k = self.em.tmp()
             v = self.em.tmp()
@@ -773,6 +957,20 @@ class ModuleCompiler:
         if lit.withs:
             raise Unsupported("with modifier")
         expr = lit.expr
+        hint = self._key_hints.get(id(lit))
+        if hint is not None and not lit.negated:
+            k_name, e_term = hint
+            if not scope.bound(k_name):
+                try:
+                    e_expr = self.value(e_term, scope, ind)
+                except (_NotDeterministic, Unsupported):
+                    e_expr = None
+                if e_expr is not None:
+                    te = self.em.tmp()
+                    self.em.w(ind, f"{te} = {e_expr}")
+                    self.em.w(ind, f"if {te} is not UNDEF:")
+                    ind += 1
+                    self._hint_bind[k_name] = te
         if lit.negated:
             self._emit_negation(expr, scope, ind, nxt)
             return
@@ -829,6 +1027,572 @@ class ModuleCompiler:
                 self.em.w(j, f"if rego_eq({a}, {b}):"), nxt(j + 1)))
         self.iter_emit(lhs, scope, ind, both)
 
+    # ------------------------------------------------ input-path hoisting
+
+    def _collect_input_paths(self, rules) -> list[tuple]:
+        """All maximal static input.<scalars...> prefixes referenced by
+        the given clauses (including inside comprehensions and negation),
+        for hoisting to one _stepv chain at rule entry. The chains are
+        pure and total (UNDEF propagates through _stepv), so evaluating
+        them unconditionally preserves semantics exactly."""
+        found: set = set()
+
+        def ref(t) -> None:
+            if isinstance(t.base, A.Var) and t.base.name == "input":
+                pre = []
+                for a in t.args:
+                    if isinstance(a, A.Scalar) and isinstance(
+                            a.value, (str, int, bool)):
+                        pre.append(a.value)
+                    else:
+                        break
+                if pre:
+                    found.add(tuple(pre))
+            walk(t.base)
+            for a in t.args:
+                walk(a)
+
+        def walk(t) -> None:
+            if isinstance(t, A.Ref):
+                ref(t)
+            elif isinstance(t, A.Call):
+                for a in t.args:
+                    walk(a)
+            elif isinstance(t, A.BinOp):
+                walk(t.lhs)
+                walk(t.rhs)
+            elif isinstance(t, A.UnaryMinus):
+                walk(t.term)
+            elif isinstance(t, (A.ArrayLit, A.SetLit)):
+                for x in t.items:
+                    walk(x)
+            elif isinstance(t, A.ObjectLit):
+                for k, v in t.items:
+                    walk(k)
+                    walk(v)
+            elif isinstance(t, (A.ArrayCompr, A.SetCompr)):
+                walk(t.head)
+                for lit in t.body:
+                    walk_lit(lit)
+            elif isinstance(t, A.ObjectCompr):
+                walk(t.key)
+                walk(t.value)
+                for lit in t.body:
+                    walk_lit(lit)
+            elif isinstance(t, (A.Assign, A.Unify)):
+                walk(t.lhs)
+                walk(t.rhs)
+
+        def walk_lit(lit) -> None:
+            if isinstance(lit.expr, A.SomeDecl):
+                return
+            walk(lit.expr)
+
+        for r in rules:
+            for lit in r.body:
+                walk_lit(lit)
+            for h in (r.key, r.value):
+                if h is not None:
+                    walk(h)
+            for a in r.args:
+                walk(a)
+        # type-aware order: int and str segments may share a position
+        return sorted(found, key=lambda p: [repr(s) for s in p])
+
+    def _emit_path_cache(self, rules, ind: int) -> None:
+        """Emit the hoisted _stepv chains. Maximal review-/parameters-
+        rooted paths are additionally memoized in rmemo/pmemo — the
+        audit fan-out calls the evaluator ~|constraints| times per
+        review, so a per-review (resp. per-constraint) dict get replaces
+        the whole chain on every call after the first."""
+        self._path_cache = {}
+        if self._sections:
+            self._path_cache[("review",)] = "_J['rev']"
+            self._path_cache[("parameters",)] = "_J['par']"
+        for path in self._collect_input_paths(rules):
+            if path in self._path_cache:
+                continue
+            memo = None
+            if len(path) >= 2 and path[0] == "review":
+                memo = "rmemo"
+            elif len(path) >= 2 and path[0] == "parameters":
+                memo = "pmemo"
+            if memo is not None:
+                t = self.em.tmp()
+                key = ("p",) + path  # typed tuple: 1 and "1" stay distinct
+                self.em.w(ind, f"{t} = _J[{memo!r}].get({key!r}, _MISS)")
+                self.em.w(ind, f"if {t} is _MISS:")
+                root = self._path_cache.get((path[0],), None)
+                chain = (root if root is not None
+                         else f"_stepv(_J['input'], {path[0]!r})")
+                for seg in path[1:]:
+                    chain = f"_stepv({chain}, {seg!r})"
+                self.em.w(ind + 1, f"{t} = {chain}")
+                self.em.w(ind + 1, f"_J[{memo!r}][{key!r}] = {t}")
+                self._path_cache[path] = t
+                continue
+            for ln in range(1, len(path) + 1):
+                pre = path[:ln]
+                if pre in self._path_cache:
+                    continue
+                parent = ("_J['input']" if ln == 1
+                          else self._path_cache[pre[:-1]])
+                t = self.em.tmp()
+                self.em.w(ind, f"{t} = _stepv({parent}, {pre[-1]!r})")
+                self._path_cache[pre] = t
+
+    def _cached_input_prefix(self, t: A.Ref, scope: _Scope):
+        """(temp name, remaining args) when this ref starts with a
+        hoisted static input path (longest cached prefix wins); None
+        otherwise."""
+        if self._path_cache is None or scope.bound("input"):
+            return None
+        if not (isinstance(t.base, A.Var) and t.base.name == "input"):
+            return None
+        run: list = []
+        for a in t.args:
+            if isinstance(a, A.Scalar) and isinstance(a.value,
+                                                      (str, int, bool)):
+                run.append(a.value)
+            else:
+                break
+        for ln in range(len(run), 0, -1):
+            hit = self._path_cache.get(tuple(run[:ln]))
+            if hit is not None:
+                return hit, list(t.args[ln:])
+        return None
+
+    # ----------------------------------------------------- join reorder
+
+    def _names_unbound(self, t, bound: set) -> set:
+        """Over-approximated new names a term could bind (every unbound
+        non-root, non-rule, non-wildcard name appearing anywhere)."""
+        allv: set = set()
+        _term_vars(t, allv)
+        return {v for v in allv
+                if v not in bound and not v.startswith("$wc")
+                and v not in ("input", "data") and v not in self.rules}
+
+    def _expr_read_vars(self, t) -> set:
+        allv: set = set()
+        _term_vars(t, allv)
+        builtin1 = {fn[0] for fn in BUILTINS}
+        return {v for v in allv
+                if not v.startswith("$wc") and v not in ("input", "data")
+                and v not in self.rules and v not in builtin1}
+
+    def _enum_key_var(self, lit, bound: set) -> Optional[str]:
+        """Leftmost unbound non-wildcard Var bracket arg of the literal's
+        generator ref — the candidate join key."""
+        if lit.negated or lit.withs:
+            return None
+        e = lit.expr
+        refs = []
+        if isinstance(e, (A.Assign, A.Unify)):
+            for side in (e.rhs, e.lhs):
+                if isinstance(side, A.Ref):
+                    refs.append(side)
+        elif isinstance(e, A.Ref):
+            refs.append(e)
+        for r in refs:
+            for a in r.args:
+                if isinstance(a, A.Var) and not a.name.startswith("$wc") \
+                        and a.name not in bound \
+                        and a.name not in ("input", "data"):
+                    return a.name
+        return None
+
+    def _movable_generator(self, lit, bound: set) -> Optional[set]:
+        """EXACT bind set of a self-contained hoistable generator —
+        Assign/Unify of a fresh var (or flat var tuple) against a Ref
+        rooted at input/data whose bracket args are scalars, already-
+        bound vars, wildcards, or fresh vars — else None."""
+        if lit.negated or lit.withs:
+            return None
+        e = lit.expr
+        if not isinstance(e, (A.Assign, A.Unify)):
+            return None
+        for pat, refside in ((e.lhs, e.rhs), (e.rhs, e.lhs)):
+            if not isinstance(refside, A.Ref):
+                continue
+            base = refside.base
+            if not (isinstance(base, A.Var) and base.name in ("input",
+                                                              "data")):
+                continue
+            if isinstance(pat, A.Var):
+                pv = [pat.name]
+            elif isinstance(pat, A.ArrayLit) and \
+                    all(isinstance(x, A.Var) for x in pat.items):
+                pv = [x.name for x in pat.items]
+            else:
+                continue
+            if any(n in bound for n in pv):
+                continue
+            binds = {n for n in pv if not n.startswith("$wc")}
+            ok = True
+            for a in refside.args:
+                if isinstance(a, A.Scalar):
+                    continue
+                if isinstance(a, A.Var):
+                    if a.name.startswith("$wc") or a.name in bound:
+                        continue
+                    binds.add(a.name)
+                    continue
+                ok = False
+                break
+            if ok:
+                return binds
+        return None
+
+    def _schedule_body(self, lits, bound0=()) -> list:
+        """Equality-driven join reorder (sideways information passing):
+        when a generator enumerates base[k] only for a later equality to
+        pin k to an expression E, hoist the one self-contained generator
+        that makes E computable and mark the enumeration for conversion
+        to a keyed lookup (_lookupk keeps enumeration-filter typing; the
+        pin literal stays in place as a now-trivial check). The classic
+        shape is the review-dict x parameters join
+
+            value := input.review...labels[key]
+            expected := input.parameters.labels[_]
+            expected.key == key
+
+        which drops from O(|labels| x |params|) iterations per pair to
+        O(|params|) lookups. Solution sets are order-independent
+        (conjunctive body), so the transform is semantics-preserving."""
+        out = list(lits)
+        bound: set = set(bound0)
+        g = 0
+        guard = 0
+        while g < len(out):
+            guard += 1
+            if guard > 10 * len(out) + 10:
+                return list(lits)  # paranoid: never loop forever
+            lit = out[g]
+            e = lit.expr
+            if isinstance(e, A.SomeDecl):
+                bound -= set(e.names)
+                g += 1
+                continue
+            k = None
+            if id(lit) not in self._key_hints:
+                k = self._enum_key_var(lit, bound)
+            if k is None:
+                if not lit.negated:
+                    bound |= self._names_unbound(e, bound)
+                g += 1
+                continue
+            # find a pin: a later equality between Var(k) and a k-free E
+            pin = None
+            for j in range(g + 1, len(out)):
+                lj = out[j]
+                if lj.negated or lj.withs:
+                    continue
+                ej = lj.expr
+                sides = None
+                if isinstance(ej, A.BinOp) and ej.op == "==":
+                    sides = (ej.lhs, ej.rhs)
+                elif isinstance(ej, A.Unify):
+                    sides = (ej.lhs, ej.rhs)
+                if not sides:
+                    continue
+                for a, b in (sides, sides[::-1]):
+                    if isinstance(a, A.Var) and a.name == k and \
+                            k not in self._expr_read_vars(b):
+                        pin = (j, b)
+                        break
+                if pin:
+                    break
+            if pin is None:
+                bound |= self._names_unbound(e, bound)
+                g += 1
+                continue
+            e_idx, E = pin
+            need = self._expr_read_vars(E) - bound
+            if not need:
+                # E already computable here: mark the keyed lookup
+                self._key_hints[id(lit)] = (k, E)
+                self._hint_refs.append(lit)
+                bound |= self._names_unbound(e, bound)
+                g += 1
+                continue
+            # find ONE hoistable generator in (g, e_idx) covering `need`,
+            # whose binds don't collide with anything in between
+            moved = False
+            for s in range(g + 1, e_idx):
+                binds = self._movable_generator(out[s], bound)
+                if binds is None or not need <= binds:
+                    continue
+                between_binds: set = set()
+                for m in range(g, s):
+                    if not out[m].negated and \
+                            not isinstance(out[m].expr, A.SomeDecl):
+                        between_binds |= self._names_unbound(
+                            out[m].expr, bound)
+                if binds & between_binds:
+                    continue
+                mv = out.pop(s)
+                out.insert(g, mv)
+                moved = True
+                break
+            if not moved:
+                bound |= self._names_unbound(e, bound)
+                g += 1
+            # when moved: reprocess position g (now the hoisted
+            # generator); the enumeration gets its hint on the revisit
+
+        return out
+
+    # ------------------------------------------------- head-witness memo
+
+    def _scan_lit(self, lit, bound: set) -> dict:
+        """Static facts about one body literal for the head-memo planner:
+        ok     — var-only: no direct input/data/document-rule reads, no
+                 non-arg-pure user calls (so its value is a pure function
+                 of the variables it reads);
+        enum   — needs loop emission (enumerates; can't sit in the
+                 memoized suffix);
+        reads  — already-bound vars it consumes;
+        binds  — vars it binds (for the forward bound-set simulation).
+        Conservative: anything unrecognized clears ok — the planner then
+        simply declines to memoize, never miscompiles."""
+        s = {"ok": True, "enum": False, "reads": set(), "binds": set()}
+        if lit.withs:
+            s["ok"] = False
+            return s
+        e = lit.expr
+        if isinstance(e, A.SomeDecl):
+            s["ok"] = False  # scope boundary; forward sim unbinds names
+            return s
+
+        def pat_vars(t, into: set) -> None:
+            if isinstance(t, A.Var):
+                into.add(t.name)
+            elif isinstance(t, (A.ArrayLit, A.SetLit)):
+                for i in t.items:
+                    pat_vars(i, into)
+            elif isinstance(t, A.ObjectLit):
+                for _k, v in t.items:
+                    pat_vars(v, into)
+            elif isinstance(t, A.Ref):
+                for a in t.args:
+                    pat_vars(a, into)
+
+        def val(t, local: frozenset, quiet: bool) -> None:
+            # `quiet`: inside a deterministic sub-value (comprehension or
+            # negation) whose internal enumeration doesn't make the
+            # literal itself enumerate
+            if not s["ok"]:
+                return
+            if isinstance(t, A.Scalar):
+                return
+            if isinstance(t, A.Var):
+                n = t.name
+                if n in local:
+                    return
+                if n in ("input", "data"):
+                    s["ok"] = False
+                    return
+                if n in bound:
+                    s["reads"].add(n)
+                    return
+                if n in self.rules:
+                    s["ok"] = False  # document rule / fn value reference
+                    return
+                if quiet:
+                    return  # locally-bound inside compr/negation
+                s["enum"] = True
+                if not n.startswith("$wc"):
+                    s["binds"].add(n)
+                return
+            if isinstance(t, A.Ref):
+                if isinstance(t.base, A.Var) and \
+                        t.base.name in ("input", "data") and \
+                        t.base.name not in local and t.base.name not in bound:
+                    s["ok"] = False
+                    return
+                val(t.base, local, quiet)
+                for a in t.args:
+                    if isinstance(a, A.Var) and a.name not in local and \
+                            a.name not in bound and \
+                            a.name not in ("input", "data"):
+                        if not quiet:
+                            s["enum"] = True
+                            if not a.name.startswith("$wc"):
+                                s["binds"].add(a.name)
+                        local = local | {a.name}
+                        continue
+                    pv: set = set()
+                    pat_vars(a, pv)
+                    unb = {v for v in pv if v not in local and v not in bound}
+                    if unb and not isinstance(a, A.Var):
+                        # static pattern bracket: enumerates + binds
+                        if not quiet:
+                            s["enum"] = True
+                            s["binds"] |= {v for v in unb
+                                           if not v.startswith("$wc")}
+                        local = local | unb
+                        continue
+                    val(a, local, quiet)
+                return
+            if isinstance(t, A.Call):
+                fn = tuple(t.fn)
+                if len(fn) == 1 and fn[0] in self.rules:
+                    if fn[0] not in self.arg_pure:
+                        s["ok"] = False
+                        return
+                elif fn[0] == "data" or fn not in BUILTINS:
+                    s["ok"] = False
+                    return
+                for a in t.args:
+                    val(a, local, quiet)
+                return
+            if isinstance(t, A.BinOp):
+                val(t.lhs, local, quiet)
+                val(t.rhs, local, quiet)
+                return
+            if isinstance(t, A.UnaryMinus):
+                val(t.term, local, quiet)
+                return
+            if isinstance(t, (A.ArrayLit, A.SetLit)):
+                for x in t.items:
+                    val(x, local, quiet)
+                return
+            if isinstance(t, A.ObjectLit):
+                for k, v in t.items:
+                    val(k, local, quiet)
+                    val(v, local, quiet)
+                return
+            if isinstance(t, (A.ArrayCompr, A.SetCompr, A.ObjectCompr)):
+                # a comprehension is a deterministic value; its body may
+                # enumerate internally over locally-bound vars
+                lb: set = set()
+                for l2 in t.body:
+                    if l2.withs:
+                        s["ok"] = False
+                        return
+                    e2 = l2.expr
+                    if isinstance(e2, A.SomeDecl):
+                        lb.update(e2.names)
+                        continue
+                    if isinstance(e2, (A.Assign, A.Unify)):
+                        pat_vars(e2.lhs, lb)
+                        pat_vars(e2.rhs, lb)
+                    else:
+                        pat_vars(e2, lb)
+                lb -= bound  # outer-bound names are reads, not locals
+                inner = local | frozenset(lb)
+                for l2 in t.body:
+                    e2 = l2.expr
+                    if isinstance(e2, A.SomeDecl):
+                        continue
+                    if isinstance(e2, (A.Assign, A.Unify)):
+                        val(e2.lhs, inner, True)
+                        val(e2.rhs, inner, True)
+                    else:
+                        val(e2, inner, True)
+                for h in (getattr(t, "head", None), getattr(t, "key", None),
+                          getattr(t, "value", None)):
+                    if h is not None:
+                        val(h, inner, True)
+                return
+            s["ok"] = False
+
+        if lit.negated:
+            # negation exports no bindings and is deterministic overall
+            if isinstance(e, (A.Assign, A.Unify)):
+                val(e.lhs, frozenset(), True)
+                val(e.rhs, frozenset(), True)
+            else:
+                val(e, frozenset(), True)
+            s["binds"] = set()
+            return s
+
+        def complete_binds() -> None:
+            # the forward bound-set simulation must never UNDER-report
+            # binds (a var the emitter binds but the simulation missed
+            # could silently drop out of a memo key). Over-reporting is
+            # safe: it only widens the key or trips the emission
+            # fallback. So fold in every previously-unbound name
+            # appearing anywhere in the literal.
+            allv: set = set()
+            _term_vars(e, allv)
+            s["binds"] |= {v for v in allv
+                           if v not in bound and not v.startswith("$wc")
+                           and v not in ("input", "data")
+                           and v not in self.rules}
+        if isinstance(e, (A.Assign, A.Unify)):
+            lv: set = set()
+            pat_vars(e.lhs, lv)
+            rv: set = set()
+            pat_vars(e.rhs, rv)
+            lhs_unb = {v for v in lv if v not in bound}
+            rhs_unb = {v for v in rv if v not in bound}
+            if isinstance(e, A.Assign) or not lhs_unb or not rhs_unb:
+                patside, valside = (e.lhs, e.rhs)
+                if not isinstance(e, A.Assign) and rhs_unb and not lhs_unb:
+                    patside, valside = (e.rhs, e.lhs)
+                val(valside, frozenset(), False)
+                pv: set = set()
+                pat_vars(patside, pv)
+                unb = {v for v in pv if v not in bound}
+                if isinstance(patside, A.Var) or not unb:
+                    # plain binder (or ground-ground compare): deterministic
+                    s["binds"] |= {v for v in unb if not v.startswith("$wc")}
+                    s["reads"] |= pv & bound
+                    if not isinstance(patside, A.Var):
+                        val(patside, frozenset(unb), False)
+                else:
+                    # destructuring pattern: conservative, exclude
+                    s["ok"] = False
+            else:
+                s["ok"] = False  # two non-ground sides
+            complete_binds()
+            return s
+        val(e, frozenset(), False)
+        complete_binds()
+        return s
+
+    def _head_memo_plan(self, body_lits, head_key):
+        """Plan the head-witness memo for a partial-set rule: find the
+        maximal suffix of body literals that is deterministic and
+        var-only (see _scan_lit), so (suffix + head) is a pure function
+        of the outer vars V flowing into it. The emitted code then keys
+        (suffix+head) outputs on V's values in a cross-review,
+        cross-constraint memo — the audit fan-out materializes each
+        distinct witness once. Returns (cut_index, V_sorted) or None."""
+        body = list(body_lits)
+        if not body:
+            return None
+        bound: set = set()
+        scans = []
+        for lit in body:
+            sc = self._scan_lit(lit, bound)
+            scans.append(sc)
+            e = lit.expr
+            if isinstance(e, A.SomeDecl):
+                bound -= set(e.names)
+            else:
+                bound |= sc["binds"]
+        head_sc = self._scan_lit(
+            A.Literal(expr=head_key, negated=False, withs=()), bound)
+        if not head_sc["ok"] or head_sc["enum"]:
+            return None
+        cut = len(body)
+        while cut > 0 and scans[cut - 1]["ok"] and not scans[cut - 1]["enum"]:
+            cut -= 1
+        if cut >= len(body):
+            return None  # no usable suffix
+        suffix_binds: set = set()
+        reads: set = set(head_sc["reads"])
+        for sc in scans[cut:]:
+            reads |= sc["reads"]
+            suffix_binds |= sc["binds"]
+        v = sorted(reads - suffix_binds)
+        if len(v) > 6:
+            return None  # wide key: unlikely to collapse, skip
+        return cut, v
+
     # --------------------------------------------------------------- rules
 
     def _emit_rule(self, name: str) -> None:
@@ -840,6 +1604,7 @@ class ModuleCompiler:
         self.em.w(0, f"def rule_{name}(_J):")
         self.em.w(1, "_m = _J['memo']")
         self.em.w(1, f"if {name!r} in _m: return _m[{name!r}]")
+        self._emit_path_cache(rules, 1)
         if kind == "complete":
             self.em.w(1, "_outs = []")
             default_expr = "UNDEF"
@@ -855,7 +1620,7 @@ class ModuleCompiler:
                 def acc(i, v):
                     self.em.w(i, f"if not any(rego_eq({v}, _o) "
                                  f"for _o in _outs): _outs.append({v})")
-                self.solve(r.body, 0, scope, 1,
+                self.solve(self._schedule_body(r.body), 0, scope, 1,
                            lambda i, _v=val_t, _s=scope: self.iter_emit(
                                _v, _s, i, acc))
             self.em.w(1, "if len(_outs) > 1: raise RegoError("
@@ -865,10 +1630,51 @@ class ModuleCompiler:
             self.em.w(1, "_acc = set()")
             for r in rules:
                 scope = _Scope()
-                self.solve(r.body, 0, scope, 1,
-                           lambda i, _k=r.key, _s=scope: self.iter_emit(
-                               _k, _s, i,
-                               lambda j, v: self.em.w(j, f"_acc.add({v})")))
+                body = self._schedule_body(r.body)
+                plan = self._head_memo_plan(body, r.key)
+                if plan is None:
+                    self.solve(body, 0, scope, 1,
+                               lambda i, _k=r.key, _s=scope: self.iter_emit(
+                                   _k, _s, i,
+                                   lambda j, v: self.em.w(j,
+                                                          f"_acc.add({v})")))
+                    continue
+                cut, v_names = plan
+                slot = self._hmemo_n
+                self._hmemo_n += 1
+
+                def suffix(i, _r=r, _s=scope, _cut=cut, _b=body):
+                    self.solve(list(_b[_cut:]), 0, _s, i,
+                               lambda j: self.iter_emit(
+                                   _r.key, _s, j,
+                                   lambda l, v: self.em.w(
+                                       l, f"_hacc.append({v})")))
+
+                def mid(i, _r=r, _s=scope, _cut=cut, _V=v_names, _sl=slot,
+                        _suffix=suffix, _b=body):
+                    pys = [_s.names.get(v) for v in _V]
+                    if any(p is None for p in pys):
+                        # planner/emitter scope mismatch: emit unmemoized
+                        self.solve(list(_b[_cut:]), 0, _s, i,
+                                   lambda j: self.iter_emit(
+                                       _r.key, _s, j,
+                                       lambda l, v: self.em.w(
+                                           l, f"_acc.add({v})")))
+                        return
+                    hk = self.em.tmp()
+                    hv = self.em.tmp()
+                    key = ", ".join([str(_sl)] + pys)
+                    self.em.w(i, f"{hk} = ({key},)")
+                    self.em.w(i, f"{hv} = _J['hmemo'].get({hk}, _MISS)")
+                    self.em.w(i, f"if {hv} is _MISS:")
+                    self.em.w(i + 1, "_hacc = []")
+                    _suffix(i + 1)
+                    self.em.w(i + 1, f"{hv} = tuple(_hacc)")
+                    self.em.w(i + 1, f"_J['hmemo'][{hk}] = {hv}")
+                    fx = self.em.tmp()
+                    self.em.w(i, f"for {fx} in {hv}: _acc.add({fx})")
+
+                self.solve(list(body[:cut]), 0, scope, 1, mid)
             self.em.w(1, "_r = frozenset(_acc)")
         elif kind == "partial_object":
             self.em.w(1, "_accd = {}")
@@ -887,7 +1693,7 @@ class ModuleCompiler:
                             self.em.w(l, f"_accd[{kv}] = {vv}")
                         self.iter_emit(_r.value, s, j, vcont)
                     self.iter_emit(_r.key, s, i, kcont)
-                self.solve(r.body, 0, scope, 1,
+                self.solve(self._schedule_body(r.body), 0, scope, 1,
                            lambda i, _r=r, _s=scope: put(i, _r, _s))
             self.em.w(1, "_r = FrozenDict(_accd)")
         else:
@@ -895,11 +1701,20 @@ class ModuleCompiler:
         self.em.w(1, f"_m[{name!r}] = _r")
         self.em.w(1, "return _r")
         self.em.w(0, "")
+        self._path_cache = None
 
     def _emit_function(self, name: str, rules) -> None:
         arity = len(rules[0].args)
         formals = [f"_a{i}" for i in range(arity)]
         self.em.w(0, f"def fn_{name}(_J, {', '.join(formals)}):")
+        argnames: set = set()
+        for r in rules:
+            for a in r.args:
+                _collect_arg_vars(a, argnames)
+        if "input" in argnames:
+            self._path_cache = None  # shadowed: skip hoisting
+        else:
+            self._emit_path_cache(rules, 1)
         memo = name in self.arg_pure
         if memo:
             self.em.w(1, f"_mk = ({name!r}, {', '.join(formals)})")
@@ -920,8 +1735,12 @@ class ModuleCompiler:
                 self.em.w(i, f"if not any(rego_eq({v}, _o) "
                              f"for _o in _outs): _outs.append({v})")
 
-            def body(i, _r=r, _s=scope, _v=val_t):
-                self.solve(_r.body, 0, _s, i,
+            argv: set = set()
+            for a in r.args:
+                _collect_arg_vars(a, argv)
+
+            def body(i, _r=r, _s=scope, _v=val_t, _argv=argv):
+                self.solve(self._schedule_body(_r.body, _argv), 0, _s, i,
                            lambda j: self.iter_emit(_v, _s, j, acc))
 
             def chain(i, idx, _r=r, _s=scope, _body=body):
@@ -940,6 +1759,7 @@ class ModuleCompiler:
         else:
             self.em.w(1, "return _outs[0] if _outs else UNDEF")
         self.em.w(0, "")
+        self._path_cache = None
 
     # ----------------------------------------------------------- top level
 
@@ -948,18 +1768,32 @@ class ModuleCompiler:
             raise Unsupported(f"no {entry} rule")
         for name in self.rules:
             self._emit_rule(name)
-        self.em.w(0, "def __evaluate__(_input, _inv, _rmemo=None, "
-                     "_fmemo=None):")
-        self.em.w(1, "_J = {'input': _input, 'inv': _inv, 'memo': {}, "
-                     "'rmemo': _rmemo if _rmemo is not None else {}, "
-                     "'fmemo': _fmemo if _fmemo is not None else {}}")
+        if self._sections:
+            # sections mode: review/parameters come in as direct args —
+            # callers skip the per-call input-wrapper construction
+            self.em.w(0, "def __evaluate__(_rev, _par, _inv, _rmemo=None, "
+                         "_fmemo=None, _pmemo=None, _hmemo=None):")
+            self.em.w(1, "_J = {'rev': _rev, 'par': _par, 'inv': _inv, "
+                         "'memo': {}, "
+                         "'rmemo': _rmemo if _rmemo is not None else {}, "
+                         "'fmemo': _fmemo if _fmemo is not None else {}, "
+                         "'pmemo': _pmemo if _pmemo is not None else {}, "
+                         "'hmemo': _hmemo if _hmemo is not None else {}}")
+        else:
+            self.em.w(0, "def __evaluate__(_input, _inv, _rmemo=None, "
+                         "_fmemo=None, _pmemo=None, _hmemo=None):")
+            self.em.w(1, "_J = {'input': _input, 'inv': _inv, 'memo': {}, "
+                         "'rmemo': _rmemo if _rmemo is not None else {}, "
+                         "'fmemo': _fmemo if _fmemo is not None else {}, "
+                         "'pmemo': _pmemo if _pmemo is not None else {}, "
+                         "'hmemo': _hmemo if _hmemo is not None else {}}")
         if self.rules[entry][0].kind == "function":
             raise Unsupported(f"{entry} is a function")
         self.em.w(1, f"return rule_{entry}(_J)")
 
         params = ["UNDEF", "FrozenDict", "RegoError", "rego_eq", "_enum",
-                  "_stepv", "_call", "_callu", "_bin", "_neg", "_arr",
-                  "_setl", "_obj", "_MISS"]
+                  "_stepv", "_lookupk", "_call", "_callu", "_bin", "_neg",
+                  "_arr", "_setl", "_obj", "_MISS"]
         bparams = list(self.builtin_bindings.values())
         cparams = list(self.bin_bindings.values())
         src = (f"def __make__({', '.join(params + bparams + cparams)}):\n"
@@ -971,9 +1805,17 @@ class ModuleCompiler:
         bvals = [BUILTINS[fn] for fn in self.builtin_bindings]
         cvals = [_BIN_SPECIAL[op] for op in self.bin_bindings]
         fn = g["__make__"](UNDEF, FrozenDict, RegoError, rego_eq, _enum,
-                           _stepv, _call, _callu, _bin, _neg, _arr, _setl,
-                           _obj, _MISS, *bvals, *cvals)
+                           _stepv, _lookupk, _call, _callu, _bin, _neg,
+                           _arr, _setl, _obj, _MISS, *bvals, *cvals)
         fn.__source__ = src  # for debugging
+        fn.__sections__ = self._sections
+        if self._sections:
+            def input_call(_input, _inv, *memos, _fn=fn):
+                return _fn(_stepv(_input, "review"),
+                           _stepv(_input, "parameters"), _inv, *memos)
+            fn.__input_call__ = input_call
+        else:
+            fn.__input_call__ = fn
         return fn
 
 

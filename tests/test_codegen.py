@@ -40,7 +40,7 @@ def test_codegen_matches_interpreter_on_reference_corpus(dirpath):
     checked = fired = 0
     for doc, inv in cases:
         inv = inv if inv is not None else {}
-        a = fn(freeze(doc), freeze(inv))
+        a = fn.__input_call__(freeze(doc), freeze(inv))
         b = interp.eval_rule(module.package, "violation", doc,
                              overrides={("inventory",): inv})
         assert a == b, f"{dirpath}: codegen diverged\n cg: {thaw(a)!r}\n" \
@@ -124,7 +124,7 @@ def _fn(src: str):
 def _run(src: str, inp, inv=None):
     module = parse_module(src)
     fn = compile_module(module)
-    a = fn(freeze(inp), freeze(inv if inv is not None else {}))
+    a = fn.__input_call__(freeze(inp), freeze(inv if inv is not None else {}))
     interp = Interpreter({"m": module})
     b = interp.eval_rule(module.package, "violation", inp,
                          overrides={("inventory",): inv}
@@ -179,7 +179,7 @@ violation[{"msg": both}] { true }
 """
     fn = _fn(src)
     with pytest.raises(RegoError):
-        fn(freeze({"review": {"object": {"x": 2}}}), freeze({}))
+        fn.__input_call__(freeze({"review": {"object": {"x": 2}}}), freeze({}))
 
 
 def test_partial_object_rule():

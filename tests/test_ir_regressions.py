@@ -279,12 +279,12 @@ def test_fires_pairs_matches_dense_with_padding_and_regather():
         build_eval_setup(n, c, n_bucket=4096, violate_frac=0.3)
     dense = ct.fires_chunked(feats, params, table, derived, chunk=1024)
     want = np.nonzero(dense[:n])
-    ct._pairs_cap = 16  # force at least one capacity re-gather
+    ct._rows_cap = 16  # force at least one capacity re-gather
     rows, cols = ct.fires_pairs(feats, params, table, derived, chunk=1024,
                                 n_true=n)
     assert rows.shape == want[0].shape
     assert (rows == want[0]).all() and (cols == want[1]).all()
-    assert ct._pairs_cap >= len(rows)
+    assert ct._rows_cap >= len(np.unique(rows))
     # steady state: second call reuses the remembered capacity
     rows2, cols2 = ct.fires_pairs(feats, params, table, derived, chunk=1024,
                                   n_true=n)
