@@ -147,6 +147,10 @@ class TpuDriver(RegoDriver):
         self.derived_tables = DerivedTables(self.strtab)
         self._compiled: dict[str, Optional[CompiledTemplate]] = {}
         self._programs: dict[str, Any] = {}
+        # inventory-join templates (ir/join.py): kind -> JoinProgram /
+        # lazily-built JoinCompiled
+        self._join_progs: dict[str, Any] = {}
+        self._join_compiled: dict[str, Any] = {}
         self._modules: dict[str, A.Module] = {}
         self._derived_cols: dict[str, list[int]] = {}  # kind -> global cols
         # generation counters for cache invalidation
@@ -166,6 +170,10 @@ class TpuDriver(RegoDriver):
         # counters — steady-state sweeps were rebuilding an identical
         # [N_reviews x N_cons] bool array every audit
         self._mask_cache: dict = {}
+        # join steady-state caches, one data generation deep:
+        # (data_rev, {id(review): (review, frozen)}, {(ci, id(frozen)):
+        #  (keys, ident)})
+        self._join_frz: tuple = (None, {}, {})
         # cost-based review_batch dispatch EMAs (_use_device_for_batch)
         self._dev_batch_lat_s: Optional[float] = None
         self._host_pair_rate: float = 20_000.0
@@ -186,6 +194,9 @@ class TpuDriver(RegoDriver):
         self._derived_cols.pop(kind, None)
         self._param_cache.pop(kind, None)
         self._feat_cache.pop(kind, None)
+        self._join_progs.pop(kind, None)
+        self._join_compiled.pop(kind, None)
+        self._join_frz[2].pop(kind, None)  # template update: stale keys
         module = mods[0] if len(mods) == 1 else merge_template_modules(mods)
         if module is None:
             self._compiled[kind] = None
@@ -195,6 +206,12 @@ class TpuDriver(RegoDriver):
             self._modules[kind] = module
         except Uncompilable:
             self._compiled[kind] = None
+            # cross-object templates: try the inventory-join compiler
+            from .join import compile_join
+            try:
+                self._join_progs[kind] = compile_join(module, kind)
+            except Uncompilable:
+                pass
 
     def delete_modules(self, prefix: str) -> int:
         n = super().delete_modules(prefix)
@@ -204,6 +221,9 @@ class TpuDriver(RegoDriver):
             self._programs.pop(m.group(2), None)
             self._modules.pop(m.group(2), None)
             self._derived_cols.pop(m.group(2), None)
+            self._join_progs.pop(m.group(2), None)
+            self._join_compiled.pop(m.group(2), None)
+            self._join_frz[2].pop(m.group(2), None)
         return n
 
     def compiled_for(self, kind: str) -> Optional[CompiledTemplate]:
@@ -259,7 +279,23 @@ class TpuDriver(RegoDriver):
         report_device_demotion(kind, reason)
 
     def compiled_kinds(self) -> list[str]:
-        return sorted(k for k in self._programs)
+        return sorted(set(self._programs) | set(self._join_progs))
+
+    def join_for(self, kind: str):
+        """Lazily wrap a JoinProgram in its runtime evaluator."""
+        if kind in self._join_compiled:
+            return self._join_compiled[kind]
+        prog = self._join_progs.get(kind)
+        jc = None
+        if prog is not None:
+            from .join import JoinCompiled
+            try:
+                jc = JoinCompiled(prog, self.strtab)
+            except Exception as e:
+                self._demote(kind, "join-lowering", e)
+                jc = None
+        self._join_compiled[kind] = jc
+        return jc
 
     # ---------------------------------------------------------------- data
 
@@ -327,16 +363,76 @@ class TpuDriver(RegoDriver):
         for kind in sorted(by_kind):
             cons = by_kind[kind]
             ct = self.compiled_for(kind)
-            if ct is None:
-                results.extend(self._audit_interp(target, kind, cons, reviews,
-                                                  lookup_ns, inventory, trace,
-                                                  sig_cache))
-            else:
+            if ct is not None:
                 results.extend(self._audit_compiled(target, kind, ct, cons,
                                                     reviews, lookup_ns,
                                                     inventory, trace,
                                                     sig_cache))
+                continue
+            jc = self.join_for(kind)
+            if jc is not None:
+                results.extend(self._audit_join(target, kind, jc, cons,
+                                                reviews, lookup_ns,
+                                                inventory, trace, sig_cache))
+                continue
+            results.extend(self._audit_interp(target, kind, cons, reviews,
+                                              lookup_ns, inventory, trace,
+                                              sig_cache))
         return results
+
+    def _audit_join(self, target, kind, jc, cons, reviews, lookup_ns,
+                    inventory, trace, sig_cache=None) -> list[Result]:
+        """Audit one inventory-join kind: exact aggregated-key join on
+        device/host (ir/join.py) selects firing reviews; materialization
+        re-checks and renders each firing pair exactly."""
+        from ..utils.values import freeze
+
+        mask = self._match_mask(target, kind, cons, reviews, lookup_ns,
+                                sig_cache)
+        cand = np.flatnonzero(mask.any(axis=1))
+        if cand.size == 0:
+            return []
+        cand_reviews = [reviews[int(i)] for i in cand]
+        if self._join_frz[0] != self._data_rev:
+            self._join_frz = (self._data_rev, {}, {})
+        rev_cache = self._join_frz[1]
+        key_cache = self._join_frz[2].setdefault(kind, {})
+        frz = []
+        for r in cand_reviews:
+            ent = rev_cache.get(id(r))
+            if ent is None or ent[0] is not r:
+                ent = (r, freeze(r))
+                rev_cache[id(r)] = ent
+            frz.append(ent[1])
+        try:
+            fires = jc.fires(frz, self._inventory_tree(target),
+                             self._data_gen, key_cache=key_cache)
+        except Exception as e:
+            self._demote(kind, "join-eval", e)
+            self._join_compiled[kind] = None
+            return self._audit_interp(target, kind, cons, reviews,
+                                      lookup_ns, inventory, trace,
+                                      sig_cache)
+        hit = np.flatnonzero(fires)
+        if hit.size == 0:
+            return []
+        # join programs are parameter-independent: expand each firing
+        # review to every constraint its match allows
+        sub = mask[cand[hit]]
+        rows_rep, cols = np.nonzero(sub)
+        rows = hit[rows_rep]
+        if trace is None:
+            return self.materialize_pairs(target, cons, cand_reviews,
+                                          rows, cols, inventory)
+        out: list[Result] = []
+        for ri, ci in zip(rows, cols):
+            constraint = cons[int(ci)]
+            spec = constraint.get("spec")
+            spec = spec if isinstance(spec, dict) else {}
+            out.extend(self._eval_template_violations(
+                target, constraint, cand_reviews[int(ri)],
+                spec.get("enforcementAction") or "deny", inventory, trace))
+        return out
 
     def _match_mask(self, target, kind, cons, reviews, lookup_ns,
                     sig_cache):
@@ -580,6 +676,7 @@ class TpuDriver(RegoDriver):
                     ))
         import time as _time
 
+        batch_frz: dict = {}  # id(review) -> frozen, shared across kinds
         for kind in sorted(by_kind):
             cons = by_kind[kind]
             mask = match_masks(cons, reviews, lookup_ns)
@@ -588,6 +685,26 @@ class TpuDriver(RegoDriver):
             ct = self.compiled_for(kind)
             pairs = None
             n_masked = int(mask.sum())
+            jc = self.join_for(kind) if ct is None and n_masked else None
+            if jc is not None:
+                try:
+                    jcand = np.flatnonzero(mask.any(axis=1))
+                    frz = []
+                    for i in jcand:
+                        r = reviews[int(i)]
+                        f = batch_frz.get(id(r))
+                        if f is None:
+                            f = batch_frz[id(r)] = freeze(r)
+                        frz.append(f)
+                    fires = jc.fires(frz, inventory, self._data_gen)
+                    pairs = [(int(jcand[k]), c)
+                             for k in np.flatnonzero(fires)
+                             for c in range(len(cons))
+                             if mask[int(jcand[k]), c]]
+                except Exception as e:
+                    self._demote(kind, "join-eval", e)
+                    self._join_compiled[kind] = None
+                    pairs = None
             if ct is not None and n_masked and \
                     len(reviews) >= self.MIN_DEVICE_BATCH and \
                     self._use_device_for_batch(n_masked):
