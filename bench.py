@@ -68,6 +68,21 @@ def main() -> None:
     evals = N_OBJECTS * N_CONSTRAINTS
     evals_per_sec = evals / audit_s
 
+    # ---- churn: 1-object mutation between audits ----------------------
+    # the incremental path (patch journal) must keep this near the warm
+    # steady-state sweep, not force full re-extraction/re-upload
+    from gatekeeper_tpu.parallel.workload import LABEL_POOL
+    healthy = {k: v[0][0] for k, v in LABEL_POOL.items()}
+    mutate_audit_s = float("inf")
+    for k in range(2):
+        labels = dict(healthy)
+        labels["app"] = f"churn{k}"  # healthy value churn: same verdicts
+        client.add_data({"apiVersion": "v1", "kind": "Namespace",
+                         "metadata": {"name": "ns-42", "labels": labels}})
+        t0 = time.time()
+        client.audit()
+        mutate_audit_s = min(mutate_audit_s, time.time() - t0)
+
     # ---- phase breakdown (same warm caches + jits the audit uses) -----
     import numpy as np
 
@@ -137,6 +152,7 @@ def main() -> None:
         "materialize_s": round(mat_s, 3),
         "evals_per_sec_per_chip": round(evals_per_sec),
         "first_audit_s": round(first_audit_s, 2),
+        "mutate_audit_s": round(mutate_audit_s, 3),
         "objects": N_OBJECTS,
         "constraints": N_CONSTRAINTS,
         "violating_pairs": n_pairs,

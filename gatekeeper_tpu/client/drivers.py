@@ -109,8 +109,17 @@ class RegoDriver:
         # costs seconds; both are stable until the data tree changes
         self._data_rev = 0
         self._inv_reviews_cache: dict[str, tuple] = {}  # target -> (rev, l)
+        self._inv_key_cache: dict[str, tuple] = {}  # target -> (rev, keys)
         self._sig_cache: dict[str, tuple] = {}  # target -> (rev, dict)
         self._inv_tree_cache: dict[str, tuple] = {}  # target -> (rev, tree)
+        # incremental-mutation journal: ("patch", rev, target, index,
+        # old_review, new_review) for single-object in-place replacements
+        # that PATCHED the warm caches, ("break", rev) for anything else.
+        # Consumers (mask/feature caches in ir/driver.py) replay the
+        # range since their snapshot instead of rebuilding from scratch.
+        self._patch_notes: list = []
+        self._con_rev = 0  # constraint-store revision (ns-selector cache)
+        self._ns_sel_cache: tuple = (None, False)
 
     # ------------------------------------------------------------- modules
 
@@ -183,11 +192,13 @@ class RegoDriver:
             # constraint churn leaves the inventory-review/signature/tree
             # caches valid — only actual inventory writes invalidate them
             self._data_rev += 1
+            self._note_inventory_write(tuple(path), deleted=False)
         else:
             # bound growth: dead constraint objects would pin stale
             # per-constraint memo dicts (identity checks keep them safe,
             # clearing keeps them small)
             self._pmemo.clear()
+            self._con_rev += 1
 
     def delete_data(self, path: tuple) -> bool:
         if not path:
@@ -198,9 +209,121 @@ class RegoDriver:
         self._frz_inv = (None, None)
         if path[0] != "constraints":
             self._data_rev += 1
+            self._note_inventory_write(tuple(path), deleted=True)
         else:
             self._pmemo.clear()
+            self._con_rev += 1
         return out
+
+    # ------------------------------------------------ incremental writes
+
+    def _note_inventory_write(self, path: tuple, deleted: bool) -> None:
+        notes = self._patch_notes
+        if len(notes) >= 1024:
+            # journal cap: older ranges fall out of coverage and replay
+            # degrades to a rebuild (checked via note count == rev delta)
+            del notes[: len(notes) // 2]
+        patched = None if deleted else self._try_patch_reviews(path)
+        if patched is None:
+            notes.append(("break", self._data_rev))
+        else:
+            notes.append(("patch", self._data_rev) + patched)
+
+    def _any_namespace_selector(self) -> bool:
+        """True when any stored constraint matches via namespaceSelector
+        (cached per constraint revision)."""
+        ent = self._ns_sel_cache
+        if ent[0] == self._con_rev:
+            return ent[1]
+        found = False
+        root = self._interp.get_data(("constraints",))
+        stack = [root] if isinstance(root, dict) else []
+        while stack and not found:
+            node = stack.pop()
+            for v in node.values():
+                if not isinstance(v, dict):
+                    continue
+                if v.get("kind") and isinstance(v.get("spec"), dict):
+                    match = v["spec"].get("match")
+                    if isinstance(match, dict) and \
+                            "namespaceSelector" in match:
+                        found = True
+                        break
+                else:
+                    stack.append(v)
+        self._ns_sel_cache = (self._con_rev, found)
+        return found
+
+    def _notes_between(self, rev_a: int, rev_b: int):
+        """Patch notes for writes in (rev_a, rev_b], or None when the
+        range is uncovered or contains a non-patchable write."""
+        if rev_b <= rev_a:
+            return []
+        sel = [n for n in self._patch_notes if rev_a < n[1] <= rev_b]
+        if len(sel) != rev_b - rev_a or any(n[0] == "break" for n in sel):
+            return None
+        return sel
+
+    def _try_patch_reviews(self, path: tuple):
+        """In-place REPLACEMENT of a single existing inventory object
+        patches the warm steady-state caches (review list, signature
+        cache, frozen inventory tree) instead of invalidating them — the
+        churning-cluster case where one object mutates between audits.
+        Inserts, deletes, and non-object writes return None (rebuild).
+        Returns (target, index, old_review, new_review) on success."""
+        import bisect
+
+        if len(path) < 2 or path[0] != "external":
+            return None
+        target = path[1]
+        rest = path[2:]
+        if len(rest) == 4 and rest[0] == "cluster":
+            gv, kind, name = rest[1], rest[2], rest[3]
+            sort_key_t = (0, "", gv, kind, name)
+            ns = None
+        elif len(rest) == 5 and rest[0] == "namespace":
+            ns, gv, kind, name = rest[1], rest[2], rest[3], rest[4]
+            sort_key_t = (1, ns, gv, kind, name)
+        else:
+            return None
+        if kind == "Namespace" and self._any_namespace_selector():
+            # a Namespace's labels feed OTHER reviews' match verdicts
+            # through namespaceSelector; patching only its own mask row
+            # would leave every other review in that namespace stale
+            return None
+        prev = self._data_rev - 1
+        cached = self._inv_reviews_cache.get(target)
+        keys = self._inv_key_cache.get(target)
+        if cached is None or cached[0] != prev or keys is None or \
+                keys[0] != prev:
+            return None
+        reviews, keylist = cached[1], keys[1]
+        i = bisect.bisect_left(keylist, sort_key_t)
+        if not (i < len(keylist) and keylist[i] == sort_key_t):
+            return None  # insertion would shift every later index
+        node = self._interp.get_data(tuple(path))
+        if node is UNDEF or not isinstance(node, dict):
+            return None
+        group, version = split_group_version(gv)
+        new_review = {"kind": {"group": group, "version": version,
+                               "kind": kind},
+                      "name": name, "object": node}
+        if ns is not None:
+            new_review["namespace"] = ns
+        old = reviews[i]
+        reviews[i] = new_review
+        self._inv_reviews_cache[target] = (self._data_rev, reviews)
+        self._inv_key_cache[target] = (self._data_rev, keylist)
+        sig = self._sig_cache.get(target)
+        if sig is not None and sig[0] == prev:
+            sig[1].pop(id(old), None)
+            self._sig_cache[target] = (self._data_rev, sig[1])
+        tre = self._inv_tree_cache.get(target)
+        if tre is not None and tre[0] == prev:
+            self._inv_tree_cache[target] = (
+                self._data_rev,
+                _tree_with(tre[1], rest, freeze(_deep_plain(node))))
+        return (target, i, old, new_review)
 
     def get_data(self, path: tuple) -> Any:
         v = self._interp.get_data(tuple(path))
@@ -327,6 +450,8 @@ class RegoDriver:
         return f
 
     def _freeze_inv(self, inventory):
+        if isinstance(inventory, FrozenDict):
+            return inventory  # _inventory_tree output is deep-frozen
         c = self._frz_inv
         if c[0] is inventory:
             return c[1]
@@ -619,8 +744,9 @@ class RegoDriver:
         cached = self._inv_reviews_cache.get(target)
         if cached is not None and cached[0] == self._data_rev:
             return cached[1]
-        reviews = self._build_inventory_reviews(target)
+        reviews, keys = self._build_inventory_reviews(target)
         self._inv_reviews_cache[target] = (self._data_rev, reviews)
+        self._inv_key_cache[target] = (self._data_rev, keys)
         return reviews
 
     def _audit_sig_cache(self, target: str) -> dict:
@@ -633,11 +759,15 @@ class RegoDriver:
         self._sig_cache[target] = (self._data_rev, sigs)
         return sigs
 
-    def _build_inventory_reviews(self, target: str) -> list[dict]:
+    def _build_inventory_reviews(self, target: str) -> tuple:
+        """-> (reviews, sort keys) aligned; the key list lets single-
+        object writes bisect to their review index for in-place cache
+        patching (_try_patch_reviews)."""
         reviews: list[dict] = []
+        keys: list[tuple] = []
         root = self._interp.get_data(("external", target))
         if root is UNDEF or not isinstance(root, dict):
-            return reviews
+            return reviews, keys
         cluster = root.get("cluster")
         if isinstance(cluster, dict):
             for gv in sorted(cluster):
@@ -656,6 +786,7 @@ class RegoDriver:
                             "name": name,
                             "object": by_name[name],
                         })
+                        keys.append((0, "", gv, kind, name))
         namespaced = root.get("namespace")
         if isinstance(namespaced, dict):
             for ns in sorted(namespaced):
@@ -679,7 +810,8 @@ class RegoDriver:
                                 "namespace": ns,
                                 "object": by_name[name],
                             })
-        return reviews
+                            keys.append((1, ns, gv, kind, name))
+        return reviews, keys
 
     def _eval_data_path(self, path: tuple, input_value: Any) -> list[Result]:
         """Generic data query: wrap each value at `path` as a bare Result
@@ -705,6 +837,17 @@ class RegoDriver:
             "modules": sorted(self._module_names),
             "data": data,
         }, indent=2, sort_keys=True)
+
+
+def _tree_with(tree: Any, segs: tuple, frozen_value: Any) -> Any:
+    """Frozen inventory tree with tree[segs...] replaced, rebuilding
+    only the spine (O(path depth x siblings), not O(inventory))."""
+    if not segs:
+        return frozen_value
+    base = tree if isinstance(tree, dict) else {}
+    d = dict(base)
+    d[segs[0]] = _tree_with(base.get(segs[0]), segs[1:], frozen_value)
+    return FrozenDict(d)
 
 
 def _deep_plain(v: Any) -> Any:

@@ -170,6 +170,8 @@ class TpuDriver(RegoDriver):
         # counters — steady-state sweeps were rebuilding an identical
         # [N_reviews x N_cons] bool array every audit
         self._mask_cache: dict = {}
+        # vocab-capacity padding cache: id(src) -> (weakref, padded)
+        self._vpad_cache: dict = {}
         # join steady-state caches, one data generation deep:
         # (data_rev, {id(review): (review, frozen)}, {(ci, id(frozen)):
         #  (keys, ident)})
@@ -314,8 +316,11 @@ class TpuDriver(RegoDriver):
             self._param_cache.clear()
         else:
             self._data_gen += 1
-            self._feat_cache.clear()
-        self._dev_cache.clear()  # drop device buffers for dead host arrays
+            # feature/device caches survive: single-object replacements
+            # replay through the patch journal (_notes_between) against
+            # the cached tensors; uncovered ranges rebuild lazily on the
+            # next audit. Device buffers of superseded host arrays
+            # self-evict via their weakrefs.
 
     def _dev(self, tree):
         """Device-resident view of a tree of host ndarrays, cached by leaf
@@ -440,6 +445,19 @@ class TpuDriver(RegoDriver):
         ent = self._mask_cache.get((target, kind))
         if ent is not None and ent[0] == key and ent[1] is reviews:
             return ent[2]
+        if ent is not None and ent[1] is reviews and \
+                ent[0][1] == self._constraint_gen:
+            # replay single-object replacements onto the cached mask
+            notes = self._notes_between(ent[0][0], self._data_rev)
+            if notes is not None:
+                mask = ent[2]
+                for n in notes:
+                    if n[2] != target:
+                        continue
+                    mask[n[3]] = match_masks(cons, [n[5]], lookup_ns,
+                                             sig_cache)[0]
+                self._mask_cache[(target, kind)] = (key, reviews, mask)
+                return mask
         mask = match_masks(cons, reviews, lookup_ns, sig_cache)
         self._mask_cache[(target, kind)] = (key, reviews, mask)
         return mask
@@ -480,7 +498,8 @@ class TpuDriver(RegoDriver):
             out: list[Result] = []
             try:
                 for rows, cols in self.eval_compiled_pairs_slabbed(
-                        ct, kind, cand_reviews, cons, feat_key=feat_key):
+                        ct, kind, cand_reviews, cons, feat_key=feat_key,
+                        cand=cand, target=target):
                     keep = mask[cand[rows], cols]
                     out.extend(self.materialize_pairs(
                         target, cons, cand_reviews, rows[keep], cols[keep],
@@ -496,7 +515,8 @@ class TpuDriver(RegoDriver):
             return out
         try:
             rows, cols = self.eval_compiled_pairs(ct, kind, cand_reviews,
-                                                  cons, feat_key=feat_key)
+                                                  cons, feat_key=feat_key,
+                                                  cand=cand, target=target)
         except Exception as e:
             # eval-time failures (shapes/ops outside the evaluator's
             # envelope) demote the template to the interpreter path
@@ -532,24 +552,29 @@ class TpuDriver(RegoDriver):
 
     def eval_compiled_pairs(self, ct: CompiledTemplate, kind: str,
                             reviews: list[dict], cons: list[dict],
-                            feat_key=None) -> tuple:
+                            feat_key=None, cand=None,
+                            target=None) -> tuple:
         """(rows, cols) firing pairs, row-major — the sparse form of
         eval_compiled (audits are ~99% rejects; see fires_pairs)."""
         feats, enc, table, derived = self._prepare_eval(ct, kind, reviews,
-                                                        cons, feat_key)
+                                                        cons, feat_key,
+                                                        cand=cand,
+                                                        target=target)
         rows, cols = ct.fires_pairs(feats, enc, table, derived,
                                     n_true=len(reviews))
         return _expand_parameterless(rows, cols, _param_c(enc), len(cons))
 
     def eval_compiled_pairs_slabbed(self, ct: CompiledTemplate, kind: str,
                                     reviews: list[dict], cons: list[dict],
-                                    feat_key=None):
+                                    feat_key=None, cand=None, target=None):
         """Iterator form of eval_compiled_pairs over N-axis slabs, with
         every slab's device work dispatched before the first yield (see
         CompiledTemplate.fires_pairs_slabbed) — the audit's
         sweep/materialize pipeline."""
         feats, enc, table, derived = self._prepare_eval(ct, kind, reviews,
-                                                        cons, feat_key)
+                                                        cons, feat_key,
+                                                        cand=cand,
+                                                        target=target)
         c_dev = _param_c(enc)
         # two slabs: the second sweep overlaps the first slab's host
         # materialization. More slabs lose to the per-fetch roundtrip on
@@ -563,7 +588,8 @@ class TpuDriver(RegoDriver):
             yield _expand_parameterless(rows, cols, c_dev, len(cons))
 
     def _prepare_eval(self, ct: CompiledTemplate, kind: str,
-                      reviews: list[dict], cons: list[dict], feat_key):
+                      reviews: list[dict], cons: list[dict], feat_key,
+                      cand=None, target=None):
         params_key = (self._constraint_gen,
                       tuple((c.get("metadata") or {}).get("name", "")
                             for c in cons))
@@ -583,12 +609,29 @@ class TpuDriver(RegoDriver):
         feats = None
         if feat_key is not None:
             fcache = self._feat_cache.setdefault(kind, {})
-            feats = fcache.get(feat_key)
+            meta = fcache.get("__meta__")
+            if meta is not None and meta["key"] == feat_key:
+                feats = meta["feats"]
+            elif meta is not None and cand is not None and \
+                    target is not None:
+                # replay single-object replacements onto the cached
+                # tensors: patch the changed rows (host + device)
+                # instead of re-extracting and re-uploading everything
+                feats = self._patch_feats(ct, meta, cand, target)
+                if feats is not None:
+                    meta["key"] = feat_key
+                    meta["rev"] = self._data_rev
         if feats is None:
-            feats, _, _ = extract_batch(ct.program, self.strtab, reviews)
+            feats, buckets, n_pad = extract_batch(ct.program, self.strtab,
+                                                  reviews)
             if feat_key is not None:
                 fcache.clear()
-                fcache[feat_key] = feats
+                fcache["__meta__"] = {
+                    "key": feat_key, "feats": feats,
+                    "cand": None if cand is None else np.asarray(cand),
+                    "buckets": buckets, "n_pad": n_pad,
+                    "rev": self._data_rev,
+                }
         derived = self._derived_arrays(kind, ct)
         table = self.match_tables.materialize_packed()
         if feat_key is not None:
@@ -598,16 +641,102 @@ class TpuDriver(RegoDriver):
             feats = self._dev(feats)
         return feats, self._dev(enc), self._dev(table), self._dev(derived)
 
+    def _patch_feats(self, ct: CompiledTemplate, meta: dict, cand,
+                     target: str):
+        """Apply journaled single-object replacements to the cached
+        feature tensors: one row re-extracted per changed object (with
+        the ORIGINAL buckets — overflow falls back to a full rebuild,
+        since _fill truncates silently) and dynamic-update-sliced into
+        any device-resident copies. Returns the patched tensors or None
+        when a rebuild is required."""
+        if meta["cand"] is None or not np.array_equal(meta["cand"], cand):
+            return None
+        notes = self._notes_between(meta["rev"], self._data_rev)
+        if notes is None:
+            return None
+        from .features import Extractor
+
+        ex = Extractor(ct.program, self.strtab)
+        feats = meta["feats"]
+        buckets = meta["buckets"]
+        for n in notes:
+            if n[2] != target:
+                continue
+            i, new = n[3], n[5]
+            pos = int(np.searchsorted(cand, i))
+            if not (pos < len(cand) and int(cand[pos]) == i):
+                continue  # never a candidate: no feature row
+            sizes = ex.axis_sizes([new])
+            if any(sizes.get(a, 0) > buckets.get(a, 0) for a in sizes):
+                return None  # outgrew a bucket: rebuild
+            row = ex.extract([new], 1, buckets)
+            for slot, arrs in row.items():
+                dst = feats[slot]
+                for nm, a in arrs.items():
+                    dst[nm][pos] = a[0]
+                    self._dev_patch_row(dst[nm], pos, a[0])
+        return feats
+
+    def _dev_patch_row(self, arr, pos: int, row) -> None:
+        """Refresh a device-resident leaf after an in-place host row
+        patch: transfer only the ROW and dynamic-update it into the
+        resident buffer (a full re-upload costs seconds on a tunneled
+        chip)."""
+        ent = self._dev_cache.get(id(arr))
+        if ent is None or ent[0]() is not arr:
+            return
+        import jax
+
+        fn = getattr(self, "_row_update_fn", None)
+        if fn is None:
+            def upd(d, r, p):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    d, r[None], p, axis=0)
+            fn = self._row_update_fn = jax.jit(upd)
+        self._dev_cache[id(arr)] = (ent[0], fn(ent[1], row, np.int32(pos)))
+
     def _derived_arrays(self, kind: str, ct: CompiledTemplate) -> dict:
         """Program-local derived columns, extended to the current vocab.
         Must run after extraction/encoding interned this batch's strings
-        (same ordering contract as materialize_packed)."""
+        (same ordering contract as materialize_packed). Arrays are padded
+        to the vocab capacity bucket so their shapes stay stable under
+        vocab growth (see ops.strtab.vocab_cap)."""
         cols = self._derived_cols.get(kind) or []
         if not cols:
             return {}
         global_arrays = self.derived_tables.materialize(cols)
-        return {spec.col: global_arrays[g]
+        return {spec.col: {nm: self._pad_vocab(a)
+                           for nm, a in global_arrays[g].items()}
                 for spec, g in zip(ct.program.derived, cols)}
+
+    def _pad_vocab(self, arr):
+        """Pad a vocab-indexed array to the capacity bucket (cached by
+        source identity so steady-state audits reuse one padded copy and
+        its device buffer). Float pads are NaN (no number), others 0
+        (pad sid / K_ABSENT)."""
+        from ..ops.strtab import vocab_cap
+
+        cap = vocab_cap(len(self.strtab))
+        if arr.shape[0] >= cap:
+            return arr
+        import weakref
+
+        ent = self._vpad_cache.get(id(arr))
+        if ent is not None and ent[0]() is arr and \
+                ent[1].shape[0] == cap:
+            return ent[1]
+        pad = np.zeros((cap,) + arr.shape[1:], dtype=arr.dtype)
+        if arr.dtype.kind == "f":
+            pad[:] = np.nan
+        pad[: arr.shape[0]] = arr
+        try:
+            ref = weakref.ref(arr,
+                              lambda _r, k=id(arr):
+                              self._vpad_cache.pop(k, None))
+        except TypeError:
+            return pad
+        self._vpad_cache[id(arr)] = (ref, pad)
+        return pad
 
     # ----------------------------------------------------- batched reviews
 

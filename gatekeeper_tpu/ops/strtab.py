@@ -47,6 +47,17 @@ def canon_num(v) -> str:
     return "\x01n" + repr(f)
 
 
+def vocab_cap(v: int) -> int:
+    """Capacity bucket for vocab-indexed device arrays: padding to a
+    power of two keeps their SHAPES stable while the vocab grows, so a
+    single new interned string does not recompile every jitted sweep
+    (XLA kernels are shape-specialized)."""
+    c = 256
+    while c < v:
+        c *= 2
+    return c
+
+
 class StringTable:
     """Append-only intern table. Ids are stable for the life of the table;
     `epoch` increments on growth so cached match tables know to extend."""
@@ -246,10 +257,11 @@ class MatchTables:
         table = self.materialize()  # [R, V]
         R, V = table.shape
         W = max(1, (R + 31) // 32)
-        bits = np.zeros((V, W * 32), dtype=bool)
-        bits[:, :R] = table.T
+        cap = vocab_cap(V)  # stable shape under vocab growth
+        bits = np.zeros((cap, W * 32), dtype=bool)
+        bits[:V, :R] = table.T
         weights = (1 << np.arange(32, dtype=np.uint64)).astype(np.uint64)
-        words = (bits.reshape(V, W, 32).astype(np.uint64) * weights).sum(
+        words = (bits.reshape(cap, W, 32).astype(np.uint64) * weights).sum(
             axis=-1).astype(np.uint32)
         self._packed_cache = words
         self._packed_key = key

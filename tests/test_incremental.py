@@ -1,0 +1,161 @@
+"""Incremental inventory mutation (VERDICT r3 #4).
+
+A single-object replacement between audits must NOT force full
+re-extraction / re-upload: the patch journal replays the change onto the
+cached review list, signature cache, frozen tree, match mask, and feature
+tensors. Differential correctness against the interpreter driver is the
+authority; the mechanism assertions pin the no-rebuild property.
+"""
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.client import Backend, RegoDriver
+from gatekeeper_tpu.ir import TpuDriver
+from gatekeeper_tpu.parallel.workload import (
+    REQUIRED_LABELS_TEMPLATE, synth_constraints, synth_objects)
+from gatekeeper_tpu.target import K8sValidationTarget
+
+TARGET = "admission.k8s.gatekeeper.sh"
+N, C = 600, 12
+
+
+def _setup(driver):
+    client = Backend(driver).new_client([K8sValidationTarget()])
+    client.add_template(REQUIRED_LABELS_TEMPLATE)
+    for c in synth_constraints(C, seed=1):
+        client.add_constraint(c)
+    for o in synth_objects(N, violate_frac=0.05, seed=0):
+        client.add_data(o)
+    return client
+
+
+def _mutated(i: int, labels: dict) -> dict:
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": f"ns-{i}", "labels": labels}}
+
+
+MUTATIONS = [
+    _mutated(7, {}),                                   # all labels gone
+    _mutated(123, {"owner": "alpha.corp.example",      # healthy subset
+                   "team": "payments", "env": "prod", "tier": "frontend",
+                   "region": "us-east1", "app": "shop",
+                   "cost-center": "cc-100", "compliance": "pci",
+                   "zone": "a", "dept": "eng"}),
+    _mutated(300, {"owner": "###BAD###", "team": "x"}),
+]
+
+
+def _audit_sorted(client):
+    return sorted((r.msg, (r.resource or {}).get("metadata",
+                                                 {}).get("name", ""))
+                  for r in client.audit().results())
+
+
+def test_mutation_parity_with_interpreter():
+    ci = _setup(RegoDriver())
+    ct = _setup(TpuDriver())
+    assert _audit_sorted(ci) == _audit_sorted(ct)
+    for m in MUTATIONS:
+        ci.add_data(m)
+        ct.add_data(m)
+        assert _audit_sorted(ci) == _audit_sorted(ct)
+    # deletes fall back to a rebuild but must stay correct
+    ci.remove_data(MUTATIONS[0])
+    ct.remove_data(MUTATIONS[0])
+    assert _audit_sorted(ci) == _audit_sorted(ct)
+
+
+def test_single_object_mutation_patches_not_rebuilds(monkeypatch):
+    drv = TpuDriver()
+    client = _setup(drv)
+    client.audit()
+    client.audit()  # steady state
+    reviews_before = drv._inventory_reviews(TARGET)
+    meta = drv._feat_cache["K8sRequiredLabels"]["__meta__"]
+    feats_before = meta["feats"]
+    leaf_ids = {id(a) for arrs in feats_before.values()
+                for a in arrs.values()}
+    mask_before = drv._mask_cache[(TARGET, "K8sRequiredLabels")][2]
+
+    calls = {"extract": 0}
+    import gatekeeper_tpu.ir.driver as drvmod
+    orig = drvmod.extract_batch
+
+    def counting(*a, **k):
+        calls["extract"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(drvmod, "extract_batch", counting)
+
+    client.add_data(MUTATIONS[0])
+    res = client.audit()
+
+    assert calls["extract"] == 0, "full re-extraction ran"
+    # same review list object, one review replaced in place
+    reviews_after = drv._inventory_reviews(TARGET)
+    assert reviews_after is reviews_before
+    # same feature tensors (patched rows), same device-cacheable leaves
+    meta2 = drv._feat_cache["K8sRequiredLabels"]["__meta__"]
+    assert meta2["feats"] is feats_before
+    assert {id(a) for arrs in meta2["feats"].values()
+            for a in arrs.values()} == leaf_ids
+    # same mask array object, patched row
+    assert drv._mask_cache[(TARGET, "K8sRequiredLabels")][2] is mask_before
+    # and the mutated object's violations actually changed
+    assert any((r.resource or {}).get("metadata", {}).get("name") == "ns-7"
+               for r in res.results()), "mutation not reflected in audit"
+
+
+def test_mutation_journal_breaks_on_insert_and_delete():
+    drv = TpuDriver()
+    client = _setup(drv)
+    client.audit()
+    # insert: a NEW object shifts indices -> journal breaks -> rebuild,
+    # results must still be exact vs interpreter
+    new_obj = _mutated(99999, {})
+    ci = _setup(RegoDriver())
+    ci.add_data(new_obj)
+    client.add_data(new_obj)
+    a, b = _audit_sorted(ci), _audit_sorted(client)
+    assert a == b
+    assert any(name == "ns-99999" for _m, name in b)
+
+
+def test_namespace_mutation_with_namespace_selector():
+    """Mutating a Namespace changes OTHER reviews' match verdicts via
+    namespaceSelector — the journal must break (full rebuild), and both
+    drivers must agree in both directions (match -> no-match -> match)."""
+    def setup(driver):
+        client = Backend(driver).new_client([K8sValidationTarget()])
+        client.add_template(REQUIRED_LABELS_TEMPLATE)
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K8sRequiredLabels", "metadata": {"name": "sel"},
+            "spec": {
+                "match": {"namespaceSelector":
+                          {"matchLabels": {"env": "prod"}}},
+                "parameters": {"labels": [{"key": "team"}]},
+            }})
+        client.add_data({"apiVersion": "v1", "kind": "Namespace",
+                         "metadata": {"name": "ns-x",
+                                      "labels": {"env": "prod"}}})
+        client.add_data({"apiVersion": "v1", "kind": "Pod",
+                         "metadata": {"name": "p1", "namespace": "ns-x",
+                                      "labels": {}}})
+        return client
+
+    ci, ct = setup(RegoDriver()), setup(TpuDriver())
+    assert _audit_sorted(ci) == _audit_sorted(ct)
+    assert _audit_sorted(ct), "selector must match initially"
+    flip = {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "ns-x", "labels": {"env": "dev"}}}
+    ci.add_data(flip)
+    ct.add_data(flip)
+    assert _audit_sorted(ci) == _audit_sorted(ct) == []
+    back = {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "ns-x", "labels": {"env": "prod"}}}
+    ci.add_data(back)
+    ct.add_data(back)
+    assert _audit_sorted(ci) == _audit_sorted(ct)
+    assert _audit_sorted(ct), "selector must match again"
