@@ -136,6 +136,42 @@ def main() -> None:
     base_evals_per_sec = (len(sample_reviews) * len(sample_cons)) / base_s
     base_full_audit_s = evals / base_evals_per_sec
 
+    # ---- configs #1/#2/#3/#5 (reduced scale), driver-captured ---------
+    import subprocess
+
+    configs = {}
+    try:
+        env = dict(os.environ)
+        env.setdefault("BENCH_SCALE", "0.25")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "bench_configs.py"),
+             "1", "2", "3", "5"],
+            capture_output=True, text=True, env=env,
+            timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 600)))
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    d = json.loads(line)
+                    configs[str(d.get("config"))] = d
+                except ValueError:
+                    pass
+        if proc.returncode != 0 and not configs:
+            configs["error"] = proc.stderr[-500:]
+    except subprocess.TimeoutExpired as e:
+        for line in (e.stdout or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    d = json.loads(line)
+                    configs[str(d.get("config"))] = d
+                except ValueError:
+                    pass
+        configs["timeout"] = True
+    except Exception as e:  # never lose the headline to the side configs
+        configs["error"] = str(e)[:200]
+
     out = {
         "metric": "full_audit_wall_clock_s",
         "value": round(audit_s, 3),
@@ -160,6 +196,7 @@ def main() -> None:
         "baseline_evals_per_sec": round(base_evals_per_sec),
         "baseline_full_audit_s": round(base_full_audit_s),
         "setup_s": round(setup_s, 1),
+        "configs": configs,
     }
     print(json.dumps(out))
 

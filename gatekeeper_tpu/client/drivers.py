@@ -100,7 +100,7 @@ class RegoDriver:
         self._hmemo: dict[str, dict] = {}
         # identity-keyed freeze caches for the audit materialization loop
         # (consecutive firing pairs share the review; constraints repeat)
-        self._frz_review: tuple = (None, None)
+        self._frz_review: dict[int, tuple] = {}
         self._frz_params: dict[int, tuple] = {}
         self._frz_inv: tuple = (None, None)
         self._plain_constraint: dict[int, tuple] = {}
@@ -434,11 +434,19 @@ class RegoDriver:
         return fn
 
     def _freeze_review(self, review: dict):
-        c = self._frz_review
-        if c[0] is review:
+        # id-keyed with identity check: a micro-batch sweeps the same
+        # reviews once per KIND, and a single-entry cache would re-freeze
+        # the whole batch for every kind after the first
+        c = self._frz_review.get(id(review))
+        if c is not None and c[0] is review:
             return c[1]
+        if len(self._frz_review) > 32768:
+            # bound retention: webhook reviews are transient (never
+            # reused), so the cache exists for audits re-sweeping the
+            # stable inventory — ~32k distinct materialized objects
+            self._frz_review.clear()
         f = freeze(review)
-        self._frz_review = (review, f)
+        self._frz_review[id(review)] = (review, f)
         return f
 
     def _freeze_params(self, constraint: dict, parameters):

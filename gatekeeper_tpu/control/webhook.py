@@ -69,9 +69,17 @@ class MicroBatcher:
         self._queue: list[_Pending] = []
         self._cv = threading.Condition()
         self._stop = threading.Event()
+        # collector/flusher pipeline: a sealed batch evaluates while the
+        # next one collects, so a request that just missed a batch waits
+        # ~one flush instead of up to two
+        self._sealed: list[list[_Pending]] = []
+        self._scv = threading.Condition()
         self._thread = threading.Thread(target=self._loop, name="batcher",
                                         daemon=True)
         self._thread.start()
+        self._fthread = threading.Thread(target=self._flush_loop,
+                                         name="batcher-flush", daemon=True)
+        self._fthread.start()
         self.batches = 0
         self.batched_requests = 0
 
@@ -79,7 +87,11 @@ class MicroBatcher:
         p = _Pending(review)
         with self._cv:
             self._queue.append(p)
-            self._cv.notify()
+            if len(self._queue) == 1 or len(self._queue) >= self.max_batch:
+                # wake the collector only on the first enqueue (it sleeps
+                # to the batch deadline anyway) or on a full batch — a
+                # notify per submit makes it spin once per caller thread
+                self._cv.notify()
         if not p.done.wait(timeout):
             raise TimeoutError("admission batch timed out")
         if p.error is not None:
@@ -90,6 +102,8 @@ class MicroBatcher:
         self._stop.set()
         with self._cv:
             self._cv.notify()
+        with self._scv:
+            self._scv.notify()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -108,6 +122,20 @@ class MicroBatcher:
                     del self._queue[: len(batch)]
             if not batch:
                 continue
+            with self._scv:
+                self._sealed.append(batch)
+                self._scv.notify()
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._scv:
+                while not self._sealed and not self._stop.is_set():
+                    self._scv.wait(0.1)
+                if not self._sealed:
+                    if self._stop.is_set():
+                        return
+                    continue
+                batch = self._sealed.pop(0)
             self._flush(batch)
 
     def _flush(self, batch: list[_Pending]) -> None:

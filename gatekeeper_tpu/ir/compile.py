@@ -47,7 +47,9 @@ from .prog import (
     Exists,
     Expr,
     Guard,
+    K_ABSENT,
     K_ARR,
+    K_STR,
     KindIs,
     MatchLookup,
     Not,
@@ -134,6 +136,18 @@ class SBoolList:
 
     axes: tuple
     expr: Expr
+
+
+@dataclass(frozen=True)
+class SSprintf:
+    """sprintf("prefix%v", [arg]) held symbolically: device strings are
+    interned ids, so the concatenation itself is not representable, but
+    equality against it IS — strip the constant prefix from the other
+    side via a derived column and compare the remainder (the apparmor
+    annotation-key pattern, pod-security-policy/apparmor/src.rego)."""
+
+    prefix: str
+    arg: "Symbolic"
 
 
 @dataclass(frozen=True)
@@ -461,6 +475,15 @@ class _ClauseCompiler:
     def call_value(self, t: A.Call) -> Symbolic:
         """A call in value (binding) position."""
         fn = tuple(t.fn)
+        if fn == ("sprintf",) and len(t.args) == 2 and \
+                isinstance(t.args[0], A.Scalar) and \
+                isinstance(t.args[0].value, str) and \
+                isinstance(t.args[1], A.ArrayLit) and \
+                len(t.args[1].items) == 1:
+            fmt = t.args[0].value
+            if fmt.endswith("%v") and fmt.count("%") == 1:
+                return SSprintf(fmt[:-2],
+                                self.to_symbolic(t.args[1].items[0]))
         if len(fn) == 1 and fn[0] in _BUILTIN_DERIVED and len(t.args) == 1:
             base = self.value_expr(self.to_symbolic(t.args[0]))
             if isinstance(base, _CELL_EXPRS):
@@ -581,6 +604,28 @@ class _ClauseCompiler:
         """m[<computed>] -> iterate m's entries on a fresh axis, guarded by
         key(axis) == <computed>. The ∃-reduction over the axis then yields
         exactly the map-lookup semantics (absent key -> no binding)."""
+        if isinstance(key_sym, SSprintf):
+            # m[sprintf("prefix%v", [x])]: guard on
+            # strip_prefix(key(axis)) == x. Exact iff when x is a string
+            # (strip_prefix is UNDEF for non-prefixed keys); a numeric x
+            # would render as its decimal string, which the sid equality
+            # cannot see — those rows OVER-fire instead (host re-check
+            # is authoritative), never under-fire
+            arg_expr = self.value_expr(key_sym.arg)
+            if not isinstance(arg_expr, _CELL_EXPRS):
+                raise Uncompilable("unsupported sprintf key argument")
+            col = self.ctx.derived_col("strip_prefix", key_sym.prefix)
+            axis = self.ctx.new_axis("obj")
+            kind = "param" if sym.root == "params" else "obj"
+            out = replace(sym, segs=sym.segs + (Seg("iter", axis=axis),))
+            self._register_axis(axis, kind, out)
+            key_of_axis = self.value_expr(SKey(axis=axis, kind=kind))
+            self.guards.append(Guard(expr=Or((
+                Cmp("eq", DerivedVal(col, key_of_axis), arg_expr,
+                    dtype="auto"),
+                Not(KindIs(arg_expr, (K_ABSENT, K_STR)), ()),
+            ))))
+            return out
         key_expr = self.value_expr(key_sym)
         if not isinstance(key_expr, _CELL_EXPRS):
             raise Uncompilable("unsupported computed bracket key")
@@ -841,6 +886,19 @@ class _ClauseCompiler:
         return SExpr(self.count_of(sym), zero_only=zero_only)
 
     def eq_expr(self, lhs: Symbolic, rhs: Symbolic, op: str = "eq") -> Expr:
+        for a, b in ((lhs, rhs), (rhs, lhs)):
+            if isinstance(a, SSprintf):
+                if op != "eq":
+                    raise Uncompilable("sprintf equality only supports ==")
+                col = self.ctx.derived_col("strip_prefix", a.prefix)
+                other = self.value_expr(b)
+                arg = self.value_expr(a.arg)
+                # non-string args render to strings the sid equality
+                # cannot see: over-fire those rows (host re-check)
+                return Or((
+                    Cmp("eq", DerivedVal(col, other), arg, dtype="auto"),
+                    Not(KindIs(arg, (K_ABSENT, K_STR)), ()),
+                ))
         # equality against the empty array: kind test + zero count
         for a, b in ((lhs, rhs), (rhs, lhs)):
             if isinstance(a, SConst) and a.value == ():

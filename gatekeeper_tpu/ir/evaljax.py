@@ -589,6 +589,63 @@ def _param_c(params: dict) -> int:
     return 1
 
 
+class _EagerPairs:
+    """Dispatch handle for workloads below the slab threshold: the
+    monolithic packed sweep + row gather dispatch at CONSTRUCTION (so a
+    multi-template audit overlaps every kind's device work); only the
+    dense-small / parameter-only paths stay lazy (they are
+    latency-trivial)."""
+
+    def __init__(self, ct, feats, params, table, derived, chunk, n_true):
+        self._ct = ct
+        self._args = (feats, params, table, derived, chunk, n_true)
+        self._st = None
+        if feats:
+            n_feat = next(iter(next(iter(
+                feats.values())).values())).shape[0]
+            n = n_feat if n_true is None else min(n_feat, n_true)
+            if n_feat > chunk:
+                self._st = ct._pairs_dispatch_mono(
+                    feats, params, table, derived, chunk, n)
+
+    def pairs(self):
+        if self._st is not None:
+            yield self._ct._pairs_consume_mono(self._st)
+            return
+        feats, params, table, derived, chunk, n_true = self._args
+        yield self._ct.fires_pairs(feats, params, table, derived,
+                                   chunk=chunk, n_true=n_true)
+
+
+class _SlabPairs:
+    """Pending slab kernels; .pairs() syncs in dispatch order with the
+    capacity-retry loop."""
+
+    def __init__(self, ct, pend, feats, params, table, derived, chunk,
+                 slab, n, c):
+        self._ct = ct
+        self._pend = pend
+        self._args = (feats, params, table, derived, chunk, slab, n, c)
+
+    def pairs(self):
+        ct = self._ct
+        feats, params, table, derived, chunk, slab, n, c = self._args
+        for k, (used_rcap, dev_arr) in enumerate(self._pend):
+            arr = np.asarray(dev_arr)  # sync point + single fetch
+            rcount = int(arr[0, 0])
+            while rcount > used_rcap:
+                used_rcap = max(used_rcap,
+                                1 << (rcount - 1).bit_length())
+                fn2 = ct._slab_pairs_jit(chunk, slab, used_rcap)
+                arr = np.asarray(fn2(feats, params, table, derived,
+                                     np.int32(k * slab), np.int32(n)))
+                rcount = int(arr[0, 0])
+            ct._rows_cap = max(ct._rows_cap,
+                               (1 << (rcount - 1).bit_length())
+                               if rcount > 1 else 256)
+            yield _decode_row_blocks(arr, rcount, c)
+
+
 def _decode_row_blocks(arr: np.ndarray, rcount: int, c: int):
     """(rows, cols) row-major from a _gather_rows block: unpack each
     firing row's column bitmask on host (vectorized numpy; sub-ms even
@@ -733,6 +790,14 @@ class CompiledTemplate:
             fires = self.fires(feats, params, match_table, derived)
             rows, cols = np.nonzero(fires[:n, :c])
             return rows.astype(np.int64), cols.astype(np.int64)
+        st = self._pairs_dispatch_mono(feats, params, match_table, derived,
+                                       chunk, n)
+        return self._pairs_consume_mono(st)
+
+    def _pairs_dispatch_mono(self, feats, params, match_table, derived,
+                             chunk: int, n: int):
+        """ASYNC dispatch of the monolithic packed sweep + row gather;
+        _pairs_consume_mono syncs (with the capacity-retry loop)."""
         n_feat = next(iter(next(iter(feats.values())).values())).shape[0]
         if n_feat % chunk:
             pad_n = ((n_feat + chunk - 1) // chunk) * chunk
@@ -742,12 +807,17 @@ class CompiledTemplate:
         packed = self._packed_device(feats, params, match_table, derived,
                                      chunk)
         rcap = self._rows_cap
-        while True:
+        dev = self._gather_rows(packed, n, rcap)
+        return (packed, n, rcap, dev, _param_c(params))
+
+    def _pairs_consume_mono(self, st):
+        packed, n, rcap, dev, c = st
+        arr = np.asarray(dev)  # sync
+        rcount = int(arr[0, 0])
+        while rcount > rcap:
+            rcap = max(rcap, 1 << (rcount - 1).bit_length())
             arr = np.asarray(self._gather_rows(packed, n, rcap))
             rcount = int(arr[0, 0])
-            if rcount <= rcap:
-                break
-            rcap = max(rcap, 1 << (rcount - 1).bit_length())
         self._rows_cap = max(256, (1 << (rcount - 1).bit_length())
                              if rcount > 1 else 256)
         return _decode_row_blocks(arr, rcount, c)
@@ -815,19 +885,17 @@ class CompiledTemplate:
         self._pairs_cache[key] = fn
         return fn
 
-    def fires_pairs_slabbed(self, feats: dict, params: dict,
-                            match_table: np.ndarray,
-                            derived: Optional[dict] = None,
-                            chunk: int = 8192,
-                            slab: int = 32768,
-                            n_true: Optional[int] = None):
-        """Yield row-major (rows, cols) firing pairs per N-axis slab.
-
-        ALL slab dispatches (one fused kernel each) go out before the
-        first yield, so the device works ahead on slab k+1 while the
-        host materializes slab k's messages — one audit costs
-        ~max(sweep, materialize) wall-clock instead of their sum. Falls
-        back to one fires_pairs call when a single slab suffices."""
+    def fires_pairs_dispatch(self, feats: dict, params: dict,
+                             match_table: np.ndarray,
+                             derived: Optional[dict] = None,
+                             chunk: int = 8192,
+                             slab: int = 32768,
+                             n_true: Optional[int] = None):
+        """Dispatch every slab kernel NOW (async); the returned handle's
+        .pairs() iterator syncs and decodes slab-by-slab. Callers can
+        dispatch MANY templates' sweeps before consuming any — the audit
+        overlaps every kind's device work with every kind's host
+        materialization."""
         derived = derived or {}
         n_feat = (next(iter(next(iter(feats.values())).values())).shape[0]
                   if feats else 0)
@@ -835,31 +903,29 @@ class CompiledTemplate:
         if n_true is not None:
             n = min(n, n_true)
         if not feats or n <= slab or n_feat < slab:
-            yield self.fires_pairs(feats, params, match_table, derived,
-                                   chunk=chunk, n_true=n_true)
-            return
+            return _EagerPairs(self, feats, params, match_table, derived,
+                               chunk, n_true)
         c = _param_c(params)
         n_slabs = (n + slab - 1) // slab
         rcap = self._rows_cap
         fn = self._slab_pairs_jit(chunk, slab, rcap)
-        pend = []
-        for k in range(n_slabs):
-            pend.append((rcap,
-                         fn(feats, params, match_table, derived,
-                            np.int32(k * slab), np.int32(n))))
-        for k, (used_rcap, dev_arr) in enumerate(pend):
-            arr = np.asarray(dev_arr)  # sync point + single fetch, slab k
-            rcount = int(arr[0, 0])
-            while rcount > used_rcap:
-                used_rcap = max(used_rcap, 1 << (rcount - 1).bit_length())
-                fn2 = self._slab_pairs_jit(chunk, slab, used_rcap)
-                arr = np.asarray(fn2(feats, params, match_table, derived,
-                                     np.int32(k * slab), np.int32(n)))
-                rcount = int(arr[0, 0])
-            self._rows_cap = max(self._rows_cap,
-                                 (1 << (rcount - 1).bit_length())
-                                 if rcount > 1 else 256)
-            yield _decode_row_blocks(arr, rcount, c)
+        pend = [(rcap, fn(feats, params, match_table, derived,
+                          np.int32(k * slab), np.int32(n)))
+                for k in range(n_slabs)]
+        return _SlabPairs(self, pend, feats, params, match_table, derived,
+                          chunk, slab, n, c)
+
+    def fires_pairs_slabbed(self, feats: dict, params: dict,
+                            match_table: np.ndarray,
+                            derived: Optional[dict] = None,
+                            chunk: int = 8192,
+                            slab: int = 32768,
+                            n_true: Optional[int] = None):
+        """Yield row-major (rows, cols) firing pairs per N-axis slab.
+        See fires_pairs_dispatch; this is dispatch + immediate consume."""
+        yield from self.fires_pairs_dispatch(
+            feats, params, match_table, derived, chunk=chunk, slab=slab,
+            n_true=n_true).pairs()
 
     def _gather_rows(self, packed, n: int, rcap: int):
         """Device firing-ROW gather: one [rcap+1, W+1] uint32 block —

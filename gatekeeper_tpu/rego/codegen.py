@@ -43,6 +43,9 @@ _MISS = object()  # fmemo miss sentinel (UNDEF is a legitimate result)
 # ----------------------------------------------------------- runtime helpers
 
 
+_SORTED_SETS: dict[int, tuple] = {}  # id(frozenset) -> (set, sorted tuple)
+
+
 def _enum(base):
     """Value-only _enumerate (interp.py:696): (key, value) children."""
     if isinstance(base, dict):  # FrozenDict included
@@ -50,7 +53,17 @@ def _enum(base):
     if isinstance(base, tuple):
         return enumerate(base)
     if isinstance(base, frozenset):
-        return ((m, m) for m in sorted(base, key=sort_key))
+        # canonical order is hot (parameter sets re-enumerate per pair);
+        # identity-keyed cache with a liveness check, bounded
+        ent = _SORTED_SETS.get(id(base))
+        if ent is not None and ent[0] is base:
+            srt = ent[1]
+        else:
+            if len(_SORTED_SETS) > 4096:
+                _SORTED_SETS.clear()
+            srt = tuple(sorted(base, key=sort_key))
+            _SORTED_SETS[id(base)] = (base, srt)
+        return ((m, m) for m in srt)
     return ()
 
 
@@ -380,6 +393,17 @@ def _sections_ok(module: A.Module) -> bool:
     return ok
 
 
+def _is_const_term(t) -> bool:
+    if isinstance(t, A.Scalar):
+        return True
+    if isinstance(t, (A.ArrayLit, A.SetLit)):
+        return all(_is_const_term(x) for x in t.items)
+    if isinstance(t, A.ObjectLit):
+        return all(_is_const_term(k) and _is_const_term(v)
+                   for k, v in t.items)
+    return False
+
+
 class ModuleCompiler:
     def __init__(self, module: A.Module):
         module = reorder_module(module)
@@ -388,6 +412,16 @@ class ModuleCompiler:
         self.rules: dict[str, list[A.Rule]] = {}
         for r in module.rules:
             self.rules.setdefault(r.name, []).append(r)
+        # constant rules (pure literal values, e.g. unit tables like
+        # containerlimits' unit_scale): folded to one module-level value
+        # at compile time instead of re-materializing per evaluation,
+        # and transparent to the arg-purity analysis so quantity-parsing
+        # helpers that read them still memoize on their arguments
+        self.const_rules = {
+            name for name, rs in self.rules.items()
+            if len(rs) == 1 and rs[0].kind == "complete"
+            and not rs[0].body and not rs[0].is_default
+            and rs[0].value is not None and _is_const_term(rs[0].value)}
         self.arg_pure = self._arg_pure_fns()
         self.em = _Emit()
         self.builtin_bindings: dict[tuple, str] = {}
@@ -441,6 +475,8 @@ class ModuleCompiler:
                     continue
                 for n in names:
                     if n in self.rules and n not in fns:
+                        if n in self.const_rules:
+                            continue  # constants are pure by definition
                         pure.discard(name)  # reads a document rule
                         changed = True
                         break
@@ -1768,6 +1804,12 @@ class ModuleCompiler:
             raise Unsupported(f"no {entry} rule")
         for name in self.rules:
             self._emit_rule(name)
+        for name in sorted(self.const_rules):
+            # fold: evaluate once at module build, rebind to a closure
+            self.em.w(0, f"_const_{name} = rule_{name}({{'memo': {{}}}})")
+            self.em.w(0, f"def rule_{name}(_J, _v=_const_{name}):")
+            self.em.w(1, "return _v")
+            self.em.w(0, "")
         if self._sections:
             # sections mode: review/parameters come in as direct args —
             # callers skip the per-call input-wrapper construction
