@@ -1,0 +1,6 @@
+{{/*
+Name helpers, mirroring chart/gatekeeper-operator/templates/_helpers.tpl.
+*/}}
+{{- define "gatekeeper-tpu.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}

@@ -1,0 +1,212 @@
+"""Manifest-driven end-to-end scenario suite.
+
+The analog of the reference's bats e2e table (test/bats/test.bats: 12
+admission/audit/sync scenarios against deployed manifests): every
+scenario here drives the REAL entrypoint (control.main.Runtime with the
+in-memory apiserver) using the agilebank demo's YAML manifests
+(demo/agilebank/**, the counterpart of demo/agilebank/ + the dryrun
+walkthrough), with admission requests over real HTTP against the
+webhook server.
+"""
+
+import http.client
+import json
+from pathlib import Path
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.control.main import Runtime, build_parser
+
+DEMO = Path(__file__).resolve().parent.parent / "demo" / "agilebank"
+TEMPLATE_GVK = ("templates.gatekeeper.sh", "v1beta1", "ConstraintTemplate")
+CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
+
+
+def load(rel: str) -> dict:
+    return yaml.safe_load((DEMO / rel).read_text())
+
+
+def load_dir(rel: str) -> list[dict]:
+    return [yaml.safe_load(p.read_text())
+            for p in sorted((DEMO / rel).glob("*.yaml"))]
+
+
+def admission_review(obj, operation="CREATE", username="alice", old=None):
+    group, _, version = (obj.get("apiVersion") or "").rpartition("/")
+    req = {
+        "uid": "uid-e2e",
+        "kind": {"group": group, "version": version, "kind": obj["kind"]},
+        "operation": operation,
+        "name": obj["metadata"]["name"],
+        "userInfo": {"username": username},
+        "object": obj if operation != "DELETE" else None,
+    }
+    if old is not None:
+        req["oldObject"] = old
+    ns_ = obj["metadata"].get("namespace")
+    if ns_:
+        req["namespace"] = ns_
+    return {"apiVersion": "admission.k8s.io/v1beta1",
+            "kind": "AdmissionReview", "request": req}
+
+
+@pytest.fixture(scope="module")
+def rt():
+    """One Runtime for the whole scenario table, like one deployed
+    cluster for the whole bats run."""
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--disable-cert-rotation", "--exempt-namespace",
+        "gatekeeper-system",
+    ])
+    runtime = Runtime(args)
+    runtime.args.metrics_backend = "none"
+    runtime.kube.register_kind(("networking.k8s.io", "v1", "Ingress"),
+                               namespaced=True)
+    runtime.start()
+    yield runtime
+    runtime.stop()
+
+
+def post(rt, path: str, payload: dict) -> dict:
+    conn = http.client.HTTPConnection("127.0.0.1", rt.webhook.port,
+                                      timeout=10)
+    conn.request("POST", path, json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    return json.loads(conn.getresponse().read())
+
+
+def admit(rt, obj, **kw) -> dict:
+    return post(rt, "/v1/admit", admission_review(obj, **kw))["response"]
+
+
+# --- the scenario table (ordered; module-scoped runtime carries state) --
+
+
+def test_01_templates_apply_and_crds_established(rt):
+    for tpl in load_dir("templates"):
+        rt.kube.create(tpl)
+    rt.manager.drain()
+    for tpl in load_dir("templates"):
+        kind = tpl["spec"]["crd"]["spec"]["names"]["kind"]
+        crd = rt.kube.get(
+            ("apiextensions.k8s.io", "v1beta1",
+             "CustomResourceDefinition"),
+            f"{kind.lower()}.{CONSTRAINT_GROUP}")
+        assert crd["spec"]["names"]["kind"] == kind
+        stored = rt.kube.get(TEMPLATE_GVK, tpl["metadata"]["name"])
+        assert stored["status"]["created"] is True
+        assert rt.opa.knows_kind(kind)
+
+
+def test_02_constraints_apply_and_enforce(rt):
+    for c in load_dir("constraints") + [load("dryrun/unique_ingress_host.yaml")]:
+        rt.kube.create(c)
+    rt.manager.drain()
+    stored = rt.kube.get((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                         "all-must-have-owner")
+    assert stored["status"]["byPod"][0]["enforced"] is True
+
+
+def test_03_sync_config_populates_inventory(rt):
+    rt.kube.create(load("sync.yaml"))
+    rt.kube.create(load("existing_resources/payments_service.yaml"))
+    rt.kube.create(load("dryrun/existing_ingress.yaml"))
+    rt.manager.drain()
+    dump = json.loads(rt.opa.dump())
+    inv = dump["data"]["external"]["admission.k8s.gatekeeper.sh"]
+    assert "payments" in inv["namespace"]["production"]["v1"]["Service"]
+    assert "checkout" in \
+        inv["namespace"]["payments"]["networking.k8s.io/v1"]["Ingress"]
+
+
+def test_04_namespace_label_webhook_serving(rt):
+    bad = {"apiVersion": "v1", "kind": "Namespace",
+           "metadata": {"name": "sneaky",
+                        "labels": {"admission.gatekeeper.sh/ignore":
+                                   "yes-please"}}}
+    out = post(rt, "/v1/admitlabel", admission_review(bad))
+    assert out["response"]["allowed"] is False
+    exempt = {"apiVersion": "v1", "kind": "Namespace",
+              "metadata": {"name": "gatekeeper-system",
+                           "labels": {"admission.gatekeeper.sh/ignore":
+                                      "no-self-managing"}}}
+    out = post(rt, "/v1/admitlabel", admission_review(exempt))
+    assert out["response"]["allowed"] is True
+
+
+def test_05_required_labels_denies_bad_namespace(rt):
+    resp = admit(rt, load("bad_resources/namespace.yaml"))
+    assert resp["allowed"] is False
+    assert "owner" in resp["status"]["reason"]
+    resp = admit(rt, load("good_resources/namespace.yaml"))
+    assert resp["allowed"] is True
+
+
+def test_06_container_limits(rt):
+    assert admit(rt, load("bad_resources/opa_no_limits.yaml"))["allowed"] \
+        is False
+    assert admit(rt,
+                 load("bad_resources/opa_limits_too_high.yaml"))["allowed"] \
+        is False
+    assert admit(rt, load("good_resources/opa.yaml"))["allowed"] is True
+
+
+def test_07_allowed_repos_in_production(rt):
+    resp = admit(rt, load("bad_resources/opa_wrong_repo.yaml"))
+    assert resp["allowed"] is False
+    assert "repo" in resp["status"]["reason"]
+
+
+def test_08_unique_service_selector_join(rt):
+    resp = admit(rt, load("bad_resources/duplicate_service.yaml"))
+    assert resp["allowed"] is False
+    assert "same selector" in resp["status"]["reason"]
+    distinct = {"apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": "ledger", "namespace": "production"},
+                "spec": {"selector": {"app": "ledger"},
+                         "ports": [{"port": 80}]}}
+    assert admit(rt, distinct)["allowed"] is True
+
+
+def test_09_dryrun_constraint_allows_but_audits(rt):
+    conflicting = load("dryrun/conflicting_ingress.yaml")
+    # dryrun: admission must NOT deny the conflicting ingress
+    assert admit(rt, conflicting)["allowed"] is True
+    # ... but the audit must report it once it exists in the cluster
+    rt.kube.create(conflicting)
+    rt.manager.drain()
+    rt.audit.audit_once()
+    stored = rt.kube.get((CONSTRAINT_GROUP, "v1beta1",
+                          "K8sUniqueIngressHost"), "unique-ingress-host")
+    viol = stored["status"].get("violations") or []
+    assert any(v["enforcementAction"] == "dryrun" for v in viol)
+    assert {v["name"] for v in viol} >= {"checkout", "checkout-v2"}
+
+
+def test_10_audit_reports_required_label_violations(rt):
+    rt.kube.create({"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "ownerless"}})
+    rt.manager.drain()
+    rt.audit.audit_once()
+    stored = rt.kube.get((CONSTRAINT_GROUP, "v1beta1",
+                          "K8sRequiredLabels"), "all-must-have-owner")
+    viol = stored["status"].get("violations") or []
+    assert any(v["name"] == "ownerless" for v in viol)
+    assert stored["status"]["totalViolations"] >= 1
+    assert any("owner" in v["message"] for v in viol)
+
+
+def test_11_remediated_resources_pass(rt):
+    fixed = load("bad_resources/namespace.yaml")
+    fixed["metadata"]["labels"] = {"owner": "treasury.agilebank.demo"}
+    assert admit(rt, fixed)["allowed"] is True
+
+
+def test_12_deleting_constraint_stops_enforcement(rt):
+    rt.kube.delete((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                   "all-must-have-owner")
+    rt.manager.drain()
+    assert admit(rt, load("bad_resources/namespace.yaml"))["allowed"] \
+        is True
