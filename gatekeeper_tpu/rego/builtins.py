@@ -1,9 +1,11 @@
 """Builtin functions for the Rego subset.
 
-Coverage is the builtin surface actually exercised by the reference policy
-corpus (SURVEY.md §2.3): sprintf, count, to_number, is_* type checks,
-substring, re_match, startswith/endswith/contains, replace, trim, split,
-concat, min/max/sum, any/all, plus sort/lower/upper/abs for completeness.
+Coverage: the builtin surface exercised by the reference policy corpus
+(SURVEY.md §2.3) plus the commonly-used remainder of OPA's library —
+117 builtins across strings/regex/aggregates/objects/encoding (json,
+yaml, base64, hex, urlquery)/crypto (hashes, hmac)/time/units/net.cidr/
+semver/bits/type checks. Semantics mirror OPA topdown
+(vendor/.../opa/topdown/*.go); tests pin literal expected values.
 
 Error semantics: a builtin raising BuiltinError makes the enclosing
 expression *undefined* (the literal fails; under `not` it succeeds). This is
@@ -22,6 +24,12 @@ from ..utils.values import FrozenDict, format_value, rego_eq, sort_key, type_nam
 
 class BuiltinError(Exception):
     pass
+
+
+# builtins whose results must never be memoized (non-pure): the codegen
+# purity analyses (arg-pure fmemo, review/params-pure rmemo/pmemo, the
+# head-witness memo) all consult this set
+NONDETERMINISTIC: set = {("time", "now_ns"), ("print",), ("trace",)}
 
 
 _REGEX_CACHE: dict[str, "re.Pattern[str]"] = {}
@@ -415,4 +423,537 @@ BUILTINS.update({
     if _need_str(p, "trim_suffix")
     and _need_str(s, "trim_suffix").endswith(_need_str(p, "trim_suffix"))
     else _need_str(s, "trim_suffix"),
+})
+
+
+# ---- breadth batch 2 (round 4): the commonly-used remainder of OPA's
+# builtin surface (vendor/.../opa/topdown/*.go semantics; frozen values
+# in and out, BuiltinError -> undefined)
+
+import base64 as _base64
+import binascii as _binascii
+import json
+import datetime as _dt
+import hashlib as _hashlib
+import hmac as _hmac_mod
+import ipaddress as _ipaddress
+import math as _math
+import time as _time
+import urllib.parse as _urlparse
+
+from ..utils.values import freeze, thaw
+
+
+def _bi_object_keys(o):
+    _need(o, "object", "object.keys")
+    return frozenset(o.keys())
+
+
+def _bi_object_remove(o, ks):
+    _need(o, "object", "object.remove")
+    drop = set(_iterable(ks, "object.remove"))
+    return FrozenDict((k, v) for k, v in o.items()
+                      if not any(rego_eq(k, d) for d in drop))
+
+
+def _bi_object_filter(o, ks):
+    _need(o, "object", "object.filter")
+    keep = set(_iterable(ks, "object.filter"))
+    return FrozenDict((k, v) for k, v in o.items()
+                      if any(rego_eq(k, d) for d in keep))
+
+
+def _bi_object_union(a, b):
+    _need(a, "object", "object.union")
+    _need(b, "object", "object.union")
+
+    def merge(x, y):
+        if isinstance(x, FrozenDict) and isinstance(y, FrozenDict):
+            out = dict(x)
+            for k, v in y.items():
+                out[k] = merge(out[k], v) if k in out else v
+            return FrozenDict(out)
+        return y
+
+    return merge(a, b)
+
+
+def _bi_object_union_n(objs):
+    items = _iterable(objs, "object.union_n")
+    out = FrozenDict()
+    for o in items:
+        out = _bi_object_union(out, _need(o, "object", "object.union_n"))
+    return out
+
+
+def _bi_regex_split(pattern, s):
+    return tuple(compiled_regex(_need_str(pattern, "regex.split")).split(
+        _need_str(s, "regex.split")))
+
+
+def _bi_regex_is_valid(pattern):
+    if not isinstance(pattern, str):
+        return False
+    try:
+        re.compile(pattern)
+        return True
+    except re.error:
+        return False
+
+
+_GO_REF = re.compile(r"\$(\$|\{[A-Za-z0-9_]+\}|[A-Za-z0-9_]+)")
+
+
+def _go_expand(template: str, m: "re.Match") -> str:
+    """Go regexp.Expand: $1/${name} are submatch references; $$ is a
+    literal $; unknown groups expand to the empty string."""
+    def ref(rm):
+        name = rm.group(1)
+        if name == "$":
+            return "$"
+        if name.startswith("{"):
+            name = name[1:-1]
+        try:
+            if name.isdigit():
+                idx = int(name)
+                if idx > m.re.groups:
+                    return ""
+                return m.group(idx) or ""
+            return m.group(name) or ""
+        except IndexError:  # unknown group: empty (Go Expand)
+            return ""
+    return _GO_REF.sub(ref, template)
+
+
+def _bi_regex_replace(s, pattern, value):
+    pat = compiled_regex(_need_str(pattern, "regex.replace"))
+    tmpl = _need_str(value, "regex.replace")
+    return pat.sub(lambda m: _go_expand(tmpl, m),
+                   _need_str(s, "regex.replace"))
+
+
+def _bi_regex_find_n(pattern, s, n):
+    pat = compiled_regex(_need_str(pattern, "regex.find_n"))
+    cnt = int(_need_num(n, "regex.find_n"))
+    out = [m.group(0) for m in pat.finditer(_need_str(s, "regex.find_n"))]
+    return tuple(out if cnt < 0 else out[:cnt])
+
+
+def _bi_strings_reverse(s):
+    return _need_str(s, "strings.reverse")[::-1]
+
+
+def _bi_strings_count(s, sub):
+    return _need_str(s, "strings.count").count(
+        _need_str(sub, "strings.count"))
+
+
+def _bi_indexof_n(s, sub):
+    h = _need_str(s, "indexof_n")
+    n = _need_str(sub, "indexof_n")
+    out, i = [], h.find(n)
+    while i != -1:
+        out.append(i)
+        i = h.find(n, i + 1)
+    return tuple(out)
+
+
+def _bi_replace_n(patterns, s):
+    _need(patterns, "object", "strings.replace_n")
+    out = _need_str(s, "strings.replace_n")
+    for old, new in patterns.items():
+        out = out.replace(_need_str(old, "strings.replace_n"),
+                          _need_str(new, "strings.replace_n"))
+    return out
+
+
+def _bi_any_prefix_match(search, base):
+    ss = [search] if isinstance(search, str) else \
+        _iterable(search, "strings.any_prefix_match")
+    bs = [base] if isinstance(base, str) else \
+        _iterable(base, "strings.any_prefix_match")
+    return any(_need_str(s, "strings.any_prefix_match").startswith(
+        _need_str(b, "strings.any_prefix_match")) for s in ss for b in bs)
+
+
+def _bi_any_suffix_match(search, base):
+    ss = [search] if isinstance(search, str) else \
+        _iterable(search, "strings.any_suffix_match")
+    bs = [base] if isinstance(base, str) else \
+        _iterable(base, "strings.any_suffix_match")
+    return any(_need_str(s, "strings.any_suffix_match").endswith(
+        _need_str(b, "strings.any_suffix_match")) for s in ss for b in bs)
+
+
+def _bi_hex_encode(s):
+    return _need_str(s, "hex.encode").encode().hex()
+
+
+def _bi_hex_decode(s):
+    try:
+        return bytes.fromhex(_need_str(s, "hex.decode")).decode()
+    except (ValueError, UnicodeDecodeError) as e:
+        raise BuiltinError(f"hex.decode: {e}") from None
+
+
+def _bi_urlquery_encode(s):
+    return _urlparse.quote_plus(_need_str(s, "urlquery.encode"))
+
+
+def _bi_urlquery_decode(s):
+    return _urlparse.unquote_plus(_need_str(s, "urlquery.decode"))
+
+
+def _bi_urlquery_encode_object(o):
+    _need(o, "object", "urlquery.encode_object")
+    parts = []
+    for k, v in o.items():
+        key = _urlparse.quote_plus(_need_str(k, "urlquery.encode_object"))
+        vals = [v] if isinstance(v, str) else \
+            _iterable(v, "urlquery.encode_object")
+        for x in vals:
+            parts.append(f"{key}="
+                         f"{_urlparse.quote_plus(_need_str(x, 'urlquery'))}")
+    return "&".join(parts)
+
+
+def _bi_urlquery_decode_object(s):
+    parsed = _urlparse.parse_qs(_need_str(s, "urlquery.decode_object"),
+                                keep_blank_values=True)
+    return FrozenDict((k, tuple(v)) for k, v in parsed.items())
+
+
+def _bi_json_is_valid(s):
+    if not isinstance(s, str):
+        return False
+    try:
+        json.loads(s)
+        return True
+    except ValueError:
+        return False
+
+
+def _bi_yaml_marshal(v):
+    import yaml as _yaml
+    return _yaml.safe_dump(thaw(v), default_flow_style=False)
+
+
+def _bi_yaml_unmarshal(s):
+    import yaml as _yaml
+    try:
+        return freeze(_yaml.safe_load(_need_str(s, "yaml.unmarshal")))
+    except _yaml.YAMLError as e:
+        raise BuiltinError(f"yaml.unmarshal: {e}") from None
+
+
+def _bi_yaml_is_valid(s):
+    import yaml as _yaml
+    if not isinstance(s, str):
+        return False
+    try:
+        _yaml.safe_load(s)
+        return True
+    except _yaml.YAMLError:
+        return False
+
+
+def _bi_base64_is_valid(s):
+    if not isinstance(s, str):
+        return False
+    try:
+        _base64.b64decode(s, validate=True)
+        return True
+    except (_binascii.Error, ValueError):
+        return False
+
+
+def _hash(algo):
+    def run(s):
+        return getattr(_hashlib, algo)(
+            _need_str(s, f"crypto.{algo}").encode()).hexdigest()
+    return run
+
+
+def _hmac(algo):
+    def run(s, key):
+        return _hmac_new(_need_str(key, f"crypto.hmac.{algo}"),
+                         _need_str(s, f"crypto.hmac.{algo}"), algo)
+    return run
+
+
+def _hmac_new(key: str, msg: str, algo: str) -> str:
+    return _hmac_mod.new(key.encode(), msg.encode(),
+                         getattr(_hashlib, algo)).hexdigest()
+
+
+def _bi_ceil(x):
+    return int(_math.ceil(_need_num(x, "ceil")))
+
+
+def _bi_floor(x):
+    return int(_math.floor(_need_num(x, "floor")))
+
+
+def _bi_numbers_range_step(a, b, step):
+    lo = _need_num(a, "numbers.range_step")
+    hi = _need_num(b, "numbers.range_step")
+    st = _need_num(step, "numbers.range_step")
+    if not float(st).is_integer() or st <= 0:
+        raise BuiltinError("numbers.range_step: step must be a positive "
+                           "integer")
+    st = int(st)
+    if lo <= hi:
+        return tuple(range(int(lo), int(hi) + 1, st))
+    return tuple(range(int(lo), int(hi) - 1, -st))
+
+
+def _bi_array_reverse(a):
+    _need(a, "array", "array.reverse")
+    return tuple(reversed(a))
+
+
+def _bi_time_now_ns():
+    return int(_time.time() * 1e9)
+
+
+_FRAC_RE = re.compile(r"\.(\d+)")
+
+
+def _bi_parse_rfc3339_ns(s):
+    v = _need_str(s, "time.parse_rfc3339_ns")
+    try:
+        if v.endswith("Z"):
+            v = v[:-1] + "+00:00"
+        frac_ns = 0
+        fm = _FRAC_RE.search(v)
+        if fm:
+            digits = fm.group(1)[:9]
+            frac_ns = int(digits.ljust(9, "0"))
+            v = v[: fm.start()] + v[fm.end():]
+        dt = _dt.datetime.fromisoformat(v)
+    except ValueError as e:
+        raise BuiltinError(f"time.parse_rfc3339_ns: {e}") from None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return int(dt.timestamp()) * 10**9 + frac_ns
+
+
+def _ns_to_dt(ns) -> "_dt.datetime":
+    # integer split: float division of ~1e18 ns loses sub-us precision
+    s, rem = divmod(int(_need_num(ns, "time")), 10**9)
+    return _dt.datetime.fromtimestamp(s, tz=_dt.timezone.utc).replace(
+        microsecond=rem // 1000)
+
+
+def _bi_time_date(ns):
+    d = _ns_to_dt(ns)
+    return (d.year, d.month, d.day)
+
+
+def _bi_time_clock(ns):
+    d = _ns_to_dt(ns)
+    return (d.hour, d.minute, d.second)
+
+
+def _bi_time_weekday(ns):
+    return _ns_to_dt(ns).strftime("%A")
+
+
+def _bi_time_add_date(ns, years, months, days):
+    d = _ns_to_dt(ns)
+    y = int(_need_num(years, "time.add_date"))
+    mo = int(_need_num(months, "time.add_date"))
+    dd = int(_need_num(days, "time.add_date"))
+    month0 = d.month - 1 + mo
+    year = d.year + y + month0 // 12
+    month = month0 % 12 + 1
+    # Go's AddDate normalizes out-of-range days by rolling over
+    day = d.day
+    base = _dt.datetime(year, month, 1, d.hour, d.minute, d.second,
+                        d.microsecond, tzinfo=_dt.timezone.utc)
+    out = base + _dt.timedelta(days=day - 1 + dd)
+    return int(out.timestamp()) * 10**9 + out.microsecond * 1000
+
+
+_UNITS = {"": 1, "k": 10**3, "m": 10**6, "g": 10**9, "t": 10**12,
+          "p": 10**15, "e": 10**18,
+          "ki": 2**10, "mi": 2**20, "gi": 2**30, "ti": 2**40,
+          "pi": 2**50, "ei": 2**60}
+
+
+def _parse_units(s: str, fn: str, milli_ok: bool, bytes_ok: bool):
+    v = _need_str(s, fn).strip().strip('"')
+    if not v:
+        raise BuiltinError(f"{fn}: no amount provided")
+    i = len(v)
+    while i > 0 and not (v[i - 1].isdigit() or v[i - 1] == "."):
+        i -= 1
+    num, raw = v[:i], v[i:]
+    if not num:
+        raise BuiltinError(f"{fn}: no amount provided")
+    try:
+        base = float(num) if "." in num else int(num)
+    except ValueError as e:
+        raise BuiltinError(f"{fn}: {e}") from None
+    if milli_ok and raw == "m":  # case-sensitive: 'M' is mega, 'm' milli
+        return base / 1000
+    suffix = raw.lower()
+    if bytes_ok:  # only parse_bytes accepts b/KB/KiB spellings
+        if suffix == "b":
+            suffix = ""
+        elif suffix.endswith("b") and suffix[:-1] in _UNITS:
+            suffix = suffix[:-1]
+    if suffix not in _UNITS:
+        raise BuiltinError(f"{fn}: unknown unit suffix {raw!r}")
+    out = base * _UNITS[suffix]
+    return int(out) if float(out).is_integer() else out
+
+
+def _bi_units_parse(s):
+    # decimal k/M/G... and binary Ki/Mi/Gi... (no bytes 'b' suffix)
+    return _parse_units(s, "units.parse", milli_ok=True, bytes_ok=False)
+
+
+def _bi_units_parse_bytes(s):
+    return int(_parse_units(s, "units.parse_bytes", milli_ok=False,
+                            bytes_ok=True))
+
+
+def _net(v, fn):
+    try:
+        s = _need_str(v, fn)
+        if "/" in s:
+            return _ipaddress.ip_network(s, strict=False)
+        return _ipaddress.ip_network(s + "/32" if ":" not in s
+                                     else s + "/128", strict=False)
+    except ValueError as e:
+        raise BuiltinError(f"{fn}: {e}") from None
+
+
+def _bi_cidr_contains(cidr, x):
+    net = _net(cidr, "net.cidr_contains")
+    other = _net(x, "net.cidr_contains")
+    try:
+        return other.subnet_of(net)
+    except TypeError as e:  # mixed IPv4/IPv6: undefined, not a crash
+        raise BuiltinError(f"net.cidr_contains: {e}") from None
+
+
+def _bi_cidr_intersects(a, b):
+    try:
+        return _net(a, "net.cidr_intersects").overlaps(
+            _net(b, "net.cidr_intersects"))
+    except TypeError as e:
+        raise BuiltinError(f"net.cidr_intersects: {e}") from None
+
+
+def _bi_cidr_is_valid(v):
+    if not isinstance(v, str):
+        return False
+    try:
+        _ipaddress.ip_network(v, strict=False)
+        return True
+    except ValueError:
+        return False
+
+
+_SEMVER = re.compile(
+    r"^(\d+)\.(\d+)\.(\d+)(?:-([0-9A-Za-z.-]+))?(?:\+[0-9A-Za-z.-]+)?$")
+
+
+def _semver_key(v: str, fn: str):
+    m = _SEMVER.match(_need_str(v, fn))
+    if not m:
+        raise BuiltinError(f"{fn}: invalid semver {v!r}")
+    major, minor, patch = int(m.group(1)), int(m.group(2)), int(m.group(3))
+    pre = m.group(4)
+    if pre is None:
+        pre_key = (1,)  # releases sort after any pre-release
+    else:
+        parts = []
+        for p in pre.split("."):
+            parts.append((0, int(p)) if p.isdigit() else (1, p))
+        pre_key = (0, tuple(parts))
+    return (major, minor, patch, pre_key)
+
+
+def _bi_semver_is_valid(v):
+    return isinstance(v, str) and bool(_SEMVER.match(v))
+
+
+def _bi_semver_compare(a, b):
+    ka = _semver_key(a, "semver.compare")
+    kb = _semver_key(b, "semver.compare")
+    return -1 if ka < kb else (1 if ka > kb else 0)
+
+
+def _bits(fn_name, op):
+    def run(a, b):
+        x = _need_num(a, fn_name)
+        y = _need_num(b, fn_name)
+        if not float(x).is_integer() or not float(y).is_integer():
+            raise BuiltinError(f"{fn_name}: operands must be integers")
+        return op(int(x), int(y))
+    return run
+
+
+BUILTINS.update({
+    ("object", "keys"): _bi_object_keys,
+    ("object", "remove"): _bi_object_remove,
+    ("object", "filter"): _bi_object_filter,
+    ("object", "union"): _bi_object_union,
+    ("object", "union_n"): _bi_object_union_n,
+    ("regex", "split"): _bi_regex_split,
+    ("regex", "is_valid"): _bi_regex_is_valid,
+    ("regex", "replace"): _bi_regex_replace,
+    ("regex", "find_n"): _bi_regex_find_n,
+    ("strings", "reverse"): _bi_strings_reverse,
+    ("strings", "count"): _bi_strings_count,
+    ("strings", "replace_n"): _bi_replace_n,
+    ("strings", "any_prefix_match"): _bi_any_prefix_match,
+    ("strings", "any_suffix_match"): _bi_any_suffix_match,
+    ("indexof_n",): _bi_indexof_n,
+    ("hex", "encode"): _bi_hex_encode,
+    ("hex", "decode"): _bi_hex_decode,
+    ("urlquery", "encode"): _bi_urlquery_encode,
+    ("urlquery", "decode"): _bi_urlquery_decode,
+    ("urlquery", "encode_object"): _bi_urlquery_encode_object,
+    ("urlquery", "decode_object"): _bi_urlquery_decode_object,
+    ("json", "is_valid"): _bi_json_is_valid,
+    ("yaml", "marshal"): _bi_yaml_marshal,
+    ("yaml", "unmarshal"): _bi_yaml_unmarshal,
+    ("yaml", "is_valid"): _bi_yaml_is_valid,
+    ("base64", "is_valid"): _bi_base64_is_valid,
+    ("crypto", "md5"): _hash("md5"),
+    ("crypto", "sha1"): _hash("sha1"),
+    ("crypto", "sha256"): _hash("sha256"),
+    ("crypto", "hmac", "md5"): _hmac("md5"),
+    ("crypto", "hmac", "sha1"): _hmac("sha1"),
+    ("crypto", "hmac", "sha256"): _hmac("sha256"),
+    ("crypto", "hmac", "sha512"): _hmac("sha512"),
+    ("crypto", "hmac", "equal"): lambda a, b: _hmac_mod.compare_digest(
+        _need_str(a, "crypto.hmac.equal"), _need_str(b, "crypto.hmac.equal")),
+    ("ceil",): _bi_ceil,
+    ("floor",): _bi_floor,
+    ("numbers", "range_step"): _bi_numbers_range_step,
+    ("array", "reverse"): _bi_array_reverse,
+    ("time", "now_ns"): _bi_time_now_ns,
+    ("time", "parse_rfc3339_ns"): _bi_parse_rfc3339_ns,
+    ("time", "date"): _bi_time_date,
+    ("time", "clock"): _bi_time_clock,
+    ("time", "weekday"): _bi_time_weekday,
+    ("time", "add_date"): _bi_time_add_date,
+    ("units", "parse"): _bi_units_parse,
+    ("units", "parse_bytes"): _bi_units_parse_bytes,
+    ("net", "cidr_contains"): _bi_cidr_contains,
+    ("net", "cidr_intersects"): _bi_cidr_intersects,
+    ("net", "cidr_is_valid"): _bi_cidr_is_valid,
+    ("semver", "is_valid"): _bi_semver_is_valid,
+    ("semver", "compare"): _bi_semver_compare,
+    ("bits", "or"): _bits("bits.or", lambda a, b: a | b),
+    ("bits", "and"): _bits("bits.and", lambda a, b: a & b),
+    ("bits", "xor"): _bits("bits.xor", lambda a, b: a ^ b),
+    ("bits", "lsh"): _bits("bits.lsh", lambda a, b: a << b),
+    ("bits", "rsh"): _bits("bits.rsh", lambda a, b: a >> b),
+    ("bits", "negate"): lambda a: ~int(_need_num(a, "bits.negate")),
 })

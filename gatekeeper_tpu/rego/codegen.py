@@ -27,7 +27,7 @@ import operator as _operator
 from typing import Any, Callable, Optional
 
 from . import ast as A
-from .builtins import BUILTINS, BuiltinError
+from .builtins import BUILTINS, NONDETERMINISTIC, BuiltinError
 from .interp import UNDEF, RegoError, _binop
 from .safety import reorder_module
 from ..utils.values import FrozenDict, rego_eq, sort_key
@@ -260,6 +260,56 @@ class _Scope:
         return name in self.names
 
 
+def _calls_nondeterministic(r: A.Rule) -> bool:
+    found = False
+
+    def walk(t) -> None:
+        nonlocal found
+        if found:
+            return
+        if isinstance(t, A.Call):
+            if tuple(t.fn) in NONDETERMINISTIC:
+                found = True
+                return
+            for a in t.args:
+                walk(a)
+        elif isinstance(t, A.Ref):
+            walk(t.base)
+            for a in t.args:
+                walk(a)
+        elif isinstance(t, A.BinOp):
+            walk(t.lhs)
+            walk(t.rhs)
+        elif isinstance(t, A.UnaryMinus):
+            walk(t.term)
+        elif isinstance(t, (A.ArrayLit, A.SetLit)):
+            for x in t.items:
+                walk(x)
+        elif isinstance(t, A.ObjectLit):
+            for k, v in t.items:
+                walk(k)
+                walk(v)
+        elif isinstance(t, (A.ArrayCompr, A.SetCompr, A.ObjectCompr)):
+            for lit in t.body:
+                if not isinstance(lit.expr, A.SomeDecl):
+                    walk(lit.expr)
+            for h in (getattr(t, "head", None), getattr(t, "key", None),
+                      getattr(t, "value", None)):
+                if h is not None:
+                    walk(h)
+        elif isinstance(t, (A.Assign, A.Unify)):
+            walk(t.lhs)
+            walk(t.rhs)
+
+    for lit in r.body:
+        if not isinstance(lit.expr, A.SomeDecl):
+            walk(lit.expr)
+    for h in (r.key, r.value):
+        if h is not None:
+            walk(h)
+    return found
+
+
 def _collect_arg_vars(t, into: set) -> None:
     if isinstance(t, A.Var):
         if not t.name.startswith("$wc"):
@@ -456,6 +506,8 @@ class ModuleCompiler:
             for r in rules:
                 if any(lit.withs for lit in r.body):
                     names.add("input")  # `with`: treat as impure
+                if _calls_nondeterministic(r):
+                    names.add("input")  # time.now_ns etc: never memoize
                 for lit in r.body:
                     _term_vars(lit.expr, names)
                 if r.value is not None:
@@ -641,8 +693,8 @@ class ModuleCompiler:
                     all(ok(a, bound) for a in x.args)
             if isinstance(x, A.Call):
                 fn = tuple(x.fn)
-                if fn not in BUILTINS:
-                    return False  # user fn / data fn: may read parameters
+                if fn not in BUILTINS or fn in NONDETERMINISTIC:
+                    return False  # user/data fn or impure builtin
                 return all(ok(a, bound) for a in x.args)
             if isinstance(x, A.BinOp):
                 return ok(x.lhs, bound) and ok(x.rhs, bound)
@@ -1477,7 +1529,8 @@ class ModuleCompiler:
                     if fn[0] not in self.arg_pure:
                         s["ok"] = False
                         return
-                elif fn[0] == "data" or fn not in BUILTINS:
+                elif fn[0] == "data" or fn not in BUILTINS or \
+                        fn in NONDETERMINISTIC:
                     s["ok"] = False
                     return
                 for a in t.args:

@@ -1,0 +1,102 @@
+"""Rego formatter round-trip: format(parse(src)) must re-parse to the
+same AST (modulo source positions and wildcard numbering) for every
+reference library template and every repo policy — the `opa fmt`
+contract (vendor/.../format/format.go)."""
+
+from dataclasses import fields, is_dataclass
+from pathlib import Path
+
+import pytest
+
+from gatekeeper_tpu.rego import ast as A
+from gatekeeper_tpu.rego.format import format_module
+from gatekeeper_tpu.rego.parser import parse_module
+
+REFERENCE = Path("/root/reference/library")
+REF_SRCS = sorted(REFERENCE.glob("*/*/src.rego")) \
+    if REFERENCE.exists() else []
+
+
+def canon(node, wcmap):
+    """Structural normal form: drop line numbers, rename wildcards in
+    first-seen order (the parser numbers them globally)."""
+    if isinstance(node, A.Var) and node.name.startswith("$wc"):
+        if node.name not in wcmap:
+            wcmap[node.name] = f"$wc{len(wcmap)}"
+        return ("Var", wcmap[node.name])
+    if is_dataclass(node):
+        out = [type(node).__name__]
+        for f in fields(node):
+            if f.name in ("line", "source_name"):
+                continue
+            out.append((f.name, canon(getattr(node, f.name), wcmap)))
+        return tuple(out)
+    if isinstance(node, tuple):
+        return tuple(canon(x, wcmap) for x in node)
+    return node
+
+
+def roundtrip(src: str) -> None:
+    m1 = parse_module(src)
+    text = format_module(m1)
+    m2 = parse_module(text)
+    c1, c2 = canon(m1, {}), canon(m2, {})
+    assert c1 == c2, f"round-trip drift:\n{text}"
+    # idempotence: formatting formatted source is a fixed point
+    assert format_module(m2) == text
+
+
+@pytest.mark.parametrize(
+    "path", REF_SRCS, ids=[str(p.parent.name) for p in REF_SRCS])
+def test_roundtrip_reference_library(path):
+    roundtrip(path.read_text())
+
+
+def test_roundtrip_repo_policies():
+    from gatekeeper_tpu import policies
+    for name in policies.names():
+        t = policies.load(name)
+        for target in t["spec"]["targets"]:
+            roundtrip(target["rego"])
+            for lib in target.get("libs") or []:
+                roundtrip(lib)
+
+
+def test_format_shapes():
+    src = '''
+package demo
+
+default allow = false
+
+allow {
+  input.review.kind.kind == "Pod"
+  not denied
+}
+
+denied {
+  some ns
+  x := data.inventory.namespace[ns][_]["Pod"][name]
+  count({p | p := x.spec.containers[_].name}) > 1
+  y = [u | u := x.spec.volumes[_]; u.hostPath]
+  m := {k: v | v := x.metadata.labels[k]}
+  z := (1 + 2) * 3
+  x.spec.replicas >= -1
+  arr := []
+  s := set()
+  obj := {"a": 1}
+  f(x) with input as {"review": {}}
+}
+
+f(v) = out {
+  out := v
+}
+
+items[name] {
+  name := input.review.object.metadata.name
+}
+
+pairs[k] = v {
+  v := input.review.object.metadata.labels[k]
+}
+'''
+    roundtrip(src)

@@ -276,3 +276,77 @@ def test_breadth_builtins():
         elif isinstance(got, tuple):
             got = list(got)
         assert got == want, (name, expr, got, want)
+
+
+def test_breadth_builtins_round4():
+    """Round-4 builtin batch evaluated through actual rego (interpreter
+    AND codegen must agree; OPA semantics pinned by literal expecteds)."""
+    src = '''
+package b4
+
+out[x] {
+  x := {
+    "keys": object.keys({"a": 1, "b": 2}),
+    "removed": object.remove({"a": 1, "b": 2}, ["a"]),
+    "union": object.union({"a": {"x": 1}}, {"a": {"y": 2}}),
+    "rsplit": regex.split("-", "a-b-c"),
+    "rrepl": regex.replace("aaa", "a", "b"),
+    "rvalid": [regex.is_valid("^a+$"), regex.is_valid("(")],
+    "rev": strings.reverse("abc"),
+    "cnt": strings.count("banana", "na"),
+    "idxn": indexof_n("banana", "na"),
+    "hex": hex.decode(hex.encode("hi")),
+    "url": urlquery.decode(urlquery.encode("a b&c")),
+    "jvalid": [json.is_valid("{}"), json.is_valid("{")],
+    "yaml": yaml.unmarshal("a: 1"),
+    "sha": crypto.sha256("abc"),
+    "hmac_eq": crypto.hmac.equal(crypto.hmac.sha256("m", "k"),
+                                 crypto.hmac.sha256("m", "k")),
+    "ceilfloor": [ceil(1.2), floor(1.8)],
+    "steps": numbers.range_step(1, 7, 2),
+    "arev": array.reverse([1, 2, 3]),
+    "t": time.date(time.parse_rfc3339_ns("2020-01-01T00:00:00Z")),
+    "wd": time.weekday(time.parse_rfc3339_ns("2020-01-01T00:00:00Z")),
+    "units": [units.parse("10Ki"), units.parse_bytes("1KiB")],
+    "cidr": [net.cidr_contains("10.0.0.0/8", "10.1.2.3"),
+             net.cidr_intersects("10.0.0.0/8", "11.0.0.0/8")],
+    "semver": [semver.compare("1.2.3", "1.10.0"),
+               semver.compare("1.0.0-alpha", "1.0.0")],
+    "bits": [bits.or(5, 3), bits.lsh(1, 4), bits.negate(0)],
+  }
+}
+'''
+    module = parse_module(src)
+    interp = Interpreter({"m": module})
+    out = interp.eval_rule(("b4",), "out", {})
+    assert out is not UNDEF
+    # the codegen evaluator must agree with the interpreter exactly
+    from gatekeeper_tpu.rego.codegen import compile_module
+    from gatekeeper_tpu.utils.values import freeze
+    fn = compile_module(module, entry="out")
+    assert fn.__input_call__(freeze({}), freeze({})) == out
+    got = thaw(list(out)[0])
+    assert got["keys"] == ["a", "b"]
+    assert got["removed"] == {"b": 2}
+    assert got["union"] == {"a": {"x": 1, "y": 2}}
+    assert got["rsplit"] == ["a", "b", "c"]
+    assert got["rrepl"] == "bbb"
+    assert got["rvalid"] == [True, False]
+    assert got["rev"] == "cba"
+    assert got["cnt"] == 2
+    assert got["idxn"] == [2, 4]
+    assert got["hex"] == "hi"
+    assert got["url"] == "a b&c"
+    assert got["jvalid"] == [True, False]
+    assert got["yaml"] == {"a": 1}
+    assert got["sha"].startswith("ba7816bf")
+    assert got["hmac_eq"] is True
+    assert got["ceilfloor"] == [2, 1]
+    assert got["steps"] == [1, 3, 5, 7]
+    assert got["arev"] == [3, 2, 1]
+    assert got["t"] == [2020, 1, 1]
+    assert got["wd"] == "Wednesday"
+    assert got["units"] == [10240, 1024]
+    assert got["cidr"] == [True, False]
+    assert got["semver"] == [-1, -1]
+    assert got["bits"] == [7, 16, -1]
