@@ -142,13 +142,13 @@ def main() -> None:
     configs = {}
     try:
         env = dict(os.environ)
-        env.setdefault("BENCH_SCALE", "0.25")
+        env.setdefault("BENCH_SCALE", "0.2")
         proc = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(
                 os.path.abspath(__file__)), "bench_configs.py"),
              "1", "2", "3", "5"],
             capture_output=True, text=True, env=env,
-            timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 600)))
+            timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 300)))
         for line in proc.stdout.splitlines():
             line = line.strip()
             if line.startswith("{"):
