@@ -957,3 +957,198 @@ BUILTINS.update({
     ("bits", "rsh"): _bits("bits.rsh", lambda a, b: a >> b),
     ("bits", "negate"): lambda a: ~int(_need_num(a, "bits.negate")),
 })
+
+
+# ---- breadth batch 3: json document surgery, graph traversal, jwt ----
+
+
+def _split_json_path(p, fn: str):
+    if isinstance(p, str):
+        return tuple(seg for seg in p.split("/") if seg != "")
+    if isinstance(p, tuple):
+        return tuple(str(x) if not isinstance(x, str) else x for x in p)
+    raise BuiltinError(f"{fn}: path must be a string or array")
+
+
+def _paths_trie(paths, fn: str):
+    trie: dict = {}
+    for p in _iterable(paths, fn):
+        node = trie
+        for seg in _split_json_path(p, fn):
+            node = node.setdefault(seg, {})
+        node["\x00end"] = True
+    return trie
+
+
+def _step_into(v, seg: str):
+    if isinstance(v, FrozenDict):
+        if seg in v:
+            return True, v[seg]
+        return False, None
+    if isinstance(v, tuple):
+        try:
+            i = int(seg)
+        except ValueError:
+            return False, None
+        if 0 <= i < len(v):
+            return True, v[i]
+    return False, None
+
+
+def _bi_json_filter(obj, paths):
+    """Keep only the listed paths (OPA topdown/json.go Filter)."""
+    _need(obj, "object", "json.filter")
+    trie = _paths_trie(paths, "json.filter")
+
+    def keep(v, node):
+        if "\x00end" in node:
+            return v
+        if isinstance(v, FrozenDict):
+            out = {}
+            for k, child in node.items():
+                if k == "\x00end":
+                    continue
+                present, sub = _step_into(v, k)
+                if present:
+                    kept = keep(sub, child)
+                    if kept is not _MISSING_JSON:
+                        out[k] = kept
+            return FrozenDict(out)
+        if isinstance(v, tuple):
+            out = []
+            # original index order, not trie insertion order
+            for k, child in sorted(
+                    ((k, c) for k, c in node.items() if k != "\x00end"),
+                    key=lambda kv: int(kv[0]) if kv[0].isdigit() else 0):
+                present, sub = _step_into(v, k)
+                if present:
+                    kept = keep(sub, child)
+                    if kept is not _MISSING_JSON:
+                        out.append(kept)
+            return tuple(out)
+        return _MISSING_JSON
+
+    got = keep(obj, trie)
+    return got if got is not _MISSING_JSON else FrozenDict()
+
+
+_MISSING_JSON = object()
+
+
+def _bi_json_remove(obj, paths):
+    """Remove the listed paths (OPA topdown/json.go Remove)."""
+    _need(obj, "object", "json.remove")
+    trie = _paths_trie(paths, "json.remove")
+
+    def strip(v, node):
+        if "\x00end" in node:
+            return _MISSING_JSON
+        if isinstance(v, FrozenDict):
+            out = {}
+            for k, sub in v.items():
+                child = node.get(k if isinstance(k, str) else str(k))
+                if child is None:
+                    out[k] = sub
+                else:
+                    kept = strip(sub, child)
+                    if kept is not _MISSING_JSON:
+                        out[k] = kept
+            return FrozenDict(out)
+        if isinstance(v, tuple):
+            out = []
+            for i, sub in enumerate(v):
+                child = node.get(str(i))
+                if child is None:
+                    out.append(sub)
+                else:
+                    kept = strip(sub, child)
+                    if kept is not _MISSING_JSON:
+                        out.append(kept)
+            return tuple(out)
+        return v
+
+    got = strip(obj, trie)
+    return got if got is not _MISSING_JSON else FrozenDict()
+
+
+def _bi_object_subset(sup, sub):
+    """True when sub is a (recursive) subset of sup: objects by keys,
+    sets by membership, arrays by subsequence (OPA object.subset)."""
+    def check(a, b):
+        if isinstance(b, FrozenDict) and isinstance(a, FrozenDict):
+            return all(k in a and check(a[k], v) for k, v in b.items())
+        if isinstance(b, frozenset) and isinstance(a, frozenset):
+            return b <= a
+        if isinstance(b, tuple) and isinstance(a, tuple):
+            i = 0
+            for x in a:
+                if i < len(b) and rego_eq(x, b[i]):
+                    i += 1
+            return i == len(b)
+        return rego_eq(a, b)
+
+    return check(sup, sub)
+
+
+def _bi_graph_reachable(graph, initial):
+    """Node set reachable from `initial` over an adjacency object whose
+    values are arrays/sets of neighbor keys (OPA graph.reachable)."""
+    _need(graph, "object", "graph.reachable")
+    frontier = list(_iterable(initial, "graph.reachable"))
+    seen = set()
+    while frontier:
+        n = frontier.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        nbrs = graph.get(n)
+        if isinstance(nbrs, (tuple, frozenset)):
+            frontier.extend(nbrs)
+    return frozenset(seen)
+
+
+def _b64url_decode_pad(s: str, fn: str) -> bytes:
+    pad = "=" * (-len(s) % 4)
+    try:
+        return _base64.urlsafe_b64decode(s + pad)
+    except (_binascii.Error, ValueError) as e:
+        raise BuiltinError(f"{fn}: {e}") from None
+
+
+def _bi_jwt_decode(token):
+    """[header, payload, signature-hex] without verification (OPA
+    io.jwt.decode, topdown/tokens.go)."""
+    parts = _need_str(token, "io.jwt.decode").split(".")
+    if len(parts) != 3:
+        raise BuiltinError("io.jwt.decode: expected 3 '.'-separated parts")
+    try:
+        header = json.loads(_b64url_decode_pad(parts[0], "io.jwt.decode"))
+        payload = json.loads(_b64url_decode_pad(parts[1], "io.jwt.decode"))
+    except ValueError as e:
+        raise BuiltinError(f"io.jwt.decode: {e}") from None
+    sig = _b64url_decode_pad(parts[2], "io.jwt.decode").hex()
+    return (freeze(header), freeze(payload), sig)
+
+
+def _bi_jwt_verify_hs256(token, secret):
+    parts = _need_str(token, "io.jwt.verify_hs256").split(".")
+    if len(parts) != 3:
+        return False
+    mac = _hmac_mod.new(_need_str(secret, "io.jwt.verify_hs256").encode(),
+                        f"{parts[0]}.{parts[1]}".encode(),
+                        _hashlib.sha256).digest()
+    return _hmac_mod.compare_digest(
+        mac, _b64url_decode_pad(parts[2], "io.jwt.verify_hs256"))
+
+
+BUILTINS.update({
+    ("json", "filter"): _bi_json_filter,
+    ("json", "remove"): _bi_json_remove,
+    ("object", "subset"): _bi_object_subset,
+    ("graph", "reachable"): _bi_graph_reachable,
+    ("io", "jwt", "decode"): _bi_jwt_decode,
+    ("io", "jwt", "verify_hs256"): _bi_jwt_verify_hs256,
+    ("base64url", "encode_no_pad"): lambda s: _base64.urlsafe_b64encode(
+        _need_str(s, "base64url.encode_no_pad").encode()
+    ).decode().rstrip("="),
+})
