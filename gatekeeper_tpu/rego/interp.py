@@ -812,6 +812,16 @@ class Interpreter:
                     yield v
             return
 
+        if tuple(t.fn) == ("walk",):
+            # multi-valued builtin: walk(x) enumerates every [path, value]
+            # pair of the document, root first (OPA topdown/walk.go); the
+            # common `[p, v] := walk(x)` form destructures the pairs.
+            # Deliberately NOT in BUILTINS: codegen/device treat unknown
+            # fns as Unsupported, falling back to this interpreter.
+            for argvals in self._iter_product(t.args, env, ctx, tuple):
+                yield from _walk_pairs(argvals[0])
+            return
+
         fn = BUILTINS.get(t.fn)
         if fn is None:
             raise RegoError(f"unknown function {'.'.join(t.fn)}")
@@ -827,6 +837,22 @@ class Interpreter:
 
 
 # ---------------------------------------------------------------- helpers
+
+
+def _walk_pairs(v):
+    stack = [((), v)]
+    while stack:
+        path, node = stack.pop()
+        yield (path, node)
+        if isinstance(node, FrozenDict):
+            for k, x in node.items():
+                stack.append((path + (k,), x))
+        elif isinstance(node, tuple):
+            for i, x in enumerate(node):
+                stack.append((path + (i,), x))
+        elif isinstance(node, frozenset):
+            for x in node:
+                stack.append((path + (x,), x))
 
 
 def _binop(op: str, a, b):

@@ -350,3 +350,68 @@ out[x] {
     assert got["cidr"] == [True, False]
     assert got["semver"] == [-1, -1]
     assert got["bits"] == [7, 16, -1]
+
+
+def test_walk_builtin():
+    """walk(x) enumerates all [path, value] pairs (OPA topdown/walk.go);
+    templates using it stay on the interpreter path (codegen/device
+    treat it as unsupported) but must evaluate correctly end-to-end."""
+    src = '''
+package w
+
+secrets[p] {
+  [p, v] := walk(input.review.object)
+  is_string(v)
+  contains(v, "SECRET")
+}
+
+depth2[v] {
+  [path, v] := walk(input.review.object)
+  count(path) == 2
+}
+'''
+    module = parse_module(src)
+    interp = Interpreter({"m": module})
+    inp = {"review": {"object": {
+        "a": {"b": "SECRET1", "c": "ok"},
+        "d": ["x", {"e": "SECRET2"}],
+    }}}
+    out = thaw(interp.eval_rule(("w",), "secrets", inp))
+    assert sorted(out) == [["a", "b"], ["d", 1, "e"]]
+    d2 = thaw(interp.eval_rule(("w",), "depth2", inp))
+    assert sorted(d2, key=str) == sorted(["SECRET1", "ok", "x",
+                                          {"e": "SECRET2"}], key=str)
+    # end-to-end through both drivers (TpuDriver must fall back loudly
+    # but correctly)
+    from gatekeeper_tpu.client import Backend, RegoDriver
+    from gatekeeper_tpu.ir import TpuDriver
+    from gatekeeper_tpu.target import AugmentedUnstructured, \
+        K8sValidationTarget
+    tpl = {"apiVersion": "templates.gatekeeper.sh/v1beta1",
+           "kind": "ConstraintTemplate", "metadata": {"name": "tnosecret"},
+           "spec": {"crd": {"spec": {"names": {"kind": "TNoSecret"}}},
+                    "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                                 "rego": '''
+package tnosecret
+violation[{"msg": msg}] {
+  [path, v] := walk(input.review.object)
+  is_string(v)
+  contains(v, "hunter2")
+  msg := sprintf("secret-looking value at %v", [path])
+}
+'''}]}}
+    outs = []
+    for drv in (RegoDriver(), TpuDriver()):
+        c = Backend(drv).new_client([K8sValidationTarget()])
+        c.add_template(tpl)
+        c.add_constraint({"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                          "kind": "TNoSecret", "metadata": {"name": "t"},
+                          "spec": {}})
+        bad = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p", "namespace": "d"},
+               "spec": {"containers": [{"name": "m", "env": [
+                   {"name": "PW", "value": "hunter2"}]}]}}
+        outs.append(sorted(
+            r.msg for r in c.review(AugmentedUnstructured(bad)).results()))
+    assert outs[0] == outs[1]
+    assert outs[0] and "spec" in outs[0][0]
