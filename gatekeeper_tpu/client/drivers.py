@@ -648,17 +648,20 @@ class RegoDriver:
         frz_review = None
         review = None
         rmemo: dict = {}
+        # plain-int lists: iterating numpy scalars costs ~100ns per
+        # element extraction and they are slow dict keys
+        rows = rows.tolist() if hasattr(rows, "tolist") else rows
+        cols = cols.tolist() if hasattr(cols, "tolist") else cols
         for ri, ci in zip(rows, cols):
             if ri != cur_ri:
                 cur_ri = ri
-                review = pair_reviews[int(ri)]
+                review = pair_reviews[ri]
                 frz_review = self._freeze_review(review)
                 ent = self._rmemo.get(kind)
                 if ent is None or ent[0] is not review:
                     ent = (review, {})
                     self._rmemo[kind] = ent
                 rmemo = ent[1]
-            ci = int(ci)
             if fn is None:  # demoted mid-batch: stay on the fallback
                 out.extend(self._eval_template_violations(
                     target, cons[ci], review, enforce[ci], inventory,

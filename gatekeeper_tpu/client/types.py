@@ -13,7 +13,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 
-@dataclass
+@dataclass(slots=True)
 class Result:
     msg: str = ""
     metadata: dict = field(default_factory=dict)
