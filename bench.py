@@ -36,7 +36,25 @@ SAMPLE_CONSTRAINTS = int(os.environ.get("BENCH_BASELINE_CONSTRAINTS", 40))
 TARGET = "admission.k8s.gatekeeper.sh"
 
 
+def _device_sanity() -> None:
+    """A broken accelerator runtime (e.g. a libtpu client/terminal
+    mismatch) must degrade this benchmark to CPU, not lose it: probe a
+    trivial jit and re-exec under JAX_PLATFORMS=cpu on failure."""
+    try:
+        import jax
+        import numpy as _np
+        jax.jit(lambda x: x + 1)(_np.ones(8, _np.float32))
+    except Exception as e:
+        if os.environ.get("JAX_PLATFORMS") != "cpu":
+            print(f"# device probe failed ({type(e).__name__}); "
+                  f"falling back to CPU", file=sys.stderr)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        raise
+
+
 def main() -> None:
+    _device_sanity()
     t_setup = time.time()
     from gatekeeper_tpu.client import Backend
     from gatekeeper_tpu.ir import TpuDriver
