@@ -7,8 +7,9 @@ One JSON line per config:
   #2 full shipped general library x 10k mixed objects — full audit
   #3 full shipped pod-security-policy library x 50k Pods (regex-heavy)
      — full audit
-  #5 streaming admission through the MicroBatcher — sustained
-     requests/s and p50/p99 latency under an open-loop arrival process
+  #5 streaming admission through the MicroBatcher vs the FULL general
+     library — sustained requests/s and p50/p99 latency under 64
+     closed-loop concurrent clients
 
 All audits run steady-state through client.audit() (warm caches), same
 contract as bench.py. Run: python bench_configs.py [1 2 3 5]
