@@ -415,3 +415,72 @@ violation[{"msg": msg}] {
             r.msg for r in c.review(AugmentedUnstructured(bad)).results()))
     assert outs[0] == outs[1]
     assert outs[0] and "spec" in outs[0][0]
+
+
+def test_breadth_builtins_batch3():
+    src = '''
+package b5
+
+out[x] {
+  x := {
+    "filter": json.filter({"a": {"b": 1, "c": 2}}, ["a/b"]),
+    "remove": json.remove({"a": {"b": 1, "c": 2}}, ["a/b"]),
+    "subset": [object.subset({"a": {"b": 1}, "x": 2}, {"a": {"b": 1}}),
+               object.subset({"a": 1}, {"a": 2})],
+    "reach": graph.reachable({"a": ["b"], "b": ["c"], "c": [],
+                              "z": ["a"]}, ["a"]),
+    "nopad": base64url.encode_no_pad("hi?"),
+  }
+}
+'''
+    module = parse_module(src)
+    interp = Interpreter({"m": module})
+    out = interp.eval_rule(("b5",), "out", {})
+    assert out is not UNDEF
+    from gatekeeper_tpu.rego.codegen import compile_module
+    from gatekeeper_tpu.utils.values import freeze
+    fn = compile_module(module, entry="out")
+    assert fn.__input_call__(freeze({}), freeze({})) == out
+    got = thaw(list(out)[0])
+    assert got["filter"] == {"a": {"b": 1}}
+    assert got["remove"] == {"a": {"c": 2}}
+    assert got["subset"] == [True, False]
+    assert sorted(got["reach"]) == ["a", "b", "c"]
+    assert got["nopad"] == "aGk_"
+
+
+def test_jwt_decode_verify():
+    import base64 as b64
+    import hashlib
+    import hmac as hmac_mod
+    import json as pyjson
+
+    def seg(d):
+        return b64.urlsafe_b64encode(
+            pyjson.dumps(d).encode()).decode().rstrip("=")
+
+    hdr, pl = seg({"alg": "HS256"}), seg({"sub": "me", "admin": True})
+    sig = b64.urlsafe_b64encode(hmac_mod.new(
+        b"topsecret", f"{hdr}.{pl}".encode(),
+        hashlib.sha256).digest()).decode().rstrip("=")
+    token = f"{hdr}.{pl}.{sig}"
+    src = '''
+package jwt
+
+claims[p] {
+  [_, p, _] := io.jwt.decode(input.review.token)
+  io.jwt.verify_hs256(input.review.token, "topsecret")
+}
+
+forged[p] {
+  [_, p, _] := io.jwt.decode(input.review.token)
+  io.jwt.verify_hs256(input.review.token, "wrong")
+}
+'''
+    module = parse_module(src)
+    interp = Interpreter({"m": module})
+    out = thaw(interp.eval_rule(("jwt",), "claims",
+                                {"review": {"token": token}}))
+    assert out == [{"sub": "me", "admin": True}]
+    out2 = interp.eval_rule(("jwt",), "forged", {"review": {"token": token}})
+    assert out2 is UNDEF or thaw(out2) == []
