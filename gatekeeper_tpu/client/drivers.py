@@ -112,6 +112,12 @@ class RegoDriver:
         self._inv_key_cache: dict[str, tuple] = {}  # target -> (rev, keys)
         self._sig_cache: dict[str, tuple] = {}  # target -> (rev, dict)
         self._inv_tree_cache: dict[str, tuple] = {}  # target -> (rev, tree)
+        # audit-scoped freeze cache: id(review) -> (review, frozen),
+        # valid for one data generation (inventory reviews are stable
+        # then), journal-patched on single-object replacements. Sized by
+        # the inventory, unlike the small capped _frz_review the
+        # webhook's transient reviews go through.
+        self._audit_frz: tuple = (None, {})
         # incremental-mutation journal: ("patch", rev, target, index,
         # old_review, new_review) for single-object in-place replacements
         # that PATCHED the warm caches, ("break", rev) for anything else.
@@ -433,6 +439,26 @@ class RegoDriver:
         self._codegen[key] = fn
         return fn
 
+    def _freeze_review_audit(self, review: dict):
+        ent = self._audit_frz
+        if ent[0] != self._data_rev:
+            notes = self._notes_between(ent[0], self._data_rev) \
+                if ent[0] is not None else None
+            if notes is None:
+                ent = (self._data_rev, {})
+            else:
+                for n in notes:
+                    ent[1].pop(id(n[4]), None)  # replaced review object
+                ent = (self._data_rev, ent[1])
+            self._audit_frz = ent
+        m = ent[1]
+        c = m.get(id(review))
+        if c is not None and c[0] is review:
+            return c[1]
+        f = freeze(review)
+        m[id(review)] = (review, f)
+        return f
+
     def _freeze_review(self, review: dict):
         # id-keyed with identity check: a micro-batch sweeps the same
         # reviews once per KIND, and a single-entry cache would re-freeze
@@ -656,7 +682,7 @@ class RegoDriver:
             if ri != cur_ri:
                 cur_ri = ri
                 review = pair_reviews[ri]
-                frz_review = self._freeze_review(review)
+                frz_review = self._freeze_review_audit(review)
                 ent = self._rmemo.get(kind)
                 if ent is None or ent[0] is not review:
                     ent = (review, {})
