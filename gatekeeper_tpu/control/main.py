@@ -150,6 +150,13 @@ class Runtime:
             self.cert_rotator.start()
         if self.webhook:
             self.webhook.start()
+        # long-lived-server GC tuning: everything built so far (engine,
+        # policy caches, codegen closures) is effectively permanent;
+        # freezing it out of the collector's scan set keeps multi-ms
+        # gen-2 pauses out of the admission tail
+        import gc
+        gc.collect()
+        gc.freeze()
         log.info("gatekeeper-tpu started",
                  details={"operations": sorted(self.operations)})
 
