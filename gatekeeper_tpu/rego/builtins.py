@@ -1152,3 +1152,133 @@ BUILTINS.update({
         _need_str(s, "base64url.encode_no_pad").encode()
     ).decode().rstrip("="),
 })
+
+
+def _json_ptr(path: str, fn: str) -> list:
+    if path == "":
+        return []
+    if not path.startswith("/"):
+        raise BuiltinError(f"{fn}: path must start with '/'")
+    return [seg.replace("~1", "/").replace("~0", "~")
+            for seg in path.split("/")[1:]]
+
+
+def _patch_apply(doc, segs: list, op: str, value, fn: str):
+    """Immutable RFC 6902 add/remove/replace on frozen values."""
+    if not segs:
+        if op == "remove":
+            raise BuiltinError(f"{fn}: cannot remove the root")
+        return value
+    seg = segs[0]
+    if isinstance(doc, FrozenDict):
+        if len(segs) == 1:
+            d = dict(doc)
+            if op == "remove":
+                if seg not in d:
+                    raise BuiltinError(f"{fn}: path not found: {seg}")
+                d.pop(seg)
+            elif op == "replace":
+                if seg not in d:
+                    raise BuiltinError(f"{fn}: path not found: {seg}")
+                d[seg] = value
+            else:  # add
+                d[seg] = value
+            return FrozenDict(d)
+        if seg not in doc:
+            raise BuiltinError(f"{fn}: path not found: {seg}")
+        d = dict(doc)
+        d[seg] = _patch_apply(doc[seg], segs[1:], op, value, fn)
+        return FrozenDict(d)
+    if isinstance(doc, tuple):
+        if seg == "-" and op == "add" and len(segs) == 1:
+            return doc + (value,)
+        try:
+            i = int(seg)
+        except ValueError:
+            raise BuiltinError(f"{fn}: bad array index {seg!r}") from None
+        if not (0 <= i <= len(doc) - (0 if op == "add" else 1)):
+            raise BuiltinError(f"{fn}: index {i} out of range")
+        if len(segs) == 1:
+            if op == "add":
+                return doc[:i] + (value,) + doc[i:]
+            if op == "remove":
+                return doc[:i] + doc[i + 1:]
+            return doc[:i] + (value,) + doc[i + 1:]
+        return doc[:i] + (_patch_apply(doc[i], segs[1:], op, value, fn),) \
+            + doc[i + 1:]
+    raise BuiltinError(f"{fn}: cannot descend into {type_name(doc)}")
+
+
+def _bi_json_patch(doc, patches):
+    """RFC 6902 add/remove/replace/copy/move/test (OPA json.patch)."""
+    fn = "json.patch"
+    out = doc
+    for p in _iterable(patches, fn):
+        _need(p, "object", fn)
+        op = p.get("op")
+        path = _json_ptr(_need_str(p.get("path", ""), fn), fn)
+        if op in ("add", "replace"):
+            out = _patch_apply(out, path, op, p.get("value"), fn)
+        elif op == "remove":
+            out = _patch_apply(out, path, "remove", None, fn)
+        elif op in ("copy", "move"):
+            src = _json_ptr(_need_str(p.get("from", ""), fn), fn)
+            node = out
+            for seg in src:
+                present, node = _step_into(node, seg)
+                if not present:
+                    raise BuiltinError(f"{fn}: from path not found")
+            if op == "move":
+                out = _patch_apply(out, src, "remove", None, fn)
+            out = _patch_apply(out, path, "add", node, fn)
+        elif op == "test":
+            node = out
+            for seg in path:
+                present, node = _step_into(node, seg)
+                if not present:
+                    raise BuiltinError(f"{fn}: test path not found")
+            if not rego_eq(node, p.get("value")):
+                raise BuiltinError(f"{fn}: test failed")
+        else:
+            raise BuiltinError(f"{fn}: unsupported op {op!r}")
+    return out
+
+
+def _bi_time_diff(a, b):
+    """[years, months, days, hours, minutes, seconds] between two ns
+    timestamps (OPA time.diff, Go-style civil difference)."""
+    d1 = _ns_to_dt(a)
+    d2 = _ns_to_dt(b)
+    if d1 < d2:
+        d1, d2 = d2, d1
+    y = d1.year - d2.year
+    mo = d1.month - d2.month
+    dd = d1.day - d2.day
+    hh = d1.hour - d2.hour
+    mi = d1.minute - d2.minute
+    ss = d1.second - d2.second
+    if ss < 0:
+        ss += 60
+        mi -= 1
+    if mi < 0:
+        mi += 60
+        hh -= 1
+    if hh < 0:
+        hh += 24
+        dd -= 1
+    if dd < 0:
+        prev_month_year = d1.year if d1.month > 1 else d1.year - 1
+        prev_month = d1.month - 1 if d1.month > 1 else 12
+        import calendar as _cal
+        dd += _cal.monthrange(prev_month_year, prev_month)[1]
+        mo -= 1
+    if mo < 0:
+        mo += 12
+        y -= 1
+    return (y, mo, dd, hh, mi, ss)
+
+
+BUILTINS.update({
+    ("json", "patch"): _bi_json_patch,
+    ("time", "diff"): _bi_time_diff,
+})
