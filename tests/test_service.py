@@ -169,3 +169,46 @@ def test_bind_failure_raises():
             make_server(driver="rego", address=f"127.0.0.1:{port}")
     finally:
         server.stop(grace=None)
+
+
+def test_join_templates_over_the_wire(remote):
+    """Round-4 feature through the gRPC seam: the inventory-join
+    templates (device-compiled in the TpuDriver-backed server) must
+    produce the same audit/review answers as a local interpreter
+    client."""
+    from gatekeeper_tpu import policies
+    from gatekeeper_tpu.client import Backend, RegoDriver
+    from gatekeeper_tpu.target import AugmentedUnstructured, \
+        K8sValidationTarget
+
+    rc = remote
+
+    def ingress(name, ns, hosts):
+        return {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+                "metadata": {"name": name, "namespace": ns},
+                "spec": {"rules": [{"host": h} for h in hosts]}}
+
+    local = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    outs = []
+    for client in (rc, local):
+        client.add_template(policies.load("general/uniqueingresshost"))
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K8sUniqueIngressHost",
+            "metadata": {"name": "uniq"}, "spec": {}})
+        client.add_data(ingress("a", "ns1", ["x.com"]))
+        client.add_data(ingress("b", "ns2", ["x.com", "y.com"]))
+        client.add_data(ingress("c", "ns3", ["z.com"]))
+        aud = sorted((r.msg,
+                      (r.resource or {}).get("metadata", {}).get("name"))
+                     for r in client.audit().results())
+        rev = sorted(r.msg for r in client.review(
+            AugmentedUnstructured(ingress("new", "ns9",
+                                          ["y.com"]))).results())
+        own = sorted(r.msg for r in client.review(
+            AugmentedUnstructured(ingress("c", "ns3",
+                                          ["z.com"]))).results())
+        outs.append((aud, rev, own))
+    assert outs[0] == outs[1]
+    aud, rev, own = outs[0]
+    assert len(aud) == 2 and rev and own == []
