@@ -492,3 +492,47 @@ violation[{"msg": "traced deny"}] { input.review.object.metadata.name }
     assert "request trace" not in "\n".join(
         r.message for r in caplog.records)
     handler.batcher.stop()
+
+
+def test_webhook_survives_adversarial_payloads(runtime):
+    """Malformed admission bodies must never crash the server: garbage
+    bytes/non-JSON get 400; structurally-broken reviews fail OPEN with
+    an error log (the validating webhook's Ignore failure policy — the
+    reference's posture for handler errors)."""
+    import http.client
+    import json as pyjson
+
+    payloads = [
+        b"not json at all",
+        b"\xff\xfe garbage bytes",
+        b"{}",
+        pyjson.dumps({"request": None}).encode(),
+        pyjson.dumps({"request": {"uid": "u"}}).encode(),
+        pyjson.dumps({"request": {"uid": "u", "kind": "notadict",
+                                  "object": []}}).encode(),
+        pyjson.dumps({"request": {"uid": "u",
+                                  "kind": {"group": 1, "version": [],
+                                           "kind": {}},
+                                  "object": {"metadata": None}}}).encode(),
+    ]
+    for body in payloads:
+        for path in ("/v1/admit", "/v1/admitlabel"):
+            conn = http.client.HTTPConnection("127.0.0.1",
+                                              runtime.webhook.port,
+                                              timeout=10)
+            conn.request("POST", path, body,
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            data = r.read()
+            if r.status == 400:
+                continue  # unparseable body rejected at the transport
+            assert r.status == 200
+            out = pyjson.loads(data)
+            assert out["response"]["allowed"] is True  # fail open
+    # and the server still serves real traffic afterwards
+    conn = http.client.HTTPConnection("127.0.0.1", runtime.webhook.port,
+                                      timeout=10)
+    conn.request("POST", "/v1/admit",
+                 pyjson.dumps(admission_review(ns("post-fuzz"))),
+                 {"Content-Type": "application/json"})
+    assert pyjson.loads(conn.getresponse().read())["response"] is not None
