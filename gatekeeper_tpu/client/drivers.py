@@ -667,8 +667,6 @@ class RegoDriver:
         elif len(hm) > 500_000:
             hm.clear()
         sections = fn.__sections__
-        import time as _time
-        _t0 = _time.time()
         vcache: dict[int, tuple] = {}  # id(violation) -> (msg, details)
         out = []
         append = out.append
@@ -741,12 +739,6 @@ class RegoDriver:
                     review=review,
                     enforcement_action=enforce[ci],
                 ))
-        # feed the cost model (device-vs-host dispatch) with the
-        # measured materialization rate when the sample is meaningful
-        el = _time.time() - _t0
-        if el > 0.005 and len(rows) >= 256 and \
-                hasattr(self, "_observe"):
-            self._observe("_host_pair_rate", len(rows) / el)
         return out
 
     # ---------------------------------------------------------- store views

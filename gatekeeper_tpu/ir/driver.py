@@ -490,9 +490,19 @@ class TpuDriver(RegoDriver):
         if st[0] == "empty":
             return []
         _tag, mask, cand, cand_reviews, handle, c_dev = st
+        import time as _time
+
         out: list[Result] = []
+        first_sync = _time.time()
         try:
             for rows, cols in handle.pairs():
+                if first_sync is not None:
+                    # dispatch->first-result latency: the audit-side
+                    # sample of the device cost EMA (review_batch
+                    # supplies the webhook-side samples)
+                    self._observe("_dev_batch_lat_s",
+                                  _time.time() - first_sync)
+                    first_sync = None
                 rows, cols = _expand_parameterless(rows, cols, c_dev,
                                                    len(cons))
                 keep = mask[cand[rows], cols]
@@ -587,18 +597,27 @@ class TpuDriver(RegoDriver):
 
     def _audit_interp(self, target, kind, cons, reviews, lookup_ns,
                       inventory, trace, sig_cache=None) -> list[Result]:
+        import time as _time
+
         out: list[Result] = []
         mask = self._match_mask(target, kind, cons, reviews, lookup_ns,
                                 sig_cache)
+        n_masked = 0
+        t0 = _time.time()
         for r, review in enumerate(reviews):
             for c, constraint in enumerate(cons):
                 if not mask[r, c]:
                     continue
+                n_masked += 1
                 spec = constraint.get("spec")
                 spec = spec if isinstance(spec, dict) else {}
                 enforcement = spec.get("enforcementAction") or "deny"
                 out.extend(self._eval_template_violations(
                     target, constraint, review, enforcement, inventory, trace))
+        # feed the cost model in its own units (masked pairs per second)
+        el = _time.time() - t0
+        if trace is None and el > 0.005 and n_masked >= 256:
+            self._observe("_host_pair_rate", n_masked / el)
         return out
 
     def _audit_compiled(self, target, kind, ct: CompiledTemplate, cons,
