@@ -615,8 +615,16 @@ class JoinCompiled:
             fi = (compile_module(prog.module, entry=c.rev_ident)
                   if c.rev_ident else None)
             self._rev_fns.append((fk, fi))
-        self._inv_cache: tuple = (None, None)
-        self._dev_in_cache: dict = {}  # clause -> (key, device args)
+        # (data_gen, id(inventory_tree)) -> tabs; the tree identity keeps
+        # two targets at the same data generation from sharing tables
+        self._inv_cache: dict = {}
+        # clause -> (inv_key, kb, device (u_p, cnt_p, sik_p))
+        self._dev_inv_cache: dict = {}
+        # clause -> (karr bytes, iks bytes, device (karr, iks)) — review
+        # tensors are only reused when their CONTENT matches; keying by
+        # shape alone returned stale fires when the candidate set changed
+        # membership at equal size
+        self._dev_rev_cache: dict = {}
         self._jit = None
 
     # ------------------------------------------------ inventory tables
@@ -624,8 +632,13 @@ class JoinCompiled:
     def inv_tables(self, inventory_tree, data_gen) -> list:
         """Per clause: (U sorted unique key sids, CNT objects per key,
         SIK identity sid when CNT==1 else IK_MULTI, host dict)."""
-        if self._inv_cache[0] == data_gen:
-            return self._inv_cache[1]
+        cache_key = (data_gen, id(inventory_tree))
+        hit = self._inv_cache.get(cache_key)
+        # the entry pins the tree, so an id() hit can only be the same
+        # object — the identity check guards against a tree freed and
+        # re-allocated at the same address before this entry existed
+        if hit is not None and hit[0] is inventory_tree:
+            return hit[1]
         from ..rego.interp import UNDEF
 
         tabs = []
@@ -659,7 +672,13 @@ class JoinCompiled:
             host = {int(k): (int(c_), int(s_))
                     for k, c_, s_ in zip(u, cnt, sik)}
             tabs.append((u, cnt, sik, host))
-        self._inv_cache = (data_gen, tabs)
+        # stale generations (and their device tensors) can't be reused;
+        # drop them so long-running audits don't accumulate tables
+        if any(k[0] != data_gen for k in self._inv_cache):
+            self._inv_cache = {k: v for k, v in self._inv_cache.items()
+                               if k[0] == data_gen}
+            self._dev_inv_cache.clear()
+        self._inv_cache[cache_key] = (inventory_tree, tabs)
         return tabs
 
     # ------------------------------------------------------ review keys
@@ -726,8 +745,9 @@ class JoinCompiled:
             if hmax == 0:
                 continue
             if n >= self.MIN_DEVICE_REVIEWS:
-                out |= self._fires_device(ci, u, cnt, sik, keys, iks,
-                                          hmax, data_gen)
+                out |= self._fires_device(
+                    ci, u, cnt, sik, keys, iks, hmax,
+                    (data_gen, id(inventory_tree)))
             else:
                 for r in range(n):
                     if out[r]:
@@ -741,16 +761,16 @@ class JoinCompiled:
         return out
 
     def _fires_device(self, ci, u, cnt, sik, keys, iks, hmax,
-                      data_gen) -> np.ndarray:
+                      inv_key) -> np.ndarray:
         """Device membership: pad keys to [N, H], searchsorted into the
         padded unique-key table, apply count/identity rules. One jit per
-        (H bucket, K bucket) shape. All inputs are made device-resident
-        and cached per (clause, data generation): steady-state audits
-        re-dispatch one kernel over resident buffers instead of
-        re-uploading the key tensors every sweep (H2D rides a slow
-        tunnel)."""
+        (H bucket, K bucket) shape. Inventory tensors are cached per
+        (clause, data generation, tree identity); review tensors are
+        rebuilt on host every call and their device copies reused only
+        when the BYTES match — steady-state audits (same candidate list)
+        skip the H2D upload, while a changed candidate set of equal size
+        never sees stale keys."""
         import jax
-        import jax.numpy as jnp
 
         # int32 throughout: jax runs with x64 disabled, which would
         # silently truncate int64 inputs (interned sids always fit)
@@ -761,14 +781,10 @@ class JoinCompiled:
         kb = 1
         while kb < len(u):
             kb *= 2
-        cache_key = (data_gen, n, h, kb)
-        ent = self._dev_in_cache.get(ci)
-        if ent is not None and ent[0] == cache_key:
-            args = ent[1]
+        ent = self._dev_inv_cache.get(ci)
+        if ent is not None and ent[0] == inv_key and ent[1] == kb:
+            inv_args = ent[2]
         else:
-            karr = np.full((n, h), KEY_PAD, dtype=np.int32)
-            for r, ks in enumerate(keys):
-                karr[r, :len(ks)] = ks
             big = np.iinfo(np.int32).max
             u_p = np.full(kb, big, dtype=np.int32)
             u_p[:len(u)] = u
@@ -776,12 +792,25 @@ class JoinCompiled:
             cnt_p[:len(u)] = cnt
             sik_p = np.full(kb, IK_MULTI, dtype=np.int32)
             sik_p[:len(u)] = sik
-            args = tuple(jax.device_put(a)
-                         for a in (u_p, cnt_p, sik_p, karr,
-                                   iks.astype(np.int32)))
-            self._dev_in_cache[ci] = (cache_key, args)
+            inv_args = tuple(jax.device_put(a) for a in (u_p, cnt_p, sik_p))
+            self._dev_inv_cache[ci] = (inv_key, kb, inv_args)
+
+        karr = np.full((n, h), KEY_PAD, dtype=np.int32)
+        for r, ks in enumerate(keys):
+            karr[r, :len(ks)] = ks
+        iks32 = iks.astype(np.int32)
+        kb_bytes, ik_bytes = karr.tobytes(), iks32.tobytes()
+        rev = self._dev_rev_cache.get(ci)
+        if rev is not None and rev[0] == kb_bytes and rev[1] == ik_bytes:
+            rev_args = rev[2]
+        else:
+            rev_args = (jax.device_put(karr), jax.device_put(iks32))
+            self._dev_rev_cache[ci] = (kb_bytes, ik_bytes, rev_args)
+        args = inv_args + rev_args
 
         if self._jit is None:
+            import jax.numpy as jnp
+
             def run(u_p, cnt_p, sik_p, karr, iks):
                 pos = jnp.searchsorted(u_p, karr)
                 pos = jnp.clip(pos, 0, u_p.shape[0] - 1)

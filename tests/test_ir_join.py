@@ -131,3 +131,67 @@ def test_join_device_path_matches_host_path():
         jc.MIN_DEVICE_REVIEWS = saved
     assert (np.asarray(host) == np.asarray(dev)).all()
     assert host.any(), "non-vacuous: some host collisions must fire"
+
+
+def test_join_device_cache_not_keyed_by_shape_alone():
+    """Two same-size review batches with different membership must get
+    different fires through the device path — regression for the device
+    input cache being keyed only by (data_gen, n, h, kb), which reused
+    the previous batch's key tensors and silently under-fired."""
+    import numpy as np
+
+    from gatekeeper_tpu.utils.values import freeze
+
+    drv = TpuDriver()
+    client = Backend(drv).new_client([K8sValidationTarget()])
+    client.add_template(policies.load("general/uniqueingresshost"))
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sUniqueIngressHost", "metadata": {"name": "u"},
+        "spec": {}})
+    client.add_data(ingress("base", "ns0", ["dup.com"]))
+    jc = drv.join_for("K8sUniqueIngressHost")
+    inv = drv._inventory_tree("admission.k8s.gatekeeper.sh")
+    # batch A: 4 reviews, none colliding; batch B: same size/shape, all
+    # colliding with the stored dup.com host
+    def rv(name, ns, hosts):
+        return freeze({"kind": {"group": "networking.k8s.io",
+                                "version": "v1", "kind": "Ingress"},
+                       "name": name, "namespace": ns,
+                       "object": ingress(name, ns, hosts)})
+
+    batch_a = [rv(f"a{i}", "nsA", [f"free{i}.com"]) for i in range(4)]
+    batch_b = [rv(f"b{i}", "nsB", ["dup.com"]) for i in range(4)]
+    saved = jc.MIN_DEVICE_REVIEWS
+    try:
+        jc.MIN_DEVICE_REVIEWS = 1  # force the device path
+        fa = jc.fires(batch_a, inv, drv._data_gen)
+        fb = jc.fires(batch_b, inv, drv._data_gen)
+        # and back again, to also catch reuse in the other direction
+        fa2 = jc.fires(batch_a, inv, drv._data_gen)
+    finally:
+        jc.MIN_DEVICE_REVIEWS = saved
+    assert not np.asarray(fa).any(), "batch A has no collisions"
+    assert np.asarray(fb).all(), "batch B must all fire"
+    assert not np.asarray(fa2).any(), "stale device tensors reused"
+
+
+def test_join_inv_tables_keyed_by_tree_identity():
+    """Two different inventory trees at the same data generation must
+    not share join tables — regression for the per-data_gen-only cache
+    (second registered target reused the first target's tables)."""
+    from gatekeeper_tpu.utils.values import freeze
+
+    drv = TpuDriver()
+    client = Backend(drv).new_client([K8sValidationTarget()])
+    client.add_template(policies.load("general/uniqueingresshost"))
+    jc = drv.join_for("K8sUniqueIngressHost")
+    tree_a = freeze({"cluster": {}, "namespace": {
+        "ns1": {"networking.k8s.io/v1": {"Ingress": {
+            "a": ingress("a", "ns1", ["x.com"])}}}}})
+    tree_b = freeze({"cluster": {}, "namespace": {}})
+    tabs_a = jc.inv_tables(tree_a, 7)
+    tabs_b = jc.inv_tables(tree_b, 7)
+    assert len(tabs_a[0][0]) == 1, "tree A has one join key"
+    assert len(tabs_b[0][0]) == 0, "tree B is empty, must not reuse A"
+    assert jc.inv_tables(tree_a, 7) is tabs_a, "cache hit expected"
