@@ -20,7 +20,6 @@ from ..client import Client
 from . import metrics
 from .kube import KubeError, NotFound
 from .logging import logger
-from .util import set_by_pod_status
 
 log = logger("audit")
 
@@ -98,25 +97,87 @@ class AuditManager:
         """Discovery-driven sweep: list every listable GVK and feed the
         objects through the driver's BATCHED inventory evaluation (the
         reference reviews one object at a time here)."""
+        from ..target.handler import AugmentedUnstructured
+
         resources = [r for r in self.kube.server_preferred_resources()
                      if "list" in (r.get("verbs") or [])
                      and r.get("kind") not in _SKIP_KINDS
                      and r.get("group") not in ("templates.gatekeeper.sh",
                                                 CONSTRAINT_GROUP)]
-        # namespaces first so the namespace cache resolves selectors
         resources.sort(key=lambda r: (r.get("kind") != "Namespace",
                                       r.get("group") or "", r.get("kind")))
         # stage all live objects into a scratch audit client: reuse the
         # driver's vectorized audit over inventory (external data paths)
         results = []
         staged: list[dict] = []
+        # listed Namespaces, sideloaded onto each namespaced review so
+        # namespaceSelector constraints resolve from the live cluster
+        # state — NOT just synced inventory (reference wraps every object
+        # as AugmentedUnstructured{obj, ns}, manager.go:250-271); the
+        # sort above lists Namespaces first so the map is complete before
+        # any namespaced object is staged
+        ns_by_name: dict[str, dict] = {}
+        saw_ns_kind = False
         for res in resources:
             gvk = (res["group"], res["version"], res["kind"])
             try:
                 objs = self.kube.list(gvk)
             except KubeError:
                 continue
+            if gvk == ("", "v1", "Namespace"):
+                saw_ns_kind = True
+                for o in objs:
+                    name = (o.get("metadata") or {}).get("name")
+                    if name:
+                        ns_by_name[name] = o
             staged.extend(objs)
+        if not saw_ns_kind:
+            # discovery may exclude Namespaces (RBAC-filtered lists);
+            # fetch them explicitly — without this map every
+            # namespaceSelector constraint autorejects. A FAILED listing
+            # aborts the sweep: with no map, augmented() would skip every
+            # namespaced object and the status write would then wipe all
+            # previously-reported violations cluster-wide
+            for o in self.kube.list(("", "v1", "Namespace")):
+                name = (o.get("metadata") or {}).get("name")
+                if name:
+                    ns_by_name[name] = o
+
+        def resolve_ns(name: str) -> Optional[dict]:
+            """Map hit, else a direct GET (a namespace created after the
+            one-time snapshot — the reference's per-object nsCache.Get
+            does the same on a cache miss)."""
+            ns_obj = ns_by_name.get(name)
+            if ns_obj is None:
+                try:
+                    ns_obj = self.kube.get(("", "v1", "Namespace"), name)
+                except KubeError:
+                    return None
+                ns_by_name[name] = ns_obj
+            return ns_obj
+
+        def augmented(o: dict) -> Optional[AugmentedUnstructured]:
+            """Reference semantics (manager.go:250-271 + target.go:129-135):
+            EVERY object gets a namespace sideload — the listed Namespace
+            for namespaced objects (suppressing autoreject and giving the
+            selector real labels), an EMPTY namespace for cluster-scoped
+            ones (the reference's `&corev1.Namespace{}`, so selectors see
+            no labels rather than autorejecting). An object whose
+            namespace cannot be resolved is skipped, as the reference
+            skips on a failed namespace fetch."""
+            ns = (o.get("metadata") or {}).get("namespace")
+            if not ns:
+                return AugmentedUnstructured(o, {"metadata": {}})
+            ns_obj = resolve_ns(ns)
+            if ns_obj is None:
+                log.error("unable to look up object namespace",
+                          details={"namespace": ns,
+                                   "kind": o.get("kind"),
+                                   "name": (o.get("metadata") or {}
+                                            ).get("name")})
+                return None
+            return AugmentedUnstructured(o, ns_obj)
+
         # evaluate via the driver's batch review API when available,
         # falling back to per-object review
         driver = self.opa.driver
@@ -125,7 +186,10 @@ class AuditManager:
             handler = self.opa.targets[target]
             reviews = []
             for o in staged:
-                handled, review = handler.handle_review(o)
+                aug = augmented(o)
+                if aug is None:
+                    continue
+                handled, review = handler.handle_review(aug)
                 if handled:
                     reviews.append(review)
             batches = driver.review_batch(target, reviews)
@@ -134,10 +198,11 @@ class AuditManager:
                     handler.handle_violation(r)
                     results.append(r)
         else:
-            from ..target.handler import AugmentedUnstructured
             for o in staged:
-                results.extend(
-                    self.opa.review(AugmentedUnstructured(o)).results())
+                aug = augmented(o)
+                if aug is None:
+                    continue
+                results.extend(self.opa.review(aug).results())
         return results
 
     # ------------------------------------------------------------ aggregation
@@ -158,7 +223,6 @@ class AuditManager:
         target_kinds = set()
         for kind in self.opa.template_kinds():
             target_kinds.add(kind)
-        seen = set(by_constraint)
         for kind in sorted(target_kinds):
             gvk = (CONSTRAINT_GROUP, "v1beta1", kind)
             try:

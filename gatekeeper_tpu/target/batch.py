@@ -19,9 +19,13 @@ cluster. Semantics still delegate to the differentially-tested predicate
 in matcher.py; this module only memoizes it (correctness asserted by the
 brute-force differential in tests/test_target_matcher.py).
 
-Reviews carrying `_unstable` (webhook namespace sideload) fall back to
-per-review evaluation — the sideloaded namespace object is not part of
-the signature.
+Reviews carrying `_unstable` (namespace sideload — the webhook per
+request, discovery-mode audit for every namespaced object) contribute
+the sideloaded namespace's LABELS to the signature: that is all
+_matches_nsselector can observe of it, so objects sharing a namespace
+still collapse into one group instead of falling back to per-review
+evaluation (the discovery audit sideloads on every namespaced review —
+a fallback there would reintroduce the R×C matcher loop).
 """
 
 from __future__ import annotations
@@ -47,6 +51,19 @@ def _dependence(constraint: dict) -> tuple:
     return (name_dep, "namespaceSelector" in match, "labelSelector" in match)
 
 
+def _labels_key(labels: dict):
+    """Hashable signature key for a labels dict. Labels are dict[str, str]
+    in practice: a sorted-items tuple is a ~4x cheaper key than a
+    recursive freeze (hash() probes for unhashable values so malformed
+    labels fall back cleanly)."""
+    try:
+        t = tuple(sorted(labels.items()))
+        hash(t)
+        return t
+    except TypeError:
+        return freeze(labels)
+
+
 def _label_state(review: dict, field: str):
     """(is-empty, hashable labels key) of review.object/.oldObject —
     everything _any_labelselector_match can observe."""
@@ -58,20 +75,34 @@ def _label_state(review: dict, field: str):
     labels = meta.get("labels") if isinstance(meta, dict) else None
     if not isinstance(labels, dict):
         return (False, None)
-    try:
-        # labels are dict[str, str] in practice: a sorted-items tuple is a
-        # ~4x cheaper signature key than a recursive freeze (hash() probes
-        # for unhashable values so malformed labels fall back cleanly)
-        t = tuple(sorted(labels.items()))
-        hash(t)
-        return (False, t)
-    except TypeError:
-        return (False, freeze(labels))
+    return (False, _labels_key(labels))
+
+
+def _unstable_state(review: dict):
+    """Hashable key of the sideloaded namespace as _get_ns observes it:
+    (present-and-resolving, labels key), or _MISSING for a malformed
+    sideload (→ per-review fallback)."""
+    if "_unstable" not in review:
+        return None
+    unstable = review.get("_unstable")
+    if not isinstance(unstable, dict):
+        return _MISSING
+    ns = unstable.get("namespace")
+    if ns is None:
+        return (False, None)
+    if not isinstance(ns, dict):
+        return _MISSING
+    meta = ns.get("metadata")
+    labels = meta.get("labels") if isinstance(meta, dict) else None
+    if not isinstance(labels, dict):
+        return (True, None)
+    return (True, _labels_key(labels))
 
 
 def _signature(review: dict) -> Optional[tuple]:
     """Full match-relevant signature, or None for per-review fallback."""
-    if "_unstable" in review:
+    ust = _unstable_state(review)
+    if ust is _MISSING:
         return None
     kind = review.get("kind")
     kind = kind if isinstance(kind, dict) else {}
@@ -84,6 +115,7 @@ def _signature(review: dict) -> Optional[tuple]:
         eff_ns,
         _label_state(review, "object"),
         _label_state(review, "oldObject"),
+        ust,
     )
 
 
@@ -93,7 +125,7 @@ def _project(sig: tuple, dep: tuple) -> tuple:
     if name_dep:
         key += (sig[3],)
     if nssel_dep:
-        key += (sig[2], sig[4], sig[5])
+        key += (sig[2], sig[4], sig[5], sig[6])
     if lblsel_dep:
         key += (sig[4], sig[5])
     return key

@@ -214,6 +214,48 @@ def test_dryrun_constraint_does_not_deny(runtime):
                for v in stored["status"]["violations"])
 
 
+def test_discovery_audit_resolves_namespace_selector(runtime):
+    """namespaceSelector constraints must evaluate with REAL match
+    decisions in discovery-mode audit on an UNSYNCED cluster — the
+    listed Namespaces are sideloaded per review (reference
+    manager.go:250-271), not read from synced inventory. Regression:
+    the audit staged raw objects, the matcher fell back to the (empty)
+    inventory cache, and every namespaceSelector constraint
+    autorejected with "Namespace is not cached in OPA"."""
+    kube = runtime.kube
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    c = json.loads(json.dumps(CONSTRAINT))
+    c["metadata"]["name"] = "owner-in-prod-ns"
+    c["spec"]["match"] = {
+        "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+        "namespaceSelector": {"matchLabels": {"env": "prod"}},
+    }
+    kube.create(c)
+    runtime.manager.drain()
+    kube.create(ns("prod-ns", {"env": "prod"}))
+    kube.create(ns("dev-ns", {"env": "dev"}))
+
+    def pod(name, namespace):
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": namespace}}
+
+    kube.create(pod("unlabeled-prod", "prod-ns"))
+    kube.create(pod("unlabeled-dev", "dev-ns"))
+    # no Config sync: the driver's inventory namespace cache is empty
+    assert runtime.opa.driver.get_data(
+        ("external", "admission.k8s.gatekeeper.sh", "cluster", "v1",
+         "Namespace", "prod-ns")) is None
+    runtime.audit.audit_once()
+    stored = kube.get((CONSTRAINT_GROUP, "v1beta1", "K8sRequiredLabels"),
+                      "owner-in-prod-ns")
+    viol = stored["status"]["violations"]
+    names = {v["name"] for v in viol}
+    assert "unlabeled-prod" in names, viol
+    assert "unlabeled-dev" not in names, viol
+    assert all("not cached in OPA" not in v["message"] for v in viol), viol
+
+
 def test_gatekeeper_resource_validation(runtime):
     handler = runtime.webhook.validation
     bad_template = json.loads(json.dumps(TEMPLATE))

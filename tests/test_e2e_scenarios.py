@@ -64,6 +64,13 @@ def rt():
     runtime.args.metrics_backend = "none"
     runtime.kube.register_kind(("networking.k8s.io", "v1", "Ingress"),
                                namespaced=True)
+    # the namespaces the demo manifests deploy into — a real cluster
+    # always has the Namespace object (the audit, like the reference,
+    # skips objects whose namespace cannot be fetched)
+    for ns_name in ("gatekeeper-system", "payments", "production",
+                    "staging"):
+        runtime.kube.create({"apiVersion": "v1", "kind": "Namespace",
+                             "metadata": {"name": ns_name}})
     runtime.start()
     yield runtime
     runtime.stop()

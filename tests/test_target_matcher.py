@@ -105,6 +105,27 @@ def _reviews():
             "kind": {"group": "", "version": "v1", "kind": "Pod"},
             "namespace": "prod", "object": pod("a", "prod", web),
             "_unstable": {"namespace": NS_OBJECTS["prod"]}},
+        # sideload takes priority over the cache lookup (src.rego get_ns):
+        # review.namespace says prod but the sideloaded object is dev
+        "pod-sideload-overrides-cache": {
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "namespace": "prod", "object": pod("a", "prod", web),
+            "_unstable": {"namespace": NS_OBJECTS["dev"]}},
+        # sideload resolves a namespace the cache has never seen — the
+        # discovery-audit case (reference manager.go:250-271)
+        "pod-unknown-ns-sideloaded": {
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "namespace": "nowhere", "object": pod("a", "nowhere", web),
+            "_unstable": {"namespace": {
+                "apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "nowhere",
+                             "labels": {"env": "prod"}}}}},
+        "pod-sideload-unlabeled-ns": {
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "namespace": "bare", "object": pod("a", "bare", web),
+            "_unstable": {"namespace": {
+                "apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "bare"}}}},
         "pod-unknown-ns": {"kind": {"group": "", "version": "v1", "kind": "Pod"},
                            "namespace": "nowhere", "object": pod("a", "nowhere")},
         "pod-empty-ns-string": {
