@@ -77,6 +77,15 @@ def main() -> int:
         ok(f"{label}{suffix}")
 
     try:
+        # the namespaces the demo manifests deploy into — a real cluster
+        # always has the Namespace object (the audit skips objects whose
+        # namespace cannot be fetched, mirroring the reference)
+        for ns_name in ("gatekeeper-system", "payments", "production",
+                        "staging"):
+            rt.kube.create({"apiVersion": "v1", "kind": "Namespace",
+                            "metadata": {"name": ns_name,
+                                         "labels": {"owner": "agilebank"}}})
+
         say("AgileBank applies the policy templates")
         for p in sorted((DEMO / "templates").glob("*.yaml")):
             rt.kube.create(yaml.safe_load(p.read_text()))

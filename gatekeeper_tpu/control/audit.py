@@ -143,15 +143,25 @@ class AuditManager:
                 if name:
                     ns_by_name[name] = o
 
+        ns_missing: set[str] = set()
+
         def resolve_ns(name: str) -> Optional[dict]:
             """Map hit, else a direct GET (a namespace created after the
             one-time snapshot — the reference's per-object nsCache.Get
-            does the same on a cache miss)."""
+            does the same on a cache miss). Failures are negative-cached
+            for the sweep: N orphaned objects in a deleted namespace
+            must cost one GET, not N."""
             ns_obj = ns_by_name.get(name)
             if ns_obj is None:
+                if name in ns_missing:
+                    return None
                 try:
                     ns_obj = self.kube.get(("", "v1", "Namespace"), name)
                 except KubeError:
+                    ns_missing.add(name)
+                    log.error("unable to look up object namespace; "
+                              "skipping its objects this sweep",
+                              details={"namespace": name})
                     return None
                 ns_by_name[name] = ns_obj
             return ns_obj
@@ -170,11 +180,6 @@ class AuditManager:
                 return AugmentedUnstructured(o, {"metadata": {}})
             ns_obj = resolve_ns(ns)
             if ns_obj is None:
-                log.error("unable to look up object namespace",
-                          details={"namespace": ns,
-                                   "kind": o.get("kind"),
-                                   "name": (o.get("metadata") or {}
-                                            ).get("name")})
                 return None
             return AugmentedUnstructured(o, ns_obj)
 

@@ -161,7 +161,7 @@ def enable_compile_cache() -> None:
 
 
 class TpuDriver(RegoDriver):
-    def __init__(self):
+    def __init__(self, mesh=None):
         super().__init__()
         enable_compile_cache()
         self.strtab = StringTable()
@@ -205,6 +205,49 @@ class TpuDriver(RegoDriver):
         # refines it from real batches and audits
         self._host_pair_rate: float = 100_000.0
         self._dev_skips = 0
+        # multi-device: audits shard over the mesh's "data" axis (the
+        # object dimension — SURVEY §2.5's batch-parallel sweep) when
+        # more than one device is visible. GATEKEEPER_TPU_MESH=off
+        # disables; =<n> caps the data-axis width
+        self._mesh = self._build_mesh(mesh)
+        # sharded/replicated device placements for the mesh sweep,
+        # keyed (id(leaf), data-leading?) with the _dev weakref pattern
+        self._dev_mesh_cache: dict = {}
+        # which path the last audit's compiled kinds took, for
+        # observability (bench.py reports it): "mesh(data=N)" | "single"
+        self.last_audit_path: Optional[str] = None
+
+    def _build_mesh(self, mesh):
+        import os
+
+        if mesh is not None:
+            return mesh
+        cfg = os.environ.get("GATEKEEPER_TPU_MESH", "auto").lower()
+        if cfg in ("off", "0", "none", ""):
+            return None
+        import jax
+
+        devices = jax.devices()
+        if cfg not in ("auto", "all"):
+            try:
+                devices = devices[: int(cfg)]
+            except ValueError:
+                log.warning("GATEKEEPER_TPU_MESH=%r not understood; "
+                            "using all %d devices", cfg, len(devices))
+        if len(devices) < 2:
+            return None
+        # the data axis must divide the power-of-two extraction buckets
+        # (_mesh_shardable): round down so e.g. 6 visible devices shard
+        # over 4 instead of silently never taking the mesh path
+        pow2 = 1 << (len(devices).bit_length() - 1)
+        if pow2 != len(devices):
+            log.warning("mesh data axis rounded down to %d of %d devices "
+                        "(power-of-two bucket divisibility)", pow2,
+                        len(devices))
+            devices = devices[:pow2]
+        from ..parallel.mesh import make_mesh
+
+        return make_mesh(devices=devices)
 
     # ------------------------------------------------------------- modules
 
@@ -374,10 +417,45 @@ class TpuDriver(RegoDriver):
 
         return jax.tree_util.tree_map(put, tree)
 
+    def _dev_mesh(self, tree, data_leading: bool):
+        """Mesh placement twin of _dev: leaves are device_put with a
+        NamedSharding — leading axis split over "data" for feature
+        tensors, fully replicated for params/tables — and cached weakly
+        by host-array identity, so steady-state mesh audits re-dispatch
+        over resident sharded buffers instead of re-distributing every
+        sweep."""
+        import weakref
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh
+        cache = self._dev_mesh_cache
+
+        def put(arr):
+            key = (id(arr), data_leading)
+            hit = cache.get(key)
+            if hit is not None and hit[0]() is arr:
+                return hit[1]
+            if data_leading and getattr(arr, "ndim", 0) >= 1:
+                spec = P("data", *([None] * (arr.ndim - 1)))
+            else:
+                spec = P(*([None] * getattr(arr, "ndim", 0)))
+            d = jax.device_put(arr, NamedSharding(mesh, spec))
+            try:
+                ref = weakref.ref(arr, lambda _r, k=key: cache.pop(k, None))
+            except TypeError:
+                return d
+            cache[key] = (ref, d)
+            return d
+
+        return jax.tree_util.tree_map(put, tree)
+
     # --------------------------------------------------------------- audit
 
     def _eval_audit(self, target: str, trace: Optional[list]) -> list[Result]:
         constraints = self._constraints(target)
+        self._audit_used_mesh = False
         if not constraints:
             return []
         lookup_ns = self._namespace_lookup(target)
@@ -445,12 +523,30 @@ class TpuDriver(RegoDriver):
                                                sig_cache)
         for kind in sorted(by_kind):
             results.extend(by_res.get(kind, []))
+        self.last_audit_path = (
+            f"mesh(data={self._mesh.shape['data']})"
+            if self._audit_used_mesh else "single")
         return results
+
+    # audits below this many candidate reviews stay single-device: a
+    # mesh dispatch only pays off once per-shard slabs are substantial
+    MESH_MIN_REVIEWS = 8192
+
+    def _mesh_shardable(self, n_reviews: int) -> bool:
+        """Mesh path gate: enough rows, and the power-of-two extraction
+        bucket divides evenly over the data axis."""
+        if self._mesh is None or n_reviews < self.MESH_MIN_REVIEWS:
+            return False
+        from .features import _bucket
+
+        return _bucket(n_reviews) % self._mesh.shape["data"] == 0
 
     def _audit_dispatch(self, target, kind, ct, cons, reviews, lookup_ns,
                         sig_cache):
         """Phase 1 for one compiled kind: mask, feature prep, and ASYNC
-        device dispatch of every slab. Returns consume state, or None
+        device dispatch of every slab — SPMD over the mesh's data axis
+        when one is available and the sweep is large enough, else the
+        single-device slab pipeline. Returns consume state, or None
         after a demotion (caller falls back to the interpreter)."""
         try:
             mask = self._match_mask(target, kind, cons, reviews, lookup_ns,
@@ -465,17 +561,26 @@ class TpuDriver(RegoDriver):
             if not self._use_device_for_batch(int(mask.sum())):
                 return None
             cand_reviews = [reviews[int(i)] for i in cand]
+            use_mesh = self._mesh_shardable(len(cand_reviews))
             feat_key = (self._data_gen, hash(cand.tobytes()))
             feats, enc, table, derived = self._prepare_eval(
                 ct, kind, cand_reviews, cons, feat_key, cand=cand,
-                target=target)
+                target=target, mesh=use_mesh)
             c_dev = _param_c(enc)
             chunk = 8192
-            half = (len(cand_reviews) + 1) // 2
-            slab = max(chunk * 4, ((half + chunk - 1) // chunk) * chunk)
-            handle = ct.fires_pairs_dispatch(feats, enc, table, derived,
-                                             chunk=chunk, slab=slab,
-                                             n_true=len(cand_reviews))
+            if use_mesh:
+                handle = ct.fires_pairs_mesh_dispatch(
+                    feats, enc, table, self._mesh, derived, chunk=chunk,
+                    n_true=len(cand_reviews))
+                self._audit_used_mesh = True
+            else:
+                half = (len(cand_reviews) + 1) // 2
+                slab = max(chunk * 4,
+                           ((half + chunk - 1) // chunk) * chunk)
+                handle = ct.fires_pairs_dispatch(feats, enc, table,
+                                                 derived, chunk=chunk,
+                                                 slab=slab,
+                                                 n_true=len(cand_reviews))
             return ("h", mask, cand, cand_reviews, handle, c_dev)
         except DriverError:
             raise
@@ -710,7 +815,7 @@ class TpuDriver(RegoDriver):
 
     def _prepare_eval(self, ct: CompiledTemplate, kind: str,
                       reviews: list[dict], cons: list[dict], feat_key,
-                      cand=None, target=None):
+                      cand=None, target=None, mesh: bool = False):
         params_key = (self._constraint_gen,
                       tuple((c.get("metadata") or {}).get("name", "")
                             for c in cons))
@@ -755,6 +860,14 @@ class TpuDriver(RegoDriver):
                 }
         derived = self._derived_arrays(kind, ct)
         table = self.match_tables.materialize_packed()
+        if mesh:
+            # SPMD sweep: features split over the data axis, everything
+            # else replicated across the mesh, all kept resident
+            if feat_key is not None:
+                feats = self._dev_mesh(feats, data_leading=True)
+            return (feats, self._dev_mesh(enc, False),
+                    self._dev_mesh(table, False),
+                    self._dev_mesh(derived, False))
         if feat_key is not None:
             # steady-state audit: keep the cached tensors device-resident.
             # One-shot feats (webhook micro-batches) stay host-side — the
@@ -799,13 +912,13 @@ class TpuDriver(RegoDriver):
         return feats
 
     def _dev_patch_row(self, arr, pos: int, row) -> None:
-        """Refresh a device-resident leaf after an in-place host row
-        patch: transfer only the ROW and dynamic-update it into the
-        resident buffer (a full re-upload costs seconds on a tunneled
-        chip)."""
-        ent = self._dev_cache.get(id(arr))
-        if ent is None or ent[0]() is not arr:
-            return
+        """Refresh device-resident leaves after an in-place host row
+        patch: transfer only the ROW and dynamic-update it into each
+        resident buffer — the single-device copy and any mesh-sharded
+        copy (a full re-upload costs seconds on a tunneled chip). The
+        sharded update touches one row on one shard; the result is
+        pinned back to the original sharding so steady-state mesh sweeps
+        keep dispatching over resident buffers."""
         import jax
 
         fn = getattr(self, "_row_update_fn", None)
@@ -814,7 +927,16 @@ class TpuDriver(RegoDriver):
                 return jax.lax.dynamic_update_slice_in_dim(
                     d, r[None], p, axis=0)
             fn = self._row_update_fn = jax.jit(upd)
-        self._dev_cache[id(arr)] = (ent[0], fn(ent[1], row, np.int32(pos)))
+        ent = self._dev_cache.get(id(arr))
+        if ent is not None and ent[0]() is arr:
+            self._dev_cache[id(arr)] = (ent[0],
+                                        fn(ent[1], row, np.int32(pos)))
+        ment = self._dev_mesh_cache.get((id(arr), True))
+        if ment is not None and ment[0]() is arr:
+            d = fn(ment[1], row, np.int32(pos))
+            if d.sharding != ment[1].sharding:
+                d = jax.device_put(d, ment[1].sharding)
+            self._dev_mesh_cache[(id(arr), True)] = (ment[0], d)
 
     def _derived_arrays(self, kind: str, ct: CompiledTemplate) -> dict:
         """Program-local derived columns, extended to the current vocab.

@@ -662,6 +662,41 @@ def _decode_row_blocks(arr: np.ndarray, rcount: int, c: int):
     return rows_idx[r_rep], cols.astype(np.int64)
 
 
+class _MeshPairs:
+    """Pending mesh sweep; .pairs() syncs the single all-shard fetch and
+    decodes shard row blocks in mesh order (global row-major, since the
+    data axis shards the N axis into contiguous ordered blocks)."""
+
+    def __init__(self, ct, mesh, dev, rcap, chunk, args):
+        self._ct = ct
+        self._mesh = mesh
+        self._dev = dev
+        self._rcap = rcap
+        self._chunk = chunk
+        self._args = args  # (feats, params, table, derived, n_valid, c)
+
+    def pairs(self):
+        ct = self._ct
+        feats, params, table, derived, n_valid, c = self._args
+        rcap = self._rcap
+        arr = np.asarray(self._dev)  # sync point + single fetch
+        n_shards = arr.shape[0] // (rcap + 1)
+        counts = arr[:: rcap + 1, 0].astype(np.int64)
+        while counts.max(initial=0) > rcap:
+            # some shard overflowed its gather capacity: re-run the whole
+            # sweep at the next power of two (rare; remembered below)
+            rcap = max(rcap, 1 << (int(counts.max()) - 1).bit_length())
+            fn = ct._mesh_pairs_jit(self._mesh, self._chunk, rcap)
+            arr = np.asarray(fn(feats, params, table, derived, n_valid))
+            counts = arr[:: rcap + 1, 0].astype(np.int64)
+        ct._rows_cap_mesh = max(256, (1 << (int(counts.max()) - 1)
+                                      .bit_length())
+                                if counts.max(initial=0) > 1 else 256)
+        for k in range(n_shards):
+            block = arr[k * (rcap + 1): (k + 1) * (rcap + 1)]
+            yield _decode_row_blocks(block, int(block[0, 0]), c)
+
+
 class CompiledTemplate:
     """Device-evaluable filter for one template."""
 
@@ -677,6 +712,8 @@ class CompiledTemplate:
         self._pairs_cache: dict[tuple, Any] = {}
         # remembered firing-row gather capacity (see _gather_rows)
         self._rows_cap = 256
+        # per-shard capacity for the mesh sweep (fires_pairs_mesh_dispatch)
+        self._rows_cap_mesh = 256
 
     def _eval(self, feats, params, table, derived):
         out = None
@@ -914,6 +951,114 @@ class CompiledTemplate:
                 for k in range(n_slabs)]
         return _SlabPairs(self, pend, feats, params, match_table, derived,
                           chunk, slab, n, c)
+
+    def _mesh_pairs_jit(self, mesh, chunk: int, rcap: int):
+        """One fused SPMD program per (mesh, chunk, per-shard rcap):
+        shard_map over the mesh's "data" axis — each device sweeps its
+        contiguous N/D row block (chunked lax.map, same eval body as the
+        single-device sweep), bit-packs verdicts over C, masks padding
+        rows by GLOBAL row index, and gathers its local firing rows at
+        capacity rcap. Output spec P("data") concatenates the per-shard
+        [rcap+1, W+1] row blocks, so the host pays ONE fetch for the
+        whole mesh. No cross-device collective during evaluation: the
+        object axis is pure data parallelism; aggregation happens on
+        host from per-shard blocks (counts ride in each block header)."""
+        key = ("mesh", id(mesh), chunk, rcap)
+        fn = self._pairs_cache.get(key)
+        if fn is not None:
+            return fn
+        from jax.sharding import PartitionSpec as P
+
+        def local(feats_l, params, table, derived, n_valid):
+            leaf = next(iter(next(iter(feats_l.values())).values()))
+            n_loc = leaf.shape[0]  # static: N // data axis size
+            chunked = jax.tree_util.tree_map(
+                lambda a: a.reshape((-1, chunk) + a.shape[1:]), feats_l)
+
+            def body(ch):
+                fires = self._eval(ch, params, table, derived)  # [chunk, C]
+                c = fires.shape[-1]
+                w = (c + 31) // 32
+                pad = w * 32 - c
+                if pad:
+                    fires = jnp.pad(fires, ((0, 0), (0, pad)))
+                bits = fires.reshape(fires.shape[0], w, 32)
+                weights = (jnp.uint32(1) << jnp.arange(32,
+                                                       dtype=jnp.uint32))
+                return jnp.sum(jnp.where(bits, weights, jnp.uint32(0)),
+                               axis=-1, dtype=jnp.uint32)
+
+            packed = jax.lax.map(body, chunked)
+            packed = packed.reshape((n_loc,) + packed.shape[2:])
+            w = packed.shape[1]
+            idx = jax.lax.axis_index("data")
+            row0 = idx * n_loc
+            rows_global = row0 + jnp.arange(n_loc, dtype=jnp.int32)
+            packed = jnp.where((rows_global < n_valid)[:, None], packed,
+                               jnp.uint32(0))
+            per_row = jnp.sum(jax.lax.population_count(packed), axis=1,
+                              dtype=jnp.int32)
+            row_any = per_row > 0
+            rcount = jnp.sum(row_any, dtype=jnp.int32)
+            rows_idx = jnp.nonzero(row_any, size=rcap, fill_value=n_loc)[0]
+            sel = jnp.where(rows_idx < n_loc, rows_idx, 0)
+            sub = packed[sel]
+            sub = jnp.where((rows_idx < n_loc)[:, None], sub,
+                            jnp.uint32(0))
+            gr = jnp.where(rows_idx < n_loc, row0 + rows_idx,
+                           jnp.int32(0)).astype(jnp.uint32)
+            body2 = jnp.concatenate([gr[:, None], sub], axis=1)
+            header = jnp.zeros((1, w + 1), jnp.uint32)
+            header = header.at[0, 0].set(rcount.astype(jnp.uint32))
+            return jnp.concatenate([header, body2], axis=0)
+
+        def run(feats, params, table, derived, n_valid):
+            fspec = jax.tree_util.tree_map(
+                lambda a: P("data", *([None] * (a.ndim - 1))), feats)
+            rep = lambda tree: jax.tree_util.tree_map(
+                lambda a: P(*([None] * a.ndim)), tree)
+            return jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(fspec, rep(params), rep(table), rep(derived),
+                          P()),
+                out_specs=P("data", None),
+                check_vma=False,
+            )(feats, params, table, derived, n_valid)
+
+        fn = jax.jit(run)
+        self._pairs_cache[key] = fn
+        return fn
+
+    def fires_pairs_mesh_dispatch(self, feats: dict, params: dict,
+                                  match_table: np.ndarray, mesh,
+                                  derived: Optional[dict] = None,
+                                  chunk: int = 8192,
+                                  n_true: Optional[int] = None):
+        """Mesh-sharded form of fires_pairs_dispatch: dispatch the SPMD
+        sweep NOW (async), return a handle whose .pairs() syncs one
+        fetch and yields per-shard (rows, cols) in global row-major
+        order. Requires the feature N axis divisible by the mesh's
+        "data" axis size (callers pad to a power-of-two bucket and gate
+        on divisibility)."""
+        derived = derived or {}
+        n_feat = (next(iter(next(iter(feats.values())).values())).shape[0]
+                  if feats else 0)
+        n_data = mesh.shape["data"]
+        if not feats or n_feat % n_data:
+            raise ValueError(f"N={n_feat} not shardable over data={n_data}")
+        n = n_feat if n_true is None else min(n_feat, n_true)
+        n_loc = n_feat // n_data
+        chunk_eff = min(chunk, n_loc)
+        if n_loc % chunk_eff:
+            raise ValueError(f"n_loc={n_loc} not divisible by "
+                             f"chunk={chunk_eff}")
+        c = _param_c(params)
+        rcap = self._rows_cap_mesh
+        fn = self._mesh_pairs_jit(mesh, chunk_eff, rcap)
+        dev = fn(feats, params, match_table, derived, np.int32(n))
+        return _MeshPairs(self, mesh, dev, rcap, chunk_eff,
+                          (feats, params, match_table, derived,
+                           np.int32(n), c))
 
     def fires_pairs_slabbed(self, feats: dict, params: dict,
                             match_table: np.ndarray,

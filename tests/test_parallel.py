@@ -164,6 +164,118 @@ def test_make_mesh_validates_factorization():
     assert dict(mesh.shape) == {"data": 4, "model": 2}
 
 
+def _mesh_driver(n_min=64):
+    """TpuDriver with the production mesh path forced on: low review
+    threshold and a pinned device-latency EMA so the adaptive cost model
+    cannot route the sweep back to the host mid-test."""
+    drv = TpuDriver()
+    assert drv._mesh is not None, "8-device platform must yield a mesh"
+    drv.MESH_MIN_REVIEWS = n_min
+    drv._dev_batch_lat_s = 1e-4
+    return drv
+
+
+def _labels_workload(client, n):
+    from gatekeeper_tpu import policies
+
+    client.add_template(policies.load("general/requiredlabels"))
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels", "metadata": {"name": "need-owner"},
+        "spec": {"parameters": {"labels": [{"key": "owner"}]}}})
+    for i in range(n):
+        o = {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": f"ns{i:05d}"}}
+        if i % 3 == 0:
+            o["metadata"]["labels"] = {"owner": "me"}
+        client.add_data(o)
+
+
+def _audit_key(results):
+    return sorted((r.msg, (r.resource or {}).get("metadata", {})
+                   .get("name", "")) for r in results)
+
+
+def test_driver_mesh_audit_equals_single_device():
+    """The PRODUCTION audit path sharded over the mesh (TpuDriver with
+    >1 device, through client.audit()) must equal the single-device
+    TpuDriver and the interpreter driver exactly — and must actually
+    take the mesh path (asserted via last_audit_path, so this cannot
+    go vacuous)."""
+    from gatekeeper_tpu.client import RegoDriver
+
+    N = 2048
+    dm = _mesh_driver()
+    cm = Backend(dm).new_client([K8sValidationTarget()])
+    _labels_workload(cm, N)
+    got_mesh = _audit_key(cm.audit().results())
+    assert dm.last_audit_path == "mesh(data=8)", dm.last_audit_path
+
+    ds = TpuDriver()
+    ds._mesh = None
+    ds._dev_batch_lat_s = 1e-4
+    cs = Backend(ds).new_client([K8sValidationTarget()])
+    _labels_workload(cs, N)
+    got_single = _audit_key(cs.audit().results())
+    assert ds.last_audit_path == "single"
+
+    ci = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    _labels_workload(ci, N)
+    got_interp = _audit_key(ci.audit().results())
+
+    assert got_mesh == got_single == got_interp
+    assert len(got_mesh) == N - (N + 2) // 3, "non-vacuous"
+
+    # steady state re-audit over resident sharded buffers
+    assert _audit_key(cm.audit().results()) == got_mesh
+    assert dm.last_audit_path == "mesh(data=8)"
+
+    # single-object churn: the patch journal must keep the sharded
+    # feature tensors coherent (row update lands on the right shard)
+    for c in (cm, cs):
+        c.remove_data({"apiVersion": "v1", "kind": "Namespace",
+                       "metadata": {"name": "ns00001"}})
+    got_mesh2 = _audit_key(cm.audit().results())
+    assert dm.last_audit_path == "mesh(data=8)"
+    assert got_mesh2 == _audit_key(cs.audit().results())
+    assert len(got_mesh2) == len(got_mesh) - 1
+
+
+def test_driver_mesh_gather_capacity_retry():
+    """Every object violating: the per-shard firing-row gather must
+    overflow its initial capacity and re-run at a larger one without
+    losing rows."""
+    dm = _mesh_driver()
+    cm = Backend(dm).new_client([K8sValidationTarget()])
+    from gatekeeper_tpu import policies
+
+    cm.add_template(policies.load("general/requiredlabels"))
+    cm.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels", "metadata": {"name": "need-owner"},
+        "spec": {"parameters": {"labels": [{"key": "owner"}]}}})
+    N = 4096  # 512 firing rows per shard > the 256 initial capacity
+    for i in range(N):
+        cm.add_data({"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": f"ns{i:05d}"}})
+    out = cm.audit().results()
+    assert dm.last_audit_path == "mesh(data=8)"
+    assert len(out) == N, f"{len(out)} != {N} (rows lost in retry?)"
+    ct = dm.compiled_for("K8sRequiredLabels")
+    assert ct._rows_cap_mesh >= 512
+
+
+def test_driver_mesh_respects_min_reviews():
+    """Below the mesh threshold the driver stays single-device."""
+    dm = _mesh_driver(n_min=1 << 30)
+    dm._dev_batch_lat_s = 1e-4
+    cm = Backend(dm).new_client([K8sValidationTarget()])
+    _labels_workload(cm, 2048)
+    out = cm.audit().results()
+    assert dm.last_audit_path == "single"
+    assert len(out) == 2048 - (2048 + 2) // 3
+
+
 def test_sharded_inventory_join_membership():
     """The inventory-join membership kernel (ir/join.py: searchsorted
     over the unique-key table with count/identity rules) sharded over
