@@ -54,7 +54,8 @@ def review_of(obj, operation="CREATE"):
 def main() -> int:
     args = build_parser().parse_args([
         "--fake-kube", "--port", "0", "--prometheus-port", "0",
-        "--disable-cert-rotation", "--log-level", "WARNING",
+        "--health-addr", ":0", "--disable-cert-rotation",
+        "--log-level", "WARNING",
     ])
     rt = Runtime(args)
     rt.args.metrics_backend = "none"

@@ -117,17 +117,33 @@ class CertRotator:
         self.vwh_name = vwh_name
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._reconcile_thread: Optional[threading.Thread] = None
+        self._registrar = None
+        self._ca_pem: Optional[bytes] = None
+        # the 12h rotation loop and the watch-driven reconciler both
+        # call refresh_certs; interleaved regeneration would mix cert
+        # and key from different generations on disk
+        self._refresh_lock = threading.Lock()
 
     # ------------------------------------------------------------ lifecycle
 
-    def start(self) -> None:
+    def start(self, watch_manager=None) -> None:
         self.refresh_certs()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="cert-rotator")
         self._thread.start()
+        if watch_manager is not None:
+            self.start_reconciler(watch_manager)
 
     def stop(self) -> None:
         self._stop.set()
+        if self._registrar is not None:
+            from .kube import WatchEvent
+
+            # unblock the reconcile drain, then drop the watches
+            self._registrar.events.put(WatchEvent("_STOP", {}))
+            for gvk in list(self._registrar.gvks):
+                self._registrar.remove_watch(gvk)
 
     def _loop(self) -> None:
         while not self._stop.wait(CHECK_INTERVAL):
@@ -136,9 +152,63 @@ class CertRotator:
             except Exception as e:
                 log.error("cert refresh failed", details=str(e))
 
+    # ----------------------------------------------------------- reconciler
+
+    def start_reconciler(self, watch_manager) -> None:
+        """ReconcileVWH analog (reference certs.go:454-530): watch the
+        ValidatingWebhookConfiguration and the cert Secret and re-inject
+        the CA bundle the moment either changes — a VWH recreated
+        between 12-hour refresh ticks must not serve an unbundled config
+        until the next tick."""
+        reg = watch_manager.registrar("cert-reconciler")
+        reg.add_watch(VWH_GVK)
+        reg.add_watch(SECRET_GVK)
+        self._registrar = reg
+        self._reconcile_thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True,
+            name="cert-reconciler")
+        self._reconcile_thread.start()
+
+    def _reconcile_loop(self) -> None:
+        import queue
+
+        reg = self._registrar
+        while not self._stop.is_set():
+            try:
+                event = reg.events.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            try:
+                self._reconcile_event(event)
+            except Exception as e:
+                log.error("cert reconcile failed", details=str(e))
+
+    def _reconcile_event(self, event) -> None:
+        obj = event.object or {}
+        meta = obj.get("metadata") or {}
+        kind = obj.get("kind")
+        if kind == "ValidatingWebhookConfiguration":
+            if meta.get("name") != self.vwh_name or \
+                    event.type == "DELETED":
+                return
+            if self._ca_pem:
+                self.inject_ca(self._ca_pem)
+        elif kind == "Secret":
+            if meta.get("name") != self.secret_name or \
+                    (meta.get("namespace") or "") != self.namespace:
+                return
+            # deleted or externally modified: regenerate/reload and
+            # re-inject (refresh is idempotent when the secret is valid,
+            # so our own writes do not loop)
+            self.refresh_certs()
+
     # -------------------------------------------------------------- refresh
 
     def refresh_certs(self) -> None:
+        with self._refresh_lock:
+            self._refresh_certs_locked()
+
+    def _refresh_certs_locked(self) -> None:
         secret = self._load_secret()
         data = (secret or {}).get("data") or {}
         ca_pem = base64.b64decode(data.get("ca.crt") or b"")
@@ -155,6 +225,7 @@ class CertRotator:
         else:
             key_pem = base64.b64decode(data.get("tls.key") or b"")
         self._write_files(cert_pem, key_pem, ca_pem)
+        self._ca_pem = ca_pem
         self.inject_ca(ca_pem)
 
     def _load_secret(self) -> Optional[dict]:

@@ -1,0 +1,101 @@
+"""Health endpoints.
+
+Counterpart of the reference's healthz/readyz wiring (main.go:205-212:
+a ping checker on /healthz and default-ready /readyz served on
+--health-addr). /healthz answers 200 as soon as the server is up (the
+process is alive); /readyz consults the registered readiness checks and
+answers 503 with the failing check names until they all pass.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from .logging import logger
+
+log = logger("health")
+
+
+def parse_addr(addr: str) -> Optional[tuple[str, int]]:
+    """":9090" / "0.0.0.0:9090" -> (host, port); None when disabled
+    (empty or "0") or unparseable. Port 0 binds an EPHEMERAL port
+    (tests use ":0" to avoid collisions)."""
+    if not addr or addr == "0":
+        return None
+    host, _, port_s = addr.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        return None
+    if port < 0:
+        return None
+    return (host or "0.0.0.0", port)
+
+
+class HealthServer:
+    """/healthz + /readyz on --health-addr."""
+
+    def __init__(self, host: str, port: int):
+        self._checks: dict[str, Callable[[], bool]] = {}
+        self._lock = threading.Lock()
+        checks = self._checks
+        lock = self._lock
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/healthz":
+                    self._reply(200, b"ok")
+                    return
+                if path == "/readyz":
+                    with lock:
+                        items = list(checks.items())
+                    failing = []
+                    for name, fn in items:
+                        try:
+                            if not fn():
+                                failing.append(name)
+                        except Exception:
+                            failing.append(name)
+                    if failing:
+                        self._reply(503, ("not ready: "
+                                          + ", ".join(failing)).encode())
+                    else:
+                        self._reply(200, b"ok")
+                    return
+                self._reply(404, b"not found")
+
+            def _reply(self, code: int, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def add_readiness(self, name: str, check: Callable[[], bool]) -> None:
+        with self._lock:
+            self._checks[name] = check
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="health", daemon=True)
+        self._thread.start()
+        log.info("health endpoints serving",
+                 details={"port": self.port})
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -19,6 +19,7 @@ import threading
 from ..client import Backend
 from ..ir import TpuDriver
 from ..target import K8sValidationTarget
+from . import health
 from . import logging as glog
 from . import metrics
 from .audit import (
@@ -118,6 +119,8 @@ class Runtime:
                                          keyfile=keyfile)
         self.upgrade = UpgradeManager(self.kube)
         self.metrics_server = None
+        self.health = None
+        self._ready = False
 
     def _register_builtin_kinds(self) -> None:
         for gvk, namespaced in [
@@ -142,14 +145,34 @@ class Runtime:
                 self.metrics_server = metrics.serve(self.args.prometheus_port)
             except OSError as e:
                 log.warning("metrics port unavailable", details=str(e))
+        # healthz/readyz on --health-addr (reference main.go:205-212)
+        health_addr = getattr(self.args, "health_addr", "")
+        addr = health.parse_addr(health_addr)
+        if addr is not None:
+            try:
+                self.health = health.HealthServer(*addr)
+                self.health.add_readiness("runtime", lambda: self._ready)
+                if self.webhook:
+                    self.health.add_readiness(
+                        "webhook",
+                        lambda: self.webhook._thread.is_alive())
+                self.health.start()
+            except OSError as e:
+                log.warning("health port unavailable", details=str(e))
+        elif health_addr and health_addr != "0":
+            # a typo'd flag silently dropping liveness probes would
+            # crash-loop the deployment with no hint in the logs
+            log.warning("--health-addr not understood; health endpoints "
+                        "disabled", details={"health_addr": health_addr})
         self.upgrade.upgrade()
         self.manager.start()
         if self.audit:
             self.audit.start()
         if self.cert_rotator:
-            self.cert_rotator.start()
+            self.cert_rotator.start(watch_manager=self.manager.wm)
         if self.webhook:
             self.webhook.start()
+        self._ready = True
         # long-lived-server GC tuning: everything built so far (engine,
         # policy caches, codegen closures) is effectively permanent;
         # freezing it out of the collector's scan set keeps multi-ms
@@ -161,6 +184,7 @@ class Runtime:
                  details={"operations": sorted(self.operations)})
 
     def stop(self) -> None:
+        self._ready = False
         if self.webhook:
             self.webhook.stop()
         if self.audit:
@@ -170,6 +194,8 @@ class Runtime:
         self.manager.stop()
         if self.metrics_server:
             self.metrics_server.shutdown()
+        if self.health:
+            self.health.shutdown()
         log.info("gatekeeper-tpu stopped")
 
 

@@ -85,7 +85,7 @@ def admission_review(obj, operation="CREATE", username="alice", old=None):
 def runtime():
     args = build_parser().parse_args([
         "--fake-kube", "--port", "0", "--prometheus-port", "0",
-        "--disable-cert-rotation", "--log-denies",
+        "--health-addr", ":0", "--disable-cert-rotation", "--log-denies",
     ])
     rt = Runtime(args)
     rt.args.metrics_backend = "none"
@@ -375,6 +375,66 @@ def test_cert_rotation_injects_vwh(runtime):
                    "gatekeeper-validating-webhook-configuration")
     bundles = [w["clientConfig"].get("caBundle") for w in vwh["webhooks"]]
     assert all(bundles)
+
+
+def test_vwh_recreate_reinjects_ca_bundle(runtime):
+    """ReconcileVWH analog (reference certs.go:454-530): a VWH recreated
+    between 12-hour refresh ticks must get the caBundle re-injected by
+    the watch-driven reconciler, not wait for the next tick."""
+    import tempfile
+
+    kube = runtime.kube
+    vwh_gvk = ("admissionregistration.k8s.io", "v1beta1",
+               "ValidatingWebhookConfiguration")
+    vwh = {
+        "apiVersion": "admissionregistration.k8s.io/v1beta1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {"name": "gatekeeper-validating-webhook-configuration"},
+        "webhooks": [{"name": "validation.gatekeeper.sh",
+                      "clientConfig": {"service": {"name": "gk"}}}],
+    }
+    kube.create(json.loads(json.dumps(vwh)))
+    with tempfile.TemporaryDirectory() as td:
+        rotator = CertRotator(kube, td)
+        rotator.refresh_certs()
+        assert kube.get(vwh_gvk, vwh["metadata"]["name"])["webhooks"][0][
+            "clientConfig"].get("caBundle")
+        rotator.start_reconciler(runtime.manager.wm)
+        try:
+            # recreate the VWH with no bundle; the reconciler must
+            # restore it without any timer tick
+            kube.delete(vwh_gvk, vwh["metadata"]["name"])
+            kube.create(json.loads(json.dumps(vwh)))
+            deadline = time.time() + 5
+            bundle = None
+            while time.time() < deadline:
+                cur = kube.get(vwh_gvk, vwh["metadata"]["name"])
+                bundle = cur["webhooks"][0]["clientConfig"].get("caBundle")
+                if bundle:
+                    break
+                time.sleep(0.02)
+            assert bundle, "caBundle not re-injected on VWH recreate"
+
+            # secret deleted: reconciler regenerates and re-injects
+            kube.delete(("", "v1", "Secret"),
+                        "gatekeeper-webhook-server-cert",
+                        "gatekeeper-system")
+            deadline = time.time() + 5
+            ok = False
+            while time.time() < deadline:
+                try:
+                    sec = kube.get(("", "v1", "Secret"),
+                                   "gatekeeper-webhook-server-cert",
+                                   "gatekeeper-system")
+                except NotFound:
+                    time.sleep(0.02)
+                    continue
+                if (sec.get("data") or {}).get("tls.crt"):
+                    ok = True
+                    break
+            assert ok, "secret not regenerated after delete"
+        finally:
+            rotator.stop()
 
 
 def test_watch_manager_refcounting():

@@ -57,8 +57,8 @@ def rt():
     cluster for the whole bats run."""
     args = build_parser().parse_args([
         "--fake-kube", "--port", "0", "--prometheus-port", "0",
-        "--disable-cert-rotation", "--exempt-namespace",
-        "gatekeeper-system",
+        "--health-addr", ":0", "--disable-cert-rotation",
+        "--exempt-namespace", "gatekeeper-system",
     ])
     runtime = Runtime(args)
     runtime.args.metrics_backend = "none"
@@ -217,3 +217,18 @@ def test_12_deleting_constraint_stops_enforcement(rt):
     rt.manager.drain()
     assert admit(rt, load("bad_resources/namespace.yaml"))["allowed"] \
         is True
+
+
+def test_13_health_endpoints(rt):
+    """healthz/readyz on --health-addr (reference main.go:205-212)."""
+    assert rt.health is not None, "--health-addr must serve"
+    conn = http.client.HTTPConnection("127.0.0.1", rt.health.port,
+                                      timeout=10)
+    conn.request("GET", "/healthz")
+    resp = conn.getresponse()
+    assert resp.status == 200 and resp.read() == b"ok"
+    conn.request("GET", "/readyz")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    conn.request("GET", "/nosuch")
+    assert conn.getresponse().status == 404
