@@ -268,14 +268,20 @@ BUILTINS: dict[tuple, Any] = {
 # the OPA v0.2x surface k8s policies most commonly reach for.
 
 
-def _bi_json_marshal(v):
+def _canon_json(v) -> str:
+    """The one canonical JSON serialization (json.marshal, JWT signing
+    payloads, http.send bodies) — a single definition so OPA-parity
+    tweaks to number/key rendering can never diverge between them."""
     import json as _json
 
     from ..utils.values import thaw
 
+    return _json.dumps(thaw(v), sort_keys=True, separators=(",", ":"))
+
+
+def _bi_json_marshal(v):
     try:
-        return _json.dumps(thaw(v), sort_keys=True,
-                           separators=(",", ":"))
+        return _canon_json(v)
     except (TypeError, ValueError) as e:
         raise BuiltinError(f"json.marshal: {e}") from None
 
@@ -738,11 +744,17 @@ def _bi_parse_rfc3339_ns(s):
     return int(dt.timestamp()) * 10**9 + frac_ns
 
 
-def _ns_to_dt(ns) -> "_dt.datetime":
-    # integer split: float division of ~1e18 ns loses sub-us precision
+def _ns_split(ns) -> tuple["_dt.datetime", int]:
+    """(civil datetime of the whole seconds, sub-second ns) — carrying
+    the remainder separately keeps builtins nanosecond-exact (OPA's
+    topdown is; rounding through datetime.microsecond loses sub-us)."""
     s, rem = divmod(int(_need_num(ns, "time")), 10**9)
-    return _dt.datetime.fromtimestamp(s, tz=_dt.timezone.utc).replace(
-        microsecond=rem // 1000)
+    return _dt.datetime.fromtimestamp(s, tz=_dt.timezone.utc), rem
+
+
+def _ns_to_dt(ns) -> "_dt.datetime":
+    d, rem = _ns_split(ns)
+    return d.replace(microsecond=rem // 1000)
 
 
 def _bi_time_date(ns):
@@ -760,7 +772,7 @@ def _bi_time_weekday(ns):
 
 
 def _bi_time_add_date(ns, years, months, days):
-    d = _ns_to_dt(ns)
+    d, sub_ns = _ns_split(ns)
     y = int(_need_num(years, "time.add_date"))
     mo = int(_need_num(months, "time.add_date"))
     dd = int(_need_num(days, "time.add_date"))
@@ -770,9 +782,10 @@ def _bi_time_add_date(ns, years, months, days):
     # Go's AddDate normalizes out-of-range days by rolling over
     day = d.day
     base = _dt.datetime(year, month, 1, d.hour, d.minute, d.second,
-                        d.microsecond, tzinfo=_dt.timezone.utc)
+                        tzinfo=_dt.timezone.utc)
     out = base + _dt.timedelta(days=day - 1 + dd)
-    return int(out.timestamp()) * 10**9 + out.microsecond * 1000
+    # the sub-second ns ride through untouched (ns-exact like topdown)
+    return int(out.timestamp()) * 10**9 + sub_ns
 
 
 _UNITS = {"": 1, "k": 10**3, "m": 10**6, "g": 10**9, "t": 10**12,
@@ -1282,3 +1295,907 @@ BUILTINS.update({
     ("json", "patch"): _bi_json_patch,
     ("time", "diff"): _bi_time_diff,
 })
+
+
+# --------------------------------------------------------------- round 5
+# The builtin tail to OPA parity (reference vendor/.../topdown/
+# {crypto,tokens,time,cidr,regex,http}.go): x509/jwt asymmetric
+# verification, Go-layout time parsing/formatting, the cidr tail, regex
+# template/glob matching, gated http.send, and the named forms of the
+# infix operators (callable in OPA: plus(1, 2, x)).
+
+
+# offset-token render kinds: how Go prints the zone for each layout token
+_TZ_TOKENS = [("Z07:00", "zcolon"), ("Z0700", "znum"),
+              ("-07:00", "colon"), ("-0700", "num"), ("-07", "hour")]
+
+# format-mode placeholders: strftime passes unknown bytes through, so
+# fraction/offset render manually afterwards (ns-exact, Go-style)
+_FRAC_MARK = "\x01"
+_TZ_MARK = "\x02"
+
+
+def _go_layout_convert(layout: str, fn: str, formatting: bool):
+    """Go reference-time layout -> strftime/strptime format.
+
+    Parse mode: offset tokens map to %z, fraction runs are dropped
+    (the caller extracts fractional digits from the value for ns
+    exactness). Format mode: fraction and offset become placeholder
+    marks rendered manually by _bi_time_format. Returns
+    (fmt, fraction (char, width) or None, tz_kind or None)."""
+    tokens = [
+        ("2006", "%Y"), ("January", "%B"), ("Monday", "%A"),
+        ("Jan", "%b"), ("Mon", "%a"), ("15", "%H"), ("01", "%m"),
+        ("02", "%d"), ("03", "%I"), ("04", "%M"), ("05", "%S"),
+        ("06", "%y"), ("PM", "%p"), ("pm", "%p"), ("MST", "%Z"),
+    ]
+    out = []
+    i = 0
+    fraction = None
+    tz_kind = None
+    n = len(layout)
+    while i < n:
+        if layout[i] == "." and i + 1 < n and layout[i + 1] in "09":
+            c = layout[i + 1]
+            j = i + 1
+            while j < n and layout[j] == c:
+                j += 1
+            fraction = (c, j - i - 1)
+            if formatting:
+                out.append(_FRAC_MARK)
+            i = j
+            continue
+        matched = False
+        for tok, kind in _TZ_TOKENS:
+            if layout.startswith(tok, i):
+                tz_kind = kind
+                out.append(_TZ_MARK if formatting else "%z")
+                i += len(tok)
+                matched = True
+                break
+        if matched:
+            continue
+        for tok, fmt in tokens:
+            if layout.startswith(tok, i):
+                out.append(fmt)
+                i += len(tok)
+                break
+        else:
+            ch = layout[i]
+            out.append("%%" if ch == "%" else ch)
+            i += 1
+    return "".join(out), fraction, tz_kind
+
+
+def _bi_time_parse_ns(layout, value):
+    """Go time.Parse semantics for the common layout tokens
+    (topdown/time.go builtinParseNanos); ns-exact."""
+    lay = _need_str(layout, "time.parse_ns")
+    v = _need_str(value, "time.parse_ns")
+    fmt, fraction, _tz = _go_layout_convert(lay, "time.parse_ns",
+                                            formatting=False)
+    frac_ns = 0
+    if fraction is not None:
+        fm = _FRAC_RE.search(v)
+        if fm:
+            digits = fm.group(1)[:9]
+            frac_ns = int(digits.ljust(9, "0"))
+            v = v[: fm.start()] + v[fm.end():]
+    try:
+        d = _dt.datetime.strptime(v, fmt)
+    except ValueError as e:
+        raise BuiltinError(f"time.parse_ns: {e}") from None
+    if d.tzinfo is None:
+        d = d.replace(tzinfo=_dt.timezone.utc)
+    return int(d.timestamp()) * 10**9 + frac_ns
+
+
+_DUR_RE = re.compile(r"([0-9]*\.?[0-9]+)(ns|us|µs|μs|ms|s|m|h)")
+_DUR_NS = {"ns": 1, "us": 10**3, "µs": 10**3, "μs": 10**3,
+           "ms": 10**6, "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9}
+
+
+def _bi_time_parse_duration_ns(s):
+    """Go time.ParseDuration ("1h30m", "-2.5s", ...) -> ns."""
+    v = _need_str(s, "time.parse_duration_ns").strip()
+    sign = 1
+    if v.startswith(("-", "+")):
+        sign = -1 if v[0] == "-" else 1
+        v = v[1:]
+    if v == "0":
+        return 0
+    total = 0
+    pos = 0
+    for m in _DUR_RE.finditer(v):
+        if m.start() != pos:
+            raise BuiltinError(
+                f"time.parse_duration_ns: invalid duration {s!r}")
+        total += int(float(m.group(1)) * _DUR_NS[m.group(2)])
+        pos = m.end()
+    if pos != len(v) or pos == 0:
+        raise BuiltinError(f"time.parse_duration_ns: invalid duration {s!r}")
+    return sign * total
+
+
+def _bi_time_format(x):
+    """ns | [ns, tz] | [ns, tz, go-layout] -> formatted string
+    (modern-OPA time.format; the vendored version predates it)."""
+    lay = "2006-01-02T15:04:05Z07:00"  # RFC3339
+    tz = "UTC"
+    if isinstance(x, tuple):
+        if not x:
+            raise BuiltinError("time.format: empty array")
+        ns = x[0]
+        if len(x) > 1:
+            tz = _need_str(x[1], "time.format") or "UTC"
+        if len(x) > 2:
+            lay = _need_str(x[2], "time.format")
+    else:
+        ns = x
+    d, sub = _ns_split(ns)
+    if tz not in ("UTC", ""):
+        if tz == "Local":
+            d = d.astimezone()
+        else:
+            try:
+                import zoneinfo
+                d = d.astimezone(zoneinfo.ZoneInfo(tz))
+            except Exception as e:
+                raise BuiltinError(f"time.format: {e}") from None
+    fmt, fraction, tz_kind = _go_layout_convert(lay, "time.format",
+                                                formatting=True)
+    out = d.strftime(fmt)
+    if fraction is not None:
+        c, width = fraction
+        if c == "0":  # fixed width, trailing zeros kept
+            frac = "." + f"{sub:09d}"[:width].ljust(width, "0")
+        else:  # '9': trailing zeros (and a bare '.') dropped
+            frac = ("." + f"{sub:09d}"[:width]).rstrip("0").rstrip(".")
+        out = out.replace(_FRAC_MARK, frac)
+    if tz_kind is not None:
+        off = d.utcoffset() or _dt.timedelta(0)
+        total = int(off.total_seconds())
+        sign = "-" if total < 0 else "+"
+        hh, mm = divmod(abs(total) // 60, 60)
+        if tz_kind in ("zcolon", "znum") and total == 0:
+            zs = "Z"
+        elif tz_kind in ("zcolon", "colon"):
+            zs = f"{sign}{hh:02d}:{mm:02d}"
+        elif tz_kind == "hour":
+            zs = f"{sign}{hh:02d}"
+        else:
+            zs = f"{sign}{hh:02d}{mm:02d}"
+        out = out.replace(_TZ_MARK, zs)
+    return out
+
+
+def _bi_cidr_expand(cidr):
+    try:
+        net = _ipaddress.ip_network(_need_str(cidr, "net.cidr_expand"),
+                                    strict=False)
+    except ValueError as e:
+        raise BuiltinError(f"net.cidr_expand: {e}") from None
+    if net.num_addresses > (1 << 20):
+        raise BuiltinError(
+            f"net.cidr_expand: {cidr} expands to {net.num_addresses} "
+            "addresses (limit 2^20)")
+    return frozenset(str(ip) for ip in net)
+
+
+def _bi_cidr_merge(addrs):
+    nets4, nets6 = [], []
+    for a in _iterable(addrs, "net.cidr_merge"):
+        n = _net(a, "net.cidr_merge")
+        (nets4 if n.version == 4 else nets6).append(n)
+    out = []
+    for group in (nets4, nets6):
+        out.extend(_ipaddress.collapse_addresses(group))
+    return frozenset(str(n) for n in out)
+
+
+def _cidr_contains_pair(cidr, x, fn):
+    a = _net(cidr, fn)
+    b = _net(x, fn)
+    if a.version != b.version:
+        return False
+    return b.network_address >= a.network_address and \
+        b.broadcast_address <= a.broadcast_address
+
+
+def _cidr_match_iter(operand, v, fn):
+    """(cidr, index) pairs per topdown/cidr.go
+    evalNetCIDRContainsMatchesOperand: string -> itself; array -> first
+    element of each entry, integer index; set -> member as index;
+    object -> value's cidr, key as index."""
+    def term(x):
+        if isinstance(x, str):
+            return x
+        if isinstance(x, tuple) and x:
+            return x[0]
+        raise BuiltinError(
+            f"{fn}: operand {operand}: element must be string or "
+            "non-empty array")
+
+    if isinstance(v, str):
+        yield v, v
+    elif isinstance(v, tuple):
+        for i, x in enumerate(v):
+            yield term(x), i
+    elif isinstance(v, frozenset):
+        for x in sorted(v, key=sort_key):
+            yield term(x), x
+    elif isinstance(v, FrozenDict):
+        for k, x in v.items():
+            yield term(x), k
+    else:
+        raise BuiltinError(f"{fn}: operand {operand} must be "
+                           "string/array/set/object")
+
+
+def _bi_cidr_contains_matches(cidrs, xs):
+    fn = "net.cidr_contains_matches"
+    out = set()
+    for cidr, i1 in _cidr_match_iter(1, cidrs, fn):
+        for x, i2 in _cidr_match_iter(2, xs, fn):
+            if _cidr_contains_pair(cidr, x, fn):
+                out.add((i1, i2))
+    return frozenset(out)
+
+
+def _bi_regex_template_match(template, value, start, end):
+    """Gorilla-mux template matching (topdown/regex_template.go):
+    text outside single-char delimiters is literal, inside is regex;
+    the assembled pattern is anchored both ends."""
+    fn = "regex.template_match"
+    tpl = _need_str(template, fn)
+    v = _need_str(value, fn)
+    ds = _need_str(start, fn)
+    de = _need_str(end, fn)
+    if len(ds) != 1 or len(de) != 1:
+        raise BuiltinError(f"{fn}: delimiters must be exactly one "
+                           "character")
+    level, idx = 0, 0
+    idxs = []
+    for i, ch in enumerate(tpl):
+        if ch == ds:
+            level += 1
+            if level == 1:
+                idx = i
+        elif ch == de:
+            level -= 1
+            if level == 0:
+                idxs.append((idx, i + 1))
+            elif level < 0:
+                raise BuiltinError(f"{fn}: unbalanced braces in {tpl!r}")
+    if level != 0:
+        raise BuiltinError(f"{fn}: unbalanced braces in {tpl!r}")
+    pattern = ["^"]
+    endpos = 0
+    for (a, b) in idxs:
+        pattern.append(re.escape(tpl[endpos:a]))
+        pattern.append("(" + tpl[a + 1: b - 1] + ")")
+        endpos = b
+    pattern.append(re.escape(tpl[endpos:]))
+    pattern.append("$")
+    try:
+        return bool(compiled_regex("".join(pattern)).search(v))
+    except re.error as e:
+        raise BuiltinError(f"{fn}: {e}") from None
+
+
+def _bi_regex_find_all_string_submatch_n(pattern, s, n):
+    fn = "regex.find_all_string_submatch_n"
+    pat = _need_str(pattern, fn)
+    v = _need_str(s, fn)
+    limit = int(_need_num(n, fn))
+    try:
+        rx = compiled_regex(pat)
+    except re.error as e:
+        raise BuiltinError(f"{fn}: {e}") from None
+    out = []
+    for m in rx.finditer(v):
+        if 0 <= limit <= len(out):
+            break
+        out.append((m.group(0),)
+                   + tuple(g if g is not None else "" for g in m.groups()))
+    return tuple(out)
+
+
+# ---- glob-intersection (regex.globs_match, yashtewari/gintersect port)
+
+def _glob_tokens(s: str, fn: str) -> list:
+    """Parse the glob-regex subset (literals, '.', char classes, and
+    * / + / ? quantifiers) into (ranges, quantifier) tokens, where
+    ranges is a sorted tuple of (lo, hi) codepoint spans."""
+    toks = []
+    i, n = 0, len(s)
+    FULL = ((0, 0x10FFFF),)
+    while i < n:
+        ch = s[i]
+        if ch == ".":
+            ranges = FULL
+            i += 1
+        elif ch == "[":
+            j = i + 1
+            neg = j < n and s[j] == "^"
+            if neg:
+                j += 1
+            spans = []
+            while j < n and s[j] != "]":
+                if j + 2 < n and s[j + 1] == "-" and s[j + 2] != "]":
+                    spans.append((ord(s[j]), ord(s[j + 2])))
+                    j += 3
+                else:
+                    if s[j] == "\\" and j + 1 < n:
+                        j += 1
+                    spans.append((ord(s[j]), ord(s[j])))
+                    j += 1
+            if j >= n:
+                raise BuiltinError(f"{fn}: unterminated class in {s!r}")
+            spans.sort()
+            if neg:
+                inv, lo = [], 0
+                for a, b in spans:
+                    if a > lo:
+                        inv.append((lo, a - 1))
+                    lo = max(lo, b + 1)
+                if lo <= 0x10FFFF:
+                    inv.append((lo, 0x10FFFF))
+                spans = inv
+            ranges = tuple(spans)
+            i = j + 1
+        elif ch == "\\" and i + 1 < n:
+            ranges = ((ord(s[i + 1]), ord(s[i + 1])),)
+            i += 2
+        elif ch in "*+?":
+            raise BuiltinError(f"{fn}: dangling quantifier in {s!r}")
+        else:
+            ranges = ((ord(ch), ord(ch)),)
+            i += 1
+        quant = ""
+        if i < n and s[i] in "*+?":
+            quant = s[i]
+            i += 1
+        toks.append((ranges, quant))
+    return toks
+
+
+def _glob_nfa(toks):
+    """Thompson construction: returns (transitions, accept_state) where
+    transitions[state] = [(ranges, next_state)], plus epsilon moves
+    encoded via state skipping: state i sits before token i."""
+    # state i = position before token i; accept = len(toks)
+    eps = {i: set() for i in range(len(toks) + 1)}
+    for i, (_r, q) in enumerate(toks):
+        if q in ("*", "?"):
+            eps[i].add(i + 1)  # skip
+    trans = {}
+    for i, (r, q) in enumerate(toks):
+        # consuming r moves past the token; * and + allow staying
+        dests = {i + 1}
+        if q in ("*", "+"):
+            dests.add(i)
+        trans[i] = [(r, d) for d in sorted(dests)]
+    return eps, trans, len(toks)
+
+
+def _eps_close(states, eps):
+    out = set(states)
+    stack = list(states)
+    while stack:
+        s = stack.pop()
+        for t in eps.get(s, ()):
+            if t not in out:
+                out.add(t)
+                stack.append(t)
+    return out
+
+
+def _ranges_intersect(a, b) -> bool:
+    for lo1, hi1 in a:
+        for lo2, hi2 in b:
+            if lo1 <= hi2 and lo2 <= hi1:
+                return True
+    return False
+
+
+def _bi_regex_globs_match(a, b):
+    """True iff the two glob-style regexes can match a COMMON string
+    (OPA regex.globs_match via yashtewari/glob-intersection): product
+    NFA reachability over intersectable character ranges."""
+    fn = "regex.globs_match"
+    ta = _glob_tokens(_need_str(a, fn), fn)
+    tb = _glob_tokens(_need_str(b, fn), fn)
+    eps_a, trans_a, acc_a = _glob_nfa(ta)
+    eps_b, trans_b, acc_b = _glob_nfa(tb)
+    start = (frozenset(_eps_close({0}, eps_a)),
+             frozenset(_eps_close({0}, eps_b)))
+    seen = {start}
+    stack = [start]
+    while stack:
+        sa, sb = stack.pop()
+        if acc_a in sa and acc_b in sb:
+            return True
+        # all (range_a, range_b) co-steps with non-empty intersection
+        moves_a = [(r, d) for s in sa for (r, d) in trans_a.get(s, ())]
+        moves_b = [(r, d) for s in sb for (r, d) in trans_b.get(s, ())]
+        for ra, da in moves_a:
+            na = frozenset(_eps_close({da}, eps_a))
+            for rb, db in moves_b:
+                if not _ranges_intersect(ra, rb):
+                    continue
+                nxt = (na, frozenset(_eps_close({db}, eps_b)))
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+    return False
+
+
+def _bi_glob_quote_meta(s):
+    """Escape glob metacharacters (Go gobwas/glob QuoteMeta)."""
+    out = []
+    for ch in _need_str(s, "glob.quote_meta"):
+        if ch in r"*?\[]{},!":
+            out.append("\\")
+        out.append(ch)
+    return "".join(out)
+
+
+# ------------------------------------------------- x509 / JWT (crypto)
+
+def _load_certs(s: str, fn: str) -> list:
+    """PEM chain or base64-DER (OPA crypto.x509.parse_certificates
+    accepts both; topdown/crypto.go)."""
+    from cryptography import x509 as _x509
+
+    certs = []
+    if "-----BEGIN" in s:
+        blocks = re.findall(
+            r"-----BEGIN CERTIFICATE-----.*?-----END CERTIFICATE-----",
+            s, re.S)
+        if not blocks:
+            raise BuiltinError(f"{fn}: no PEM certificates found")
+        for b in blocks:
+            try:
+                certs.append(_x509.load_pem_x509_certificate(b.encode()))
+            except ValueError as e:
+                raise BuiltinError(f"{fn}: {e}") from None
+    else:
+        try:
+            der = _base64.b64decode(s)
+        except (_binascii.Error, ValueError) as e:
+            raise BuiltinError(f"{fn}: {e}") from None
+        from cryptography.hazmat.primitives.serialization import Encoding
+
+        # base64 input may hold one DER cert or a concatenated chain
+        while der:
+            try:
+                cert = _x509.load_der_x509_certificate(der)
+            except ValueError as e:
+                raise BuiltinError(f"{fn}: {e}") from None
+            certs.append(cert)
+            der = der[len(cert.public_bytes(Encoding.DER)):]
+    return certs
+
+
+def _name_dict(name) -> "FrozenDict":
+    from cryptography.x509.oid import NameOID
+
+    fields = {
+        NameOID.COMMON_NAME: "CommonName",
+        NameOID.ORGANIZATION_NAME: "Organization",
+        NameOID.ORGANIZATIONAL_UNIT_NAME: "OrganizationalUnit",
+        NameOID.COUNTRY_NAME: "Country",
+        NameOID.LOCALITY_NAME: "Locality",
+        NameOID.STATE_OR_PROVINCE_NAME: "Province",
+    }
+    out: dict = {}
+    for attr in name:
+        key = fields.get(attr.oid)
+        if key == "CommonName":
+            out[key] = attr.value
+        elif key is not None:
+            out.setdefault(key, []).append(attr.value)
+    return freeze(out)
+
+
+def _bi_x509_parse_certificates(s):
+    """Array of certificate objects with the Go x509.Certificate JSON
+    field names the library surface uses (Subject/Issuer/NotBefore/
+    NotAfter/DNSNames/IsCA/SerialNumber/Version); not the full Go
+    struct marshal."""
+    fn = "crypto.x509.parse_certificates"
+    from cryptography import x509 as _x509
+
+    out = []
+    for cert in _load_certs(_need_str(s, fn), fn):
+        dns_names: list = []
+        is_ca = False
+        try:
+            san = cert.extensions.get_extension_for_class(
+                _x509.SubjectAlternativeName)
+            dns_names = san.value.get_values_for_type(_x509.DNSName)
+        except _x509.ExtensionNotFound:
+            pass
+        try:
+            bc = cert.extensions.get_extension_for_class(
+                _x509.BasicConstraints)
+            is_ca = bool(bc.value.ca)
+        except _x509.ExtensionNotFound:
+            pass
+        out.append(freeze({
+            "Version": cert.version.value + 1,
+            "SerialNumber": str(cert.serial_number),
+            "Subject": thaw(_name_dict(cert.subject)),
+            "Issuer": thaw(_name_dict(cert.issuer)),
+            "NotBefore": cert.not_valid_before_utc.strftime(
+                "%Y-%m-%dT%H:%M:%SZ"),
+            "NotAfter": cert.not_valid_after_utc.strftime(
+                "%Y-%m-%dT%H:%M:%SZ"),
+            "DNSNames": dns_names,
+            "IsCA": is_ca,
+        }))
+    return tuple(out)
+
+
+def _jwt_pubkey(cert_or_key: str, fn: str):
+    """PEM certificate or PEM public key -> public key object."""
+    from cryptography import x509 as _x509
+    from cryptography.hazmat.primitives import serialization
+
+    data = cert_or_key.encode()
+    if "CERTIFICATE" in cert_or_key:
+        try:
+            return _x509.load_pem_x509_certificate(data).public_key()
+        except ValueError as e:
+            raise BuiltinError(f"{fn}: {e}") from None
+    try:
+        return serialization.load_pem_public_key(data)
+    except ValueError as e:
+        raise BuiltinError(f"{fn}: {e}") from None
+
+
+def _jwt_verify_asym(token, cert, algo: str) -> bool:
+    fn = f"io.jwt.verify_{algo.lower()}"
+    parts = _need_str(token, fn).split(".")
+    if len(parts) != 3:
+        return False
+    key = _jwt_pubkey(_need_str(cert, fn), fn)
+    signed = f"{parts[0]}.{parts[1]}".encode()
+    sig = _b64url_decode_pad(parts[2], fn)
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import (
+        ec, padding, utils as asym_utils)
+
+    try:
+        if algo == "RS256":
+            key.verify(sig, signed, padding.PKCS1v15(), hashes.SHA256())
+        elif algo == "PS256":
+            key.verify(sig, signed,
+                       padding.PSS(mgf=padding.MGF1(hashes.SHA256()),
+                                   salt_length=hashes.SHA256.digest_size),
+                       hashes.SHA256())
+        elif algo == "ES256":
+            # JOSE: raw r||s (two 32-byte ints) -> DER for cryptography
+            if len(sig) != 64:
+                return False
+            r = int.from_bytes(sig[:32], "big")
+            s_ = int.from_bytes(sig[32:], "big")
+            der = asym_utils.encode_dss_signature(r, s_)
+            key.verify(der, signed, ec.ECDSA(hashes.SHA256()))
+        else:
+            raise BuiltinError(f"{fn}: unsupported algorithm")
+        return True
+    except InvalidSignature:
+        return False
+    except BuiltinError:
+        raise
+    except Exception:
+        return False
+
+
+def _bi_jwt_decode_verify(token, constraints):
+    """[valid, header, payload] with signature + claim checks
+    (topdown/tokens.go builtinJWTDecodeVerify: cert or secret, alg pin,
+    iss/aud, exp/nbf against `time` or now)."""
+    fn = "io.jwt.decode_verify"
+    _need(constraints, "object", fn)
+    try:
+        header, payload, _sig = _bi_jwt_decode(token)
+    except BuiltinError:
+        return (False, FrozenDict(), FrozenDict())
+    alg = header.get("alg")
+    want_alg = constraints.get("alg")
+    if want_alg is not None and alg != want_alg:
+        return (False, FrozenDict(), FrozenDict())
+    ok = False
+    if alg == "HS256" and "secret" in constraints:
+        ok = _bi_jwt_verify_hs256(token, constraints["secret"])
+    elif alg in ("RS256", "PS256", "ES256") and "cert" in constraints:
+        ok = _jwt_verify_asym(token, constraints["cert"], alg)
+    if not ok:
+        return (False, FrozenDict(), FrozenDict())
+    now_ns = constraints.get("time", int(_time.time() * 1e9))
+    now_s = _need_num(now_ns, fn) / 1e9
+    exp = payload.get("exp")
+    if exp is not None and now_s >= _need_num(exp, fn):
+        return (False, FrozenDict(), FrozenDict())
+    nbf = payload.get("nbf")
+    if nbf is not None and now_s < _need_num(nbf, fn):
+        return (False, FrozenDict(), FrozenDict())
+    iss = constraints.get("iss")
+    if iss is not None and payload.get("iss") != iss:
+        return (False, FrozenDict(), FrozenDict())
+    aud = constraints.get("aud")
+    if aud is not None:
+        have = payload.get("aud")
+        have_set = set(have) if isinstance(have, tuple) else {have}
+        if aud not in have_set:
+            return (False, FrozenDict(), FrozenDict())
+    elif payload.get("aud") is not None:
+        # token carries an audience the caller did not constrain: reject
+        # (topdown/tokens.go validAudience)
+        return (False, FrozenDict(), FrozenDict())
+    return (True, header, payload)
+
+
+def _b64url_nopad(b: bytes) -> str:
+    return _base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+def _jwk_sign(alg: str, key, signed: bytes, fn: str) -> bytes:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import (
+        ec, padding, rsa, utils as asym_utils)
+
+    _need(key, "object", fn)
+    kty = key.get("kty")
+    if alg in ("HS256", "HS384", "HS512"):
+        if kty != "oct":
+            raise BuiltinError(f"{fn}: {alg} needs an oct key")
+        secret = _b64url_decode_pad(_need_str(key.get("k"), fn), fn)
+        digest = {"HS256": _hashlib.sha256, "HS384": _hashlib.sha384,
+                  "HS512": _hashlib.sha512}[alg]
+        return _hmac_mod.new(secret, signed, digest).digest()
+
+    def _i(name):
+        v = key.get(name)
+        if v is None:
+            raise BuiltinError(f"{fn}: JWK missing {name!r}")
+        return int.from_bytes(_b64url_decode_pad(_need_str(v, fn), fn),
+                              "big")
+
+    if alg == "RS256":
+        if kty != "RSA":
+            raise BuiltinError(f"{fn}: RS256 needs an RSA key")
+        pub = rsa.RSAPublicNumbers(_i("e"), _i("n"))
+        priv = rsa.RSAPrivateNumbers(
+            p=_i("p"), q=_i("q"), d=_i("d"), dmp1=_i("dp"), dmq1=_i("dq"),
+            iqmp=_i("qi"), public_numbers=pub).private_key()
+        return priv.sign(signed, padding.PKCS1v15(), hashes.SHA256())
+    if alg == "ES256":
+        if kty != "EC":
+            raise BuiltinError(f"{fn}: ES256 needs an EC key")
+        priv = ec.derive_private_key(_i("d"), ec.SECP256R1())
+        der = priv.sign(signed, ec.ECDSA(hashes.SHA256()))
+        r, s_ = asym_utils.decode_dss_signature(der)
+        return r.to_bytes(32, "big") + s_.to_bytes(32, "big")
+    raise BuiltinError(f"{fn}: unsupported algorithm {alg!r}")
+
+
+def _bi_jwt_encode_sign(headers, payload, key):
+    """Signed JWS from object headers/payload + JWK (topdown/tokens.go
+    builtinJWTEncodeSign; HS*/RS256/ES256)."""
+    fn = "io.jwt.encode_sign"
+    _need(headers, "object", fn)
+    _need(payload, "object", fn)
+    alg = headers.get("alg")
+    if not isinstance(alg, str):
+        raise BuiltinError(f"{fn}: headers must carry a string alg")
+    h = _b64url_nopad(_canon_json(headers).encode())
+    p = _b64url_nopad(_canon_json(payload).encode())
+    signed = f"{h}.{p}".encode()
+    sig = _jwk_sign(alg, key, signed, fn)
+    return f"{h}.{p}.{_b64url_nopad(sig)}"
+
+
+def _bi_jwt_encode_sign_raw(headers, payload, key):
+    """Like encode_sign but headers/payload/key arrive as JSON strings
+    (topdown/tokens.go builtinJWTEncodeSignRaw)."""
+    fn = "io.jwt.encode_sign_raw"
+    try:
+        hdr = freeze(json.loads(_need_str(headers, fn)))
+        key_obj = freeze(json.loads(_need_str(key, fn)))
+        json.loads(_need_str(payload, fn))  # must be valid JSON
+    except ValueError as e:
+        raise BuiltinError(f"{fn}: {e}") from None
+    _need(hdr, "object", fn)
+    alg = hdr.get("alg")
+    if not isinstance(alg, str):
+        raise BuiltinError(f"{fn}: headers must carry a string alg")
+    h = _b64url_nopad(_need_str(headers, fn).encode())
+    p = _b64url_nopad(_need_str(payload, fn).encode())
+    signed = f"{h}.{p}".encode()
+    sig = _jwk_sign(alg, key_obj, signed, fn)
+    return f"{h}.{p}.{_b64url_nopad(sig)}"
+
+
+# ----------------------------------------------------- gated http.send
+
+def _bi_http_send(req):
+    """Outbound HTTP from policy (topdown/http.go). DISABLED unless
+    GATEKEEPER_TPU_ENABLE_HTTP_SEND=1: admission policies phoning out
+    add unbounded tail latency and an exfiltration channel, so the gate
+    is explicit and the error says exactly how to open it."""
+    import os as _os
+
+    fn = "http.send"
+    _need(req, "object", fn)
+    if _os.environ.get("GATEKEEPER_TPU_ENABLE_HTTP_SEND") != "1":
+        raise BuiltinError(
+            f"{fn}: disabled (set GATEKEEPER_TPU_ENABLE_HTTP_SEND=1 to "
+            "allow outbound HTTP from policies)")
+    import urllib.error
+    import urllib.request
+
+    method = _need_str(req.get("method", "GET"), fn).upper()
+    url = _need_str(req.get("url", ""), fn)
+    if not url.startswith(("http://", "https://")):
+        raise BuiltinError(f"{fn}: unsupported url {url!r}")
+    body = None
+    if "body" in req:
+        body = _canon_json(req["body"]).encode()
+    elif "raw_body" in req:
+        body = _need_str(req["raw_body"], fn).encode()
+    headers = {str(k): str(v)
+               for k, v in (req.get("headers") or FrozenDict()).items()}
+    timeout = _need_num(req.get("timeout", 5), fn)
+    r = urllib.request.Request(url, data=body, headers=headers,
+                               method=method)
+    try:
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            raw = resp.read().decode("utf-8", "replace")
+            status = resp.status
+            resp_headers = {k.lower(): v for k, v in resp.headers.items()}
+    except urllib.error.HTTPError as e:
+        raw = e.read().decode("utf-8", "replace")
+        status = e.code
+        resp_headers = {k.lower(): v for k, v in e.headers.items()}
+    except (urllib.error.URLError, OSError) as e:
+        if req.get("raise_error", True):
+            raise BuiltinError(f"{fn}: {e}") from None
+        return freeze({"status_code": 0, "error": str(e)})
+    out = {"status_code": status, "raw_body": raw,
+           "headers": resp_headers}
+    try:
+        out["body"] = json.loads(raw)
+    except ValueError:
+        out["body"] = None
+    return freeze(out)
+
+
+# ------------------------------------------------------- small parity
+
+def _bi_opa_runtime():
+    """Deployment environment view (topdown/runtime.go): env + version.
+    Commonly used to read env-injected configuration in policies."""
+    import os as _os
+
+    return freeze({"env": dict(_os.environ),
+                   "version": "gatekeeper-tpu"})
+
+
+def _bi_rego_parse_module(filename, src):
+    """Parse rego source and return an AST summary (package path + rule
+    names/kinds). OPA returns its own Go AST JSON marshal; this is the
+    native AST's summary — documented divergence, same use cases
+    (introspecting a module's shape from policy)."""
+    fn = "rego.parse_module"
+    from .parser import ParseError, parse_module as _parse
+
+    try:
+        mod = _parse(_need_str(src, fn), _need_str(filename, fn))
+    except ParseError as e:
+        raise BuiltinError(f"{fn}: {e}") from None
+    return freeze({
+        "package": {"path": ["data"] + list(mod.package)},
+        "rules": [{"name": r.name, "kind": r.kind,
+                   "default": bool(getattr(r, "is_default", False))}
+                  for r in mod.rules],
+    })
+
+
+def _bi_minus(a, b):
+    # '-' doubles as set difference (named form of the infix operator)
+    if isinstance(a, frozenset) and isinstance(b, frozenset):
+        return a - b
+    return _need_num(a, "minus") - _need_num(b, "minus")
+
+
+def _bi_div(a, b):
+    d = _need_num(b, "div")
+    if d == 0:
+        raise BuiltinError("div: divide by zero")
+    out = _need_num(a, "div") / d
+    return int(out) if float(out).is_integer() else out
+
+
+def _bi_rem(a, b):
+    x, y = _need_num(a, "rem"), _need_num(b, "rem")
+    if y == 0:
+        raise BuiltinError("rem: modulo by zero")
+    if not (float(x).is_integer() and float(y).is_integer()):
+        raise BuiltinError("rem: modulo on floating-point number")
+    return int(_math.fmod(int(x), int(y)))
+
+
+def _bi_set_diff(a, b):
+    _need(a, "set", "set_diff")
+    _need(b, "set", "set_diff")
+    return a - b
+
+
+def _bi_set_and(a, b):
+    _need(a, "set", "and")
+    _need(b, "set", "and")
+    return a & b
+
+
+def _bi_set_or(a, b):
+    _need(a, "set", "or")
+    _need(b, "set", "or")
+    return a | b
+
+
+BUILTINS.update({
+    ("time", "parse_ns"): _bi_time_parse_ns,
+    ("time", "parse_duration_ns"): _bi_time_parse_duration_ns,
+    ("time", "format"): _bi_time_format,
+    ("net", "cidr_expand"): _bi_cidr_expand,
+    ("net", "cidr_merge"): _bi_cidr_merge,
+    ("net", "cidr_contains_matches"): _bi_cidr_contains_matches,
+    ("net", "cidr_overlap"): lambda c, x: _cidr_contains_pair(
+        c, x, "net.cidr_overlap"),  # deprecated alias of cidr_contains
+    ("regex", "template_match"): _bi_regex_template_match,
+    ("regex", "globs_match"): _bi_regex_globs_match,
+    ("regex", "find_all_string_submatch_n"):
+        _bi_regex_find_all_string_submatch_n,
+    ("glob", "quote_meta"): _bi_glob_quote_meta,
+    ("crypto", "x509", "parse_certificates"): _bi_x509_parse_certificates,
+    ("io", "jwt", "verify_rs256"): lambda t, c: _jwt_verify_asym(
+        t, c, "RS256"),
+    ("io", "jwt", "verify_ps256"): lambda t, c: _jwt_verify_asym(
+        t, c, "PS256"),
+    ("io", "jwt", "verify_es256"): lambda t, c: _jwt_verify_asym(
+        t, c, "ES256"),
+    ("io", "jwt", "decode_verify"): _bi_jwt_decode_verify,
+    ("io", "jwt", "encode_sign"): _bi_jwt_encode_sign,
+    ("io", "jwt", "encode_sign_raw"): _bi_jwt_encode_sign_raw,
+    ("http", "send"): _bi_http_send,
+    ("opa", "runtime"): _bi_opa_runtime,
+    ("rego", "parse_module"): _bi_rego_parse_module,
+    ("set_diff",): _bi_set_diff,
+    ("cast_null",): lambda v: _need(v, "null", "cast_null"),
+    ("cast_object",): lambda v: _need(v, "object", "cast_object"),
+    ("cast_set",): lambda v: _need(v, "set", "cast_set"),
+    # named forms of the infix operators (callable in OPA)
+    ("plus",): lambda a, b: _need_num(a, "plus") + _need_num(b, "plus"),
+    ("minus",): _bi_minus,
+    ("mul",): lambda a, b: _need_num(a, "mul") * _need_num(b, "mul"),
+    ("div",): _bi_div,
+    ("rem",): _bi_rem,
+    ("eq",): rego_eq,
+    ("gt",): lambda a, b: sort_key(a) > sort_key(b),
+    ("gte",): lambda a, b: sort_key(a) >= sort_key(b),
+    ("lt",): lambda a, b: sort_key(a) < sort_key(b),
+    ("lte",): lambda a, b: sort_key(a) <= sort_key(b),
+    ("and",): _bi_set_and,
+    ("or",): _bi_set_or,
+})
+
+# decode_verify consults the wall clock when no "time" constraint is
+# given: memoizing it would freeze token validity across requests (an
+# expired JWT would keep admitting workloads)
+NONDETERMINISTIC.update({("http", "send"), ("opa", "runtime"),
+                         ("io", "jwt", "decode_verify")})

@@ -484,3 +484,162 @@ forged[p] {
     assert out == [{"sub": "me", "admin": True}]
     out2 = interp.eval_rule(("jwt",), "forged", {"review": {"token": token}})
     assert out2 is UNDEF or thaw(out2) == []
+
+
+def test_breadth_builtins_round5():
+    """Round-5 builtin tail (crypto.x509/io.jwt asymmetric/time parse+
+    format/cidr tail/regex tail/named operators) through actual rego;
+    interpreter AND codegen must agree; literal expecteds pin OPA
+    semantics."""
+    src = '''
+package b6
+
+out[x] {
+  x := {
+    "pns": time.parse_ns("2006-01-02 15:04:05", "2020-05-01 10:30:00"),
+    "dur": time.parse_duration_ns("1h30m"),
+    "fmt": time.format([1588328999000000000, "UTC",
+                        "2006-01-02T15:04:05Z07:00"]),
+    "expand": net.cidr_expand("10.0.0.0/30"),
+    "merged": net.cidr_merge(["10.0.0.0/25", "10.0.0.128/25"]),
+    "cidrmatch": net.cidr_contains_matches(["10.0.0.0/8", "1.1.1.0/24"],
+                                           ["10.2.3.4", "8.8.8.8"]),
+    "overlap": net.cidr_overlap("10.0.0.0/8", "10.1.1.1"),
+    "tmpl": [regex.template_match("urn:foo:{.*}", "urn:foo:bar:baz",
+                                  "{", "}"),
+             regex.template_match("urn:foo:{[0-9]+}", "urn:foo:abc",
+                                  "{", "}")],
+    "globs": [regex.globs_match("a.b[0-9]*", "a.b3"),
+              regex.globs_match("abc*", "xyz")],
+    "fasn": regex.find_all_string_submatch_n("a(b+)", "abbabbb", -1),
+    "quote": glob.quote_meta("*.github.com"),
+    "ops": [plus(1, 2), minus(5, 3), mul(3, 4), div(8, 2), rem(7, 3),
+            minus({1, 2, 3}, {2}), and({1, 2}, {2, 3}), or({1}, {2})],
+    "cmp": [lt(1, 2), gt("b", "a"), lte(1, 1), gte(1, 2), eq(3, 3),
+            lt(1, "a")],
+    "sdiff": set_diff({1, 2}, {1}),
+    "casts": [cast_null(null), cast_object({"a": 1}), cast_set({1})],
+    "parsed": rego.parse_module("m.rego", "package p\\nq[x] { x := 1 }"),
+  }
+}
+
+gated[m] {
+  not http.send({"method": "GET", "url": "http://127.0.0.1:1/x"})
+  m := "http.send undefined while gated"
+}
+'''
+    module = parse_module(src)
+    interp = Interpreter({"m": module})
+    out = interp.eval_rule(("b6",), "out", {})
+    assert out is not UNDEF
+    from gatekeeper_tpu.rego.codegen import compile_module
+    from gatekeeper_tpu.utils.values import freeze
+    fn = compile_module(module, entry="out")
+    assert fn.__input_call__(freeze({}), freeze({})) == out
+    got = thaw(list(out)[0])
+    assert got["pns"] == 1588329000000000000
+    assert got["dur"] == 5400 * 10**9
+    assert got["fmt"] == "2020-05-01T10:29:59Z"
+    assert sorted(got["expand"]) == ["10.0.0.0", "10.0.0.1", "10.0.0.2",
+                                     "10.0.0.3"]
+    assert got["merged"] == ["10.0.0.0/24"]
+    assert got["cidrmatch"] == [[0, 0]]
+    assert got["overlap"] is True
+    assert got["tmpl"] == [True, False]
+    assert got["globs"] == [True, False]
+    assert got["fasn"] == [["abb", "bb"], ["abbb", "bbb"]]
+    assert got["quote"] == "\\*.github.com"
+    assert got["ops"] == [3, 2, 12, 4, 1, [1, 3], [2], [1, 2]]
+    assert got["cmp"] == [True, True, True, False, True, True]
+    assert got["sdiff"] == [2]
+    assert got["casts"] == [None, {"a": 1}, [1]]
+    assert got["parsed"]["package"]["path"] == ["data", "p"]
+    assert got["parsed"]["rules"][0]["name"] == "q"
+    # http.send is gated off by default: the call is undefined, `not`
+    # succeeds (interpreter and codegen agree)
+    gated = interp.eval_rule(("b6",), "gated", {})
+    assert thaw(gated) == ["http.send undefined while gated"]
+    g2 = compile_module(module, entry="gated")
+    assert g2.__input_call__(freeze({}), freeze({})) == gated
+
+
+def test_x509_and_asymmetric_jwt_in_rego():
+    """x509 parse + RS256/ES256 verification exercised rego-level with
+    real keys, through interpreter and codegen."""
+    import base64 as b64
+
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    from gatekeeper_tpu.control.certs import (
+        _pem_cert,
+        generate_ca,
+        generate_server_cert,
+    )
+
+    ca_key, ca_cert = generate_ca()
+    _, cert = generate_server_cert(ca_key, ca_cert, ["web.prod.svc"])
+    chain_pem = _pem_cert(cert).decode() + _pem_cert(ca_cert).decode()
+
+    priv = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    nums = priv.private_numbers()
+
+    def b64i(i):
+        bs = i.to_bytes((i.bit_length() + 7) // 8 or 1, "big")
+        return b64.urlsafe_b64encode(bs).decode().rstrip("=")
+
+    jwk = {"kty": "RSA", "n": b64i(nums.public_numbers.n),
+           "e": b64i(nums.public_numbers.e), "d": b64i(nums.d),
+           "p": b64i(nums.p), "q": b64i(nums.q), "dp": b64i(nums.dmp1),
+           "dq": b64i(nums.dmq1), "qi": b64i(nums.iqmp)}
+    pub_pem = priv.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo).decode()
+
+    src = '''
+package x509jwt
+
+certnames[n] {
+  certs := crypto.x509.parse_certificates(input.review.chain)
+  n := certs[_].Subject.CommonName
+}
+
+ca_count = n {
+  certs := crypto.x509.parse_certificates(input.review.chain)
+  n := count([c | c := certs[_]; c.IsCA])
+}
+
+token = t {
+  t := io.jwt.encode_sign({"alg": "RS256"}, {"iss": "tester"},
+                          input.review.jwk)
+}
+
+verified = v {
+  t := io.jwt.encode_sign({"alg": "RS256"}, {"iss": "tester"},
+                          input.review.jwk)
+  v := io.jwt.verify_rs256(t, input.review.pub)
+}
+
+checked = out {
+  t := io.jwt.encode_sign({"alg": "RS256"}, {"iss": "tester"},
+                          input.review.jwk)
+  out := io.jwt.decode_verify(t, {"cert": input.review.pub,
+                                  "iss": "tester"})
+}
+'''
+    module = parse_module(src)
+    interp = Interpreter({"m": module})
+    inp = {"review": {"chain": chain_pem, "jwk": jwk, "pub": pub_pem}}
+    names = thaw(interp.eval_rule(("x509jwt",), "certnames", inp))
+    assert sorted(names) == ["gatekeeper-ca", "web.prod.svc"]
+    assert thaw(interp.eval_rule(("x509jwt",), "ca_count", inp)) == 1
+    assert thaw(interp.eval_rule(("x509jwt",), "verified", inp)) is True
+    ok, _hdr, payload = thaw(interp.eval_rule(("x509jwt",), "checked", inp))
+    assert ok is True and payload["iss"] == "tester"
+    # codegen agreement on the full set
+    from gatekeeper_tpu.rego.codegen import compile_module
+    from gatekeeper_tpu.utils.values import freeze
+    for entry in ("certnames", "ca_count", "verified", "checked"):
+        fn = compile_module(module, entry=entry)
+        assert fn.__input_call__(freeze(inp), freeze({})) == \
+            interp.eval_rule(("x509jwt",), entry, inp), entry
