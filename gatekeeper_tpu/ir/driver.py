@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import re
+import threading
 from typing import Any, Iterable, Optional
 
 import numpy as np
@@ -210,6 +211,22 @@ class TpuDriver(RegoDriver):
         # more than one device is visible. GATEKEEPER_TPU_MESH=off
         # disables; =<n> caps the data-axis width
         self._mesh = self._build_mesh(mesh)
+        # async device warm-up: the FIRST audit at a new sweep shape
+        # serves from the host path while a background thread runs the
+        # device sweep once (XLA compile ~10-90s cold at audit scale —
+        # the reference ingests templates in milliseconds, so template-
+        # to-first-verdict must not block on the compiler); once warm,
+        # audits hot-swap to the device. GATEKEEPER_TPU_ASYNC_COMPILE=0
+        # restores compile-blocking dispatch (tests pin paths with it)
+        import os as _os
+
+        self.async_warm = _os.environ.get(
+            "GATEKEEPER_TPU_ASYNC_COMPILE", "1") != "0"
+        self._warm_done: set = set()
+        self._warm_inflight: set = set()
+        self._warm_fail: dict = {}               # sig -> failure count
+        self._warm_lock = threading.Lock()       # guards the warm sets
+        self._warm_sem = threading.Semaphore(1)  # one compile at a time
         # sharded/replicated device placements for the mesh sweep,
         # keyed (id(leaf), data-leading?) with the _dev weakref pattern
         self._dev_mesh_cache: dict = {}
@@ -267,6 +284,7 @@ class TpuDriver(RegoDriver):
         self._join_progs.pop(kind, None)
         self._join_compiled.pop(kind, None)
         self._join_frz[2].pop(kind, None)  # template update: stale keys
+        self._drop_warm(kind)  # new CompiledTemplate = cold jit caches
         module = mods[0] if len(mods) == 1 else merge_template_modules(mods)
         if module is None:
             self._compiled[kind] = None
@@ -294,7 +312,18 @@ class TpuDriver(RegoDriver):
             self._join_progs.pop(m.group(2), None)
             self._join_compiled.pop(m.group(2), None)
             self._join_frz[2].pop(m.group(2), None)
+            self._drop_warm(m.group(2))
         return n
+
+    def _drop_warm(self, kind: str) -> None:
+        """Template update/delete: a fresh CompiledTemplate starts with
+        empty jit caches, so its sweep shapes are NOT warm even when
+        the tensor shapes match a previous generation's signature."""
+        with self._warm_lock:
+            self._warm_done = {s for s in self._warm_done
+                               if s[0] != kind}
+            self._warm_fail = {s: c for s, c in self._warm_fail.items()
+                               if s[0] != kind}
 
     def compiled_for(self, kind: str) -> Optional[CompiledTemplate]:
         """Lazily wrap the Program in a device evaluator, registering its
@@ -456,6 +485,11 @@ class TpuDriver(RegoDriver):
     def _eval_audit(self, target: str, trace: Optional[list]) -> list[Result]:
         constraints = self._constraints(target)
         self._audit_used_mesh = False
+        # one latency sample per audit, from the FIRST consumed kind:
+        # later kinds' dispatch->consume gaps include earlier kinds'
+        # host materialization (the pipeline window), which would
+        # overstate device latency and bias the cost model to the host
+        self._lat_sampled = False
         if not constraints:
             return []
         lookup_ns = self._namespace_lookup(target)
@@ -541,13 +575,107 @@ class TpuDriver(RegoDriver):
 
         return _bucket(n_reviews) % self._mesh.shape["data"] == 0
 
+    @staticmethod
+    def _sweep_slab(n_true: int, chunk: int = 8192) -> int:
+        half = (n_true + 1) // 2
+        return max(chunk * 4, ((half + chunk - 1) // chunk) * chunk)
+
+    def _sweep_sig(self, kind, feats, enc, table, derived, n_true,
+                   use_mesh) -> tuple:
+        """Shape signature of one sweep's jit cache keys: a device
+        program is "warm" once these exact shapes executed. The slab
+        (derived from n_true) is a STATIC jit key on the single-device
+        path — two sweeps in the same feature bucket but different
+        slabs compile different programs."""
+        def shapes(tree):
+            out = []
+            if isinstance(tree, dict):
+                for k in sorted(tree):
+                    out.append((k, shapes(tree[k])))
+                return tuple(out)
+            return tuple(getattr(tree, "shape", ()))
+        slab = 0 if use_mesh else self._sweep_slab(n_true)
+        return (kind, use_mesh, slab, shapes(feats), shapes(enc),
+                tuple(getattr(table, "shape", ())), shapes(derived))
+
+    def _dispatch_handle(self, ct, feats, enc, table, derived, n_true,
+                         use_mesh, chunk=8192):
+        if use_mesh:
+            return ct.fires_pairs_mesh_dispatch(
+                feats, enc, table, self._mesh, derived, chunk=chunk,
+                n_true=n_true)
+        return ct.fires_pairs_dispatch(feats, enc, table, derived,
+                                       chunk=chunk,
+                                       slab=self._sweep_slab(n_true, chunk),
+                                       n_true=n_true)
+
+    def _spawn_warm(self, sig, kind, ct, feats, enc, table, derived,
+                    n_true, use_mesh) -> None:
+        """Run the device sweep once in the background so its jit caches
+        populate off the serving path; results are discarded (the
+        foreground already answered from the host path this round)."""
+        with self._warm_lock:
+            if sig in self._warm_inflight or sig in self._warm_done:
+                return
+            self._warm_inflight.add(sig)
+
+        def run():
+            import time as _time
+
+            t0 = _time.time()
+            try:
+                with self._warm_sem:
+                    handle = self._dispatch_handle(ct, feats, enc, table,
+                                                   derived, n_true,
+                                                   use_mesh)
+                    for _ in handle.pairs():
+                        pass
+                with self._warm_lock:
+                    self._warm_done.add(sig)
+                log.info("device program for %s warm after %.1fs "
+                         "(mesh=%s); next audit hot-swaps off the host "
+                         "path", kind, _time.time() - t0, use_mesh)
+            except Exception as e:
+                # do NOT demote from here: the warm sweep runs
+                # concurrently with foreground device work, so a
+                # transient resource failure may be contention the
+                # serving path would never see. Retry once; after that,
+                # mark warm so the FOREGROUND dispatch surfaces the
+                # real error through its own demotion path.
+                with self._warm_lock:
+                    n_fail = self._warm_fail.get(sig, 0) + 1
+                    self._warm_fail[sig] = n_fail
+                    if n_fail >= 2:
+                        self._warm_done.add(sig)
+                log.warning(
+                    "background warm sweep for %s failed (attempt %d)"
+                    "%s: %s: %s", kind, n_fail,
+                    "; next audit dispatches in the foreground"
+                    if n_fail >= 2 else "; will retry",
+                    type(e).__name__, e)
+            finally:
+                with self._warm_lock:
+                    self._warm_inflight.discard(sig)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"warm-{kind}").start()
+
+    def warm_status(self) -> dict:
+        """Observability: how many device programs are warm/in-flight
+        (bench.py reports it alongside which path served)."""
+        with self._warm_lock:
+            return {"warm": len(self._warm_done),
+                    "compiling": len(self._warm_inflight)}
+
     def _audit_dispatch(self, target, kind, ct, cons, reviews, lookup_ns,
                         sig_cache):
         """Phase 1 for one compiled kind: mask, feature prep, and ASYNC
         device dispatch of every slab — SPMD over the mesh's data axis
         when one is available and the sweep is large enough, else the
-        single-device slab pipeline. Returns consume state, or None
-        after a demotion (caller falls back to the interpreter)."""
+        single-device slab pipeline. A sweep shape that has never
+        executed serves from the host path while a background thread
+        warms the device program (XLA compile must not stall the
+        audit). Returns consume state, or None for the host path."""
         try:
             mask = self._match_mask(target, kind, cons, reviews, lookup_ns,
                                     sig_cache)
@@ -567,21 +695,23 @@ class TpuDriver(RegoDriver):
                 ct, kind, cand_reviews, cons, feat_key, cand=cand,
                 target=target, mesh=use_mesh)
             c_dev = _param_c(enc)
-            chunk = 8192
+            if self.async_warm:
+                sig = self._sweep_sig(kind, feats, enc, table, derived,
+                                      len(cand_reviews), use_mesh)
+                with self._warm_lock:
+                    warm = sig in self._warm_done
+                if not warm:
+                    self._spawn_warm(sig, kind, ct, feats, enc, table,
+                                     derived, len(cand_reviews), use_mesh)
+                    return None  # host path serves this audit
+            import time as _time
+
+            handle = self._dispatch_handle(ct, feats, enc, table, derived,
+                                           len(cand_reviews), use_mesh)
             if use_mesh:
-                handle = ct.fires_pairs_mesh_dispatch(
-                    feats, enc, table, self._mesh, derived, chunk=chunk,
-                    n_true=len(cand_reviews))
                 self._audit_used_mesh = True
-            else:
-                half = (len(cand_reviews) + 1) // 2
-                slab = max(chunk * 4,
-                           ((half + chunk - 1) // chunk) * chunk)
-                handle = ct.fires_pairs_dispatch(feats, enc, table,
-                                                 derived, chunk=chunk,
-                                                 slab=slab,
-                                                 n_true=len(cand_reviews))
-            return ("h", mask, cand, cand_reviews, handle, c_dev)
+            return ("h", mask, cand, cand_reviews, handle, c_dev,
+                    _time.time())
         except DriverError:
             raise
         except Exception as e:
@@ -594,20 +724,25 @@ class TpuDriver(RegoDriver):
         """Phase 2: sync the dispatched slabs in order, materialize."""
         if st[0] == "empty":
             return []
-        _tag, mask, cand, cand_reviews, handle, c_dev = st
+        _tag, mask, cand, cand_reviews, handle, c_dev, t_dispatch = st
         import time as _time
 
         out: list[Result] = []
-        first_sync = _time.time()
+        first_sync = True
         try:
             for rows, cols in handle.pairs():
-                if first_sync is not None:
-                    # dispatch->first-result latency: the audit-side
-                    # sample of the device cost EMA (review_batch
-                    # supplies the webhook-side samples)
-                    self._observe("_dev_batch_lat_s",
-                                  _time.time() - first_sync)
-                    first_sync = None
+                if first_sync:
+                    # DISPATCH->first-result latency, sampled only for
+                    # the audit's first consumed kind (later kinds'
+                    # gaps include earlier kinds' host materialization
+                    # under the pipeline window; measuring from consume
+                    # time instead understated it — both biases skew
+                    # _use_device_for_batch)
+                    if not getattr(self, "_lat_sampled", True):
+                        self._lat_sampled = True
+                        self._observe("_dev_batch_lat_s",
+                                      _time.time() - t_dispatch)
+                    first_sync = False
                 rows, cols = _expand_parameterless(rows, cols, c_dev,
                                                    len(cons))
                 keep = mask[cand[rows], cols]

@@ -23,6 +23,10 @@ os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+# deterministic dispatch in tests: the first device sweep compiles
+# inline instead of warming in the background (dedicated async tests
+# flip driver.async_warm back on)
+os.environ.setdefault("GATEKEEPER_TPU_ASYNC_COMPILE", "0")
 
 # a sitecustomize hook (PYTHONPATH site injection) may have imported jax at
 # interpreter startup and captured JAX_PLATFORMS from the outer environment

@@ -391,3 +391,62 @@ violation[{"msg": "bad value"}] {
         "computed-key bracket must device-compile"
     assert names(rc.audit().results()) == names(tc.audit().results()) == \
         ["wrong"]
+
+
+def test_async_warm_serves_host_then_hot_swaps():
+    """Async device compile: the first audit at a new sweep shape must
+    return CORRECT results immediately from the host path while the
+    device program warms in the background; once warm, the same audit
+    takes the device path and agrees exactly."""
+    import time
+
+    from gatekeeper_tpu import policies
+    from gatekeeper_tpu.client import Backend, RegoDriver
+    from gatekeeper_tpu.ir import TpuDriver
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    def load(client, n=600):
+        client.add_template(policies.load("general/requiredlabels"))
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K8sRequiredLabels", "metadata": {"name": "owner"},
+            "spec": {"parameters": {"labels": [{"key": "owner"}]}}})
+        for i in range(n):
+            o = {"apiVersion": "v1", "kind": "Namespace",
+                 "metadata": {"name": f"ns{i:04d}"}}
+            if i % 2 == 0:
+                o["metadata"]["labels"] = {"owner": "me"}
+            client.add_data(o)
+
+    drv = TpuDriver()
+    drv._mesh = None
+    drv.async_warm = True
+    drv._dev_batch_lat_s = 1e-4  # cost model would pick the device
+    client = Backend(drv).new_client([K8sValidationTarget()])
+    load(client)
+
+    ref = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    load(ref)
+    want = sorted((r.msg, r.resource["metadata"]["name"])
+                  for r in ref.audit().results())
+
+    # first audit: host path (warm kicked off in the background)
+    got1 = sorted((r.msg, r.resource["metadata"]["name"])
+                  for r in client.audit().results())
+    assert got1 == want and len(want) == 300
+    st = drv.warm_status()
+    assert st["warm"] + st["compiling"] >= 1, "no warm-up was started"
+
+    # wait for the hot-swap, then the device path must serve and agree
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if drv.warm_status()["warm"] >= 1:
+            break
+        time.sleep(0.05)
+    assert drv.warm_status()["warm"] >= 1, "device program never warmed"
+    got2 = sorted((r.msg, r.resource["metadata"]["name"])
+                  for r in client.audit().results())
+    assert got2 == want
+    # non-vacuous: the device consume path updates the latency EMA,
+    # proving the post-warm audit actually ran on the device
+    assert drv._dev_batch_lat_s != 1e-4, "post-warm audit stayed on host"
