@@ -19,6 +19,8 @@ import os
 import ssl
 import threading
 import time
+import urllib.error
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
@@ -27,7 +29,15 @@ GVK = tuple  # (group, version, kind)
 
 
 class KubeError(Exception):
-    pass
+    """code carries the HTTP status when one applies (str(e) stays the
+    plain message — log call sites render details=str(e))."""
+
+    def __init__(self, message: str = "", code: Optional[int] = None):
+        super().__init__(message)
+        self.code = code
+
+    def __str__(self) -> str:
+        return self.args[0] if self.args else ""
 
 
 class Conflict(KubeError):
@@ -224,7 +234,18 @@ class RestKubeClient:
 
     def __init__(self, base_url: Optional[str] = None,
                  token: Optional[str] = None,
-                 ca_file: Optional[str] = None):
+                 ca_file: Optional[str] = None,
+                 kubeconfig: Optional[str] = None):
+        client_cert: Optional[tuple] = None
+        if base_url is None and token is None:
+            # out-of-cluster: honor an explicit kubeconfig (or
+            # $KUBECONFIG / ~/.kube/config) when no in-cluster SA exists
+            cfg = self._load_kubeconfig(kubeconfig)
+            if cfg is not None:
+                base_url = cfg.get("server")
+                token = cfg.get("token")
+                ca_file = ca_file or cfg.get("ca_file")
+                client_cert = cfg.get("client_cert")
         host = os.environ.get("KUBERNETES_SERVICE_HOST")
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         self.base_url = base_url or (f"https://{host}:{port}" if host else
@@ -240,10 +261,76 @@ class RestKubeClient:
         else:
             ctx.check_hostname = False
             ctx.verify_mode = ssl.CERT_NONE
+        if client_cert is not None:
+            ctx.load_cert_chain(*client_cert)
         self._ssl = ctx
         self._plurals: dict[GVK, tuple[str, bool]] = {}
 
-    def _request(self, method: str, path: str, body: Any = None) -> Any:
+    @staticmethod
+    def _load_kubeconfig(path: Optional[str]) -> Optional[dict]:
+        """Minimal kubeconfig reader: current-context's cluster server,
+        CA, and user token/client-cert. Inline *-data fields are
+        written to temp files (ssl wants paths)."""
+        import base64
+        import tempfile
+
+        path = path or os.environ.get("KUBECONFIG") or \
+            os.path.expanduser("~/.kube/config")
+        if not os.path.exists(path):
+            return None
+        try:
+            import yaml
+
+            with open(path) as f:
+                cfg = yaml.safe_load(f) or {}
+        except Exception:
+            return None
+
+        def by_name(section, name):
+            for e in cfg.get(section) or []:
+                if e.get("name") == name:
+                    return e.get(section[:-1]) or {}
+            return {}
+
+        def materialize(data_key, file_key, src):
+            if src.get(file_key):
+                return src[file_key]
+            if src.get(data_key):
+                import atexit
+
+                f = tempfile.NamedTemporaryFile(delete=False,
+                                                suffix=".pem")
+                f.write(base64.b64decode(src[data_key]))
+                f.close()
+                # key material at 0600, removed on exit
+                os.chmod(f.name, 0o600)
+                atexit.register(lambda p=f.name:
+                                os.path.exists(p) and os.unlink(p))
+                return f.name
+            return None
+
+        try:
+            ctx_name = cfg.get("current-context")
+            ctx = by_name("contexts", ctx_name)
+            cluster = by_name("clusters", ctx.get("cluster"))
+            user = by_name("users", ctx.get("user"))
+            out: dict = {"server": cluster.get("server")}
+            out["ca_file"] = materialize("certificate-authority-data",
+                                         "certificate-authority", cluster)
+            out["token"] = user.get("token")
+            cert = materialize("client-certificate-data",
+                               "client-certificate", user)
+            key = materialize("client-key-data", "client-key", user)
+            if cert and key:
+                out["client_cert"] = (cert, key)
+        except Exception:
+            # an unreadable/corrupt kubeconfig falls back to in-cluster
+            # defaults, it must not crash startup
+            return None
+        return out if out.get("server") else None
+
+    def _open(self, method: str, path: str, body: Any = None,
+              timeout: float = 30):
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
@@ -252,16 +339,33 @@ class RestKubeClient:
             req.add_header("Content-Type", "application/json")
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
-        try:
-            with urllib.request.urlopen(req, context=self._ssl,
-                                        timeout=30) as resp:
-                return json.loads(resp.read() or b"null")
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                raise NotFound(path) from None
-            if e.code == 409:
-                raise Conflict(path) from None
-            raise KubeError(f"{method} {path}: HTTP {e.code}") from None
+        return urllib.request.urlopen(req, context=self._ssl,
+                                      timeout=timeout)
+
+    def _request(self, method: str, path: str, body: Any = None) -> Any:
+        # GETs are idempotent: retry transient failures with backoff
+        # (client-go's default behavior; a blip must not fail a sweep)
+        attempts = 3 if method == "GET" else 1
+        for attempt in range(attempts):
+            try:
+                with self._open(method, path, body) as resp:
+                    return json.loads(resp.read() or b"null")
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    raise NotFound(path) from None
+                if e.code == 409:
+                    raise Conflict(path) from None
+                if e.code in (429, 500, 502, 503, 504) and \
+                        attempt + 1 < attempts:
+                    time.sleep(0.2 * (2 ** attempt))
+                    continue
+                raise KubeError(f"{method} {path}: HTTP {e.code}",
+                                e.code) from None
+            except OSError as e:
+                if attempt + 1 < attempts:
+                    time.sleep(0.2 * (2 ** attempt))
+                    continue
+                raise KubeError(f"{method} {path}: {e}") from None
 
     def _resource_path(self, gvk: GVK, namespace: str = "") -> str:
         group, version, kind = gvk
@@ -344,9 +448,9 @@ class RestKubeClient:
         self._request(
             "DELETE", f"{self._resource_path(gvk, namespace)}/{name}")
 
-    def list(self, gvk: GVK, namespace: Optional[str] = None) -> list[dict]:
-        rl = self._request("GET", self._resource_path(gvk, namespace or ""))
-        items = rl.get("items") or []
+    LIST_PAGE_LIMIT = 500
+
+    def _fill_gvk(self, items: list[dict], gvk: GVK) -> list[dict]:
         group, version, kind = gvk
         api_version = version if not group else f"{group}/{version}"
         for it in items:
@@ -354,37 +458,161 @@ class RestKubeClient:
             it.setdefault("kind", kind)
         return items
 
+    def _list_paged(self, gvk: GVK,
+                    namespace: str = "") -> tuple[list[dict], str]:
+        """Chunked list (?limit + continue tokens) -> (items, list
+        resourceVersion) — one giant unpaged list response can stall
+        the apiserver on big clusters."""
+        base = self._resource_path(gvk, namespace)
+        items: list[dict] = []
+        cont = ""
+        rv = ""
+        while True:
+            q = f"?limit={self.LIST_PAGE_LIMIT}"
+            if cont:
+                q += f"&continue={urllib.parse.quote(cont)}"
+            rl = self._request("GET", base + q)
+            items.extend(rl.get("items") or [])
+            meta = rl.get("metadata") or {}
+            rv = meta.get("resourceVersion") or rv
+            cont = meta.get("continue") or ""
+            if not cont:
+                break
+        return self._fill_gvk(items, gvk), rv
+
+    def list(self, gvk: GVK, namespace: Optional[str] = None) -> list[dict]:
+        items, _rv = self._list_paged(gvk, namespace or "")
+        return items
+
+    # streamed watches ride long-lived chunked responses; the read
+    # timeout must exceed the server's timeoutSeconds or healthy idle
+    # streams get cut mid-wait
+    WATCH_TIMEOUT_S = 300
+
     def watch(self, gvk: GVK, callback, send_initial: bool = True):
-        """Poll-based watch fallback: list on an interval and diff.
-        Real streaming watch is a future optimization."""
+        """Streaming watch (?watch=1&resourceVersion=...) with bookmark
+        handling and backoff-relist on 410 Gone — client-go informer
+        semantics (the dynamiccache fork's underlying ListerWatcher).
+        Falls back to poll-and-diff when the server cannot stream
+        (e.g. a stub without watch support)."""
         stop = threading.Event()
 
-        def loop():
-            # key -> (resourceVersion, last object) so DELETED events carry
-            # the full identity (reconcilers read kind/apiVersion from it)
-            known: dict[tuple, tuple] = {}
-            first = True
+        def relist(known: dict, first: bool) -> tuple[dict, str]:
+            """Sync state from a fresh list: emit the diff, return the
+            new known-map and the list resourceVersion to stream from."""
+            items, rv = self._list_paged(gvk)
+            seen = {}
+            for o in items:
+                k = _key(o)
+                orv = (o.get("metadata") or {}).get("resourceVersion")
+                seen[k] = (orv, o)
+                if k not in known:
+                    if not first or send_initial:
+                        callback(WatchEvent("ADDED", o))
+                elif known[k][0] != orv:
+                    callback(WatchEvent("MODIFIED", o))
+            for k in set(known) - set(seen):
+                callback(WatchEvent("DELETED", known[k][1]))
+            return seen, rv
+
+        def stream(known: dict, rv: str) -> tuple[dict, str, bool]:
+            """One watch connection; returns (known, rv, gone) where
+            gone=True means the RV expired (410) and a relist is due."""
+            base = self._resource_path(gvk)
+            q = (f"?watch=1&allowWatchBookmarks=true"
+                 f"&timeoutSeconds={self.WATCH_TIMEOUT_S - 30}"
+                 f"&resourceVersion={urllib.parse.quote(rv)}")
+            group, version, kind = gvk
+            api_version = version if not group else f"{group}/{version}"
+            with self._open("GET", base + q,
+                            timeout=self.WATCH_TIMEOUT_S) as resp:
+                for line in resp:
+                    if stop.is_set():
+                        return known, rv, False
+                    line = line.strip()
+                    if not line:
+                        continue
+                    ev = json.loads(line)
+                    etype = ev.get("type")
+                    obj = ev.get("object") or {}
+                    if etype == "BOOKMARK":
+                        rv = (obj.get("metadata") or {}).get(
+                            "resourceVersion") or rv
+                        continue
+                    if etype == "ERROR":
+                        if (obj.get("code") == 410
+                                or "too old" in str(obj.get("message"))):
+                            return known, rv, True
+                        raise KubeError(f"watch {gvk}: {obj}")
+                    if etype not in ("ADDED", "MODIFIED", "DELETED"):
+                        # a server that ignored ?watch=1 (or a corrupt
+                        # stream) must resync, not emit junk events
+                        raise KubeError(f"watch {gvk}: unexpected "
+                                        f"frame {ev!r}")
+                    obj.setdefault("apiVersion", api_version)
+                    obj.setdefault("kind", kind)
+                    k = _key(obj)
+                    orv = (obj.get("metadata") or {}).get(
+                        "resourceVersion")
+                    if etype == "DELETED":
+                        known.pop(k, None)
+                    else:
+                        known[k] = (orv, obj)
+                    rv = orv or rv
+                    callback(WatchEvent(etype, obj))
+            return known, rv, False  # clean server-side timeout close
+
+        def poll_loop(known: dict, first: bool):
+            """2s list-and-diff, continuing from the streamed state —
+            restarting from empty would duplicate ADDED events and
+            never emit DELETED for objects lost in the gap."""
             while not stop.is_set():
                 try:
-                    objs = self.list(gvk)
+                    known, _rv = relist(known, first)
+                    first = False
                 except KubeError:
-                    time.sleep(2)
-                    continue
-                seen = {}
-                for o in objs:
-                    k = _key(o)
-                    rv = (o.get("metadata") or {}).get("resourceVersion")
-                    seen[k] = (rv, o)
-                    if k not in known:
-                        if not first or send_initial:
-                            callback(WatchEvent("ADDED", o))
-                    elif known[k][0] != rv:
-                        callback(WatchEvent("MODIFIED", o))
-                for k in set(known) - set(seen):
-                    callback(WatchEvent("DELETED", known[k][1]))
-                known = seen
-                first = False
+                    pass
                 stop.wait(2.0)
+
+        def loop():
+            known: dict = {}
+            first = True
+            rv = ""
+            need_relist = True
+            backoff = 0.5
+            bad_frames = 0
+            while not stop.is_set():
+                try:
+                    if need_relist:
+                        known, rv = relist(known, first)
+                        first = False
+                        need_relist = False
+                    known, rv, gone = stream(known, rv)
+                    backoff = 0.5
+                    bad_frames = 0
+                    if gone:
+                        need_relist = True  # RV expired: resync
+                except urllib.error.HTTPError as e:
+                    if e.code in (400, 405, 501):
+                        # server cannot stream: degrade to polling
+                        poll_loop(known, first)
+                        return
+                    need_relist = True
+                    stop.wait(backoff)
+                    backoff = min(backoff * 2, 30)
+                except (KubeError, OSError, ValueError) as e:
+                    if isinstance(e, KubeError) and \
+                            "unexpected frame" in str(e):
+                        # a server answering ?watch=1 with plain lists
+                        # can never stream: after a few tries, poll at
+                        # the 2s cadence instead of error-backoff
+                        bad_frames += 1
+                        if bad_frames >= 3:
+                            poll_loop(known, first)
+                            return
+                    need_relist = True
+                    stop.wait(backoff)
+                    backoff = min(backoff * 2, 30)
 
         t = threading.Thread(target=loop, daemon=True)
         t.start()
