@@ -78,6 +78,7 @@ class _Worker:
         self.name = name
         self.registrar = registrar
         self.handle = handle
+        self.in_flight = False
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name=f"ctrl-{name}", daemon=True)
@@ -107,11 +108,17 @@ class _Worker:
                 event = self.registrar.events.get(timeout=0.1)
             except Exception:
                 continue
+            # in_flight bridges the gap between "queue empty" and
+            # "handler finished" so drain() cannot return while a
+            # reconcile is mid-write (a sleep there was a flaky race)
+            self.in_flight = True
             try:
                 self.handle(event)
             except Exception as e:  # reconcile must never die
                 log.error(f"{self.name}: reconcile error: {e}",
                           event_type=event.type)
+            finally:
+                self.in_flight = False
 
 
 # ------------------------------------------------------------------ template
@@ -444,14 +451,24 @@ class ControllerManager:
         self.config_ctrl.start()
 
     def drain(self, timeout: float = 10.0) -> None:
-        """Wait until all reconcile queues are empty (tests)."""
+        """Wait until all reconcile queues are empty AND no handler is
+        mid-reconcile (tests; a settle-sleep here raced handlers that
+        had popped their event but not yet written the result)."""
         deadline = time.time() + timeout
         workers = [self.template_ctrl.worker, self.constraint_ctrl.worker,
                    self.sync_ctrl.worker, self.config_ctrl.worker]
+
+        def idle() -> bool:
+            return all(w.registrar.events.empty() and not w.in_flight
+                       for w in workers)
+
         while time.time() < deadline:
-            if all(w.registrar.events.empty() for w in workers):
-                time.sleep(0.05)  # let in-flight handlers finish
-                if all(w.registrar.events.empty() for w in workers):
+            # two consecutive idle observations: a handler that emits a
+            # follow-up event between the empty check and the in_flight
+            # check cannot slip through
+            if idle():
+                time.sleep(0.005)
+                if idle():
                     return
             time.sleep(0.01)
 

@@ -232,3 +232,48 @@ def test_13_health_endpoints(rt):
     assert resp.status == 200
     conn.request("GET", "/nosuch")
     assert conn.getresponse().status == 404
+
+
+def test_14_example_fixtures_end_to_end():
+    """The example/ content dir (reference example/{templates,
+    constraints,resources}): template + namespaceSelector constraint +
+    resources drive admission and discovery audit on a fresh runtime."""
+    ex = Path(__file__).resolve().parent.parent / "example"
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--health-addr", ":0", "--disable-cert-rotation",
+    ])
+    runtime = Runtime(args)
+    runtime.args.metrics_backend = "none"
+    runtime.start()
+    try:
+        kube = runtime.kube
+        kube.create(yaml.safe_load(
+            (ex / "templates/required-labels.yaml").read_text()))
+        runtime.manager.drain()
+        kube.create(yaml.safe_load(
+            (ex / "constraints/pods-in-prod-namespaces.yaml").read_text()))
+        runtime.manager.drain()
+        kube.create(yaml.safe_load(
+            (ex / "resources/prod-namespace.yaml").read_text()))
+        bad_pod = yaml.safe_load((ex / "resources/bad-pod.yaml").read_text())
+        out = runtime.webhook.validation.handle(admission_review(bad_pod))
+        assert out["response"]["allowed"] is False
+        assert "owner" in out["response"]["status"]["reason"]
+        # a pod in a namespace the selector does not match sails through
+        kube.create({"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": "dev-sandbox"}})
+        free_pod = json.loads(json.dumps(bad_pod))
+        free_pod["metadata"]["namespace"] = "dev-sandbox"
+        out = runtime.webhook.validation.handle(admission_review(free_pod))
+        assert out["response"]["allowed"] is True
+        # discovery audit resolves the selector from the live cluster
+        kube.create(bad_pod)
+        runtime.audit.audit_once()
+        stored = kube.get((CONSTRAINT_GROUP, "v1beta1",
+                           "K8sRequiredLabelsList"),
+                          "prod-pods-must-have-owner")
+        viol = stored["status"].get("violations") or []
+        assert any(v["name"] == "checkout-worker" for v in viol), viol
+    finally:
+        runtime.stop()
