@@ -78,7 +78,6 @@ class _Worker:
         self.name = name
         self.registrar = registrar
         self.handle = handle
-        self.in_flight = False
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name=f"ctrl-{name}", daemon=True)
@@ -92,15 +91,12 @@ class _Worker:
     def join(self, timeout=2.0):
         self._thread.join(timeout)
 
-    def drain_until_idle(self, timeout: float = 5.0) -> bool:
-        """Test/sync helper: wait for the queue to empty."""
-        deadline = time.time() + timeout
-        q = self.registrar.events
-        while time.time() < deadline:
-            if q.empty():
-                return True
-            time.sleep(0.005)
-        return q.empty()
+    def idle(self) -> bool:
+        """No queued events AND no popped-but-unhandled event:
+        unfinished_tasks increments at put() and only decrements at the
+        loop's task_done() after handle() returns, so there is no
+        window where an event is in flight but invisible."""
+        return self.registrar.events.unfinished_tasks == 0
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -108,17 +104,13 @@ class _Worker:
                 event = self.registrar.events.get(timeout=0.1)
             except Exception:
                 continue
-            # in_flight bridges the gap between "queue empty" and
-            # "handler finished" so drain() cannot return while a
-            # reconcile is mid-write (a sleep there was a flaky race)
-            self.in_flight = True
             try:
                 self.handle(event)
             except Exception as e:  # reconcile must never die
                 log.error(f"{self.name}: reconcile error: {e}",
                           event_type=event.type)
             finally:
-                self.in_flight = False
+                self.registrar.events.task_done()
 
 
 # ------------------------------------------------------------------ template
@@ -451,26 +443,19 @@ class ControllerManager:
         self.config_ctrl.start()
 
     def drain(self, timeout: float = 10.0) -> None:
-        """Wait until all reconcile queues are empty AND no handler is
-        mid-reconcile (tests; a settle-sleep here raced handlers that
-        had popped their event but not yet written the result)."""
+        """Wait until every reconcile queue has no queued OR in-flight
+        event (tests; unfinished_tasks covers the popped-but-unhandled
+        gap that a queue-empty check plus settle-sleep raced). Cascades
+        are safe with one pass: a handler emits follow-up events BEFORE
+        its own task_done, so the follow-up is visible in some queue
+        whenever the source task still counts as unfinished."""
         deadline = time.time() + timeout
         workers = [self.template_ctrl.worker, self.constraint_ctrl.worker,
                    self.sync_ctrl.worker, self.config_ctrl.worker]
-
-        def idle() -> bool:
-            return all(w.registrar.events.empty() and not w.in_flight
-                       for w in workers)
-
         while time.time() < deadline:
-            # two consecutive idle observations: a handler that emits a
-            # follow-up event between the empty check and the in_flight
-            # check cannot slip through
-            if idle():
-                time.sleep(0.005)
-                if idle():
-                    return
-            time.sleep(0.01)
+            if all(w.idle() for w in workers):
+                return
+            time.sleep(0.005)
 
     def stop(self) -> None:
         for w in (self.template_ctrl.worker, self.constraint_ctrl.worker,

@@ -222,6 +222,25 @@ def _obj(*kv):
 # ----------------------------------------------------------------- compiler
 
 
+def _hint_term_safe(t) -> bool:
+    """True when a join-reorder pin expression cannot RAISE at runtime:
+    vars, scalars, path refs with safe steps, and literal containers of
+    the same. Calls (user functions can raise RegoError on multi-output
+    conflicts), arithmetic (divide-by-zero), and comprehensions are
+    excluded — path steps merely go UNDEF, which the guard handles."""
+    if isinstance(t, (A.Var, A.Scalar)):
+        return True
+    if isinstance(t, A.Ref):
+        return _hint_term_safe(t.base) and all(
+            _hint_term_safe(a) for a in t.args)
+    if isinstance(t, (A.ArrayLit, A.SetLit)):
+        return all(_hint_term_safe(x) for x in t.items)
+    if isinstance(t, A.ObjectLit):
+        return all(_hint_term_safe(k) and _hint_term_safe(v)
+                   for k, v in t.items)
+    return False
+
+
 class _NotDeterministic(Exception):
     """Internal: term needs loop emission (unbound ref args)."""
 
@@ -1048,7 +1067,12 @@ class ModuleCompiler:
         hint = self._key_hints.get(id(lit))
         if hint is not None and not lit.negated:
             k_name, e_term = hint
-            if not scope.bound(k_name):
+            # the pin expression evaluates BEFORE (and regardless of)
+            # the enumeration producing bindings, so it must be unable
+            # to raise: a user-function call erroring here would
+            # surface where the interpreter, evaluating the (possibly
+            # empty) enumeration first, produces no violation at all
+            if not scope.bound(k_name) and _hint_term_safe(e_term):
                 try:
                     e_expr = self.value(e_term, scope, ind)
                 except (_NotDeterministic, Unsupported):

@@ -352,3 +352,55 @@ violation[{"msg": msg}] {
     msgs = sorted(r.msg for r in client.audit().results())
     assert msgs == ["dup of a", "dup of a", "dup of b", "dup of b",
                     "dup of c", "dup of c"]
+
+
+def test_join_hint_pin_cannot_raise_before_enumeration():
+    """Regression (advisor r4): the join-reorder hint evaluated the pin
+    expression BEFORE the enumeration. If the pin called a user function
+    that errors (complete-rule multi-output conflict), the compiled
+    evaluator raised where the interpreter — evaluating the empty
+    enumeration first — simply produced nothing. Error-prone pins are
+    now excluded from hinting."""
+    src = '''
+package hintbug
+
+boom(x) = y { y := 1 }
+boom(x) = y { y := 2 }
+
+violation[{"msg": "hit"}] {
+  v := input.review.object.items[k]
+  k == boom(input.review.object.pin)
+  v == "x"
+}
+'''
+    from gatekeeper_tpu.rego.codegen import compile_module
+    from gatekeeper_tpu.rego.interp import UNDEF, Interpreter
+    from gatekeeper_tpu.rego.parser import parse_module
+    from gatekeeper_tpu.utils.values import freeze
+
+    module = parse_module(src)
+    interp = Interpreter({"m": module})
+    fn = compile_module(module, entry="violation")
+    # empty enumeration: the interpreter yields nothing; the compiled
+    # evaluator must NOT raise through the hoisted pin
+    empty = {"review": {"object": {"pin": "p"}}}
+    want = interp.eval_rule(("hintbug",), "violation", empty)
+    got = fn.__input_call__(freeze(empty), freeze({}))
+    assert want is UNDEF or not want
+    assert got == want or (got in (UNDEF, frozenset()) and
+                           want in (UNDEF, frozenset()))
+    # non-empty enumeration: both paths surface the conflict identically
+    loaded = {"review": {"object": {"items": {"a": "x"}, "pin": "p"}}}
+    try:
+        want2 = interp.eval_rule(("hintbug",), "violation", loaded)
+        want_raised = False
+    except Exception:
+        want_raised = True
+    try:
+        got2 = fn.__input_call__(freeze(loaded), freeze({}))
+        got_raised = False
+    except Exception:
+        got_raised = True
+    assert want_raised == got_raised
+    if not want_raised:
+        assert got2 == want2
