@@ -74,7 +74,15 @@ def main() -> None:
     # ---- full audit through the public client API ---------------------
     t0 = time.time()
     resp = client.audit()
-    first_audit_s = time.time() - t0  # includes jit compile + extraction
+    first_audit_s = time.time() - t0  # at this scale the async-compile
+    # machinery blocks on the background warm (host fallback would cost
+    # more than the compile), so this includes jit compile + extraction
+    # wait out any remaining background compiles so the steady-state
+    # loop measures the device path, not a warming race
+    t_warm0 = time.time()
+    while driver.warm_status()["compiling"] and \
+            time.time() - t_warm0 < 600:
+        time.sleep(0.2)
     iters = 4
     audit_s = float("inf")
     for _ in range(iters):
@@ -83,6 +91,7 @@ def main() -> None:
         audit_s = min(audit_s, time.time() - t0)  # min-of-N: the
         # steady-state capability on a possibly noisy shared host
     n_results = len(resp.results())
+    audit_path = driver.last_audit_path  # mesh(data=N) | single
     evals = N_OBJECTS * N_CONSTRAINTS
     evals_per_sec = evals / audit_s
 
@@ -159,14 +168,15 @@ def main() -> None:
 
     configs = {}
     try:
+        # FULL scale by default: BENCH_r0N.json must carry the
+        # 10k-object and 50k-pod numbers, not reduced-scale stand-ins
         env = dict(os.environ)
-        env.setdefault("BENCH_SCALE", "0.2")
         proc = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(
                 os.path.abspath(__file__)), "bench_configs.py"),
              "1", "2", "3", "5"],
             capture_output=True, text=True, env=env,
-            timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 300)))
+            timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 1800)))
         for line in proc.stdout.splitlines():
             line = line.strip()
             if line.startswith("{"):
@@ -206,6 +216,9 @@ def main() -> None:
         "materialize_s": round(mat_s, 3),
         "evals_per_sec_per_chip": round(evals_per_sec),
         "first_audit_s": round(first_audit_s, 2),
+        "audit_path": audit_path,
+        "device_programs": driver.warm_status(),
+        "n_devices": len(__import__("jax").devices()),
         "mutate_audit_s": round(mutate_audit_s, 3),
         "objects": N_OBJECTS,
         "constraints": N_CONSTRAINTS,

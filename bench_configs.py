@@ -315,14 +315,10 @@ def config3():
 # --------------------------------------------------------------- config 5
 
 
-def config5():
-    from gatekeeper_tpu.control.webhook import MicroBatcher
+def _general_library_client():
     from gatekeeper_tpu import policies
-    import threading
 
-    _, client = new_client()
-    # the BASELINE workload: streaming admission vs the FULL general
-    # library (join templates included), mixed object kinds
+    driver, client = new_client()
     for name in policies.names():
         if name.startswith("general/"):
             client.add_template(policies.load(name))
@@ -332,9 +328,12 @@ def config5():
             "kind": kind, "metadata": {"name": cname},
             "spec": ({"parameters": params} if params else {}),
         })
-    objs = synth_mixed_objects(512, seed=3)
+    return driver, client
+
+
+def _mixed_reviews(n=512, seed=3):
     reviews = []
-    for o in objs:
+    for o in synth_mixed_objects(n, seed=seed):
         meta = o.get("metadata", {})
         r = {"kind": {"group": o["apiVersion"].rpartition("/")[0],
                       "version": o["apiVersion"].rpartition("/")[2],
@@ -344,62 +343,321 @@ def config5():
         if "namespace" in meta:
             r["namespace"] = meta["namespace"]
         reviews.append(r)
+    return reviews
+
+
+def _loadgen_child(port: int, rate: float, duration: float,
+                   seed: int, out_path: str) -> None:
+    """OPEN-LOOP load generator (run as its own process so client work
+    never shares the server's GIL): arrivals on a fixed schedule at
+    `rate` req/s regardless of response latency; each arrival is fired
+    by a pool thread and its latency recorded. Unsustained rates show
+    up as queue growth -> unbounded p99, not as a throttled client."""
+    import http.client
+    import queue as _q
+    import threading
+
+    reviews = _mixed_reviews(256, seed=seed)
+    payloads = [json.dumps({
+        "apiVersion": "admission.k8s.io/v1beta1", "kind": "AdmissionReview",
+        "request": dict(r, uid=f"u{k}",
+                        userInfo={"username": "bench"})})
+        for k, r in enumerate(reviews)]
+    n = max(1, int(rate * duration))
+    lat: list = []
+    errors = [0]
+    lock = threading.Lock()
+    work: "_q.Queue" = _q.Queue()
+
+    def runner():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        while True:
+            item = work.get()
+            if item is None:
+                return
+            t_sched, payload = item
+            try:
+                conn.request("POST", "/v1/admit", payload,
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+            except (OSError, http.client.HTTPException):
+                # count, reconnect, keep the thread alive — a dead pool
+                # thread would silently skew the whole rate's numbers
+                with lock:
+                    errors[0] += 1
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=30)
+                continue
+            now = time.time()
+            with lock:
+                lat.append((now - t_sched, now))
+
+    # enough pool threads that the schedule never starves on slow
+    # responses (open-loop: concurrency grows when the server lags)
+    pool = [threading.Thread(target=runner, daemon=True)
+            for _ in range(64)]
+    for t in pool:
+        t.start()
+    t0 = time.time()
+    for j in range(n):
+        t_sched = t0 + j / rate
+        now = time.time()
+        if t_sched > now:
+            time.sleep(t_sched - now)
+        work.put((t_sched, payloads[j % len(payloads)]))
+    deadline = time.time() + 30
+    while not work.empty() and time.time() < deadline:
+        time.sleep(0.05)
+    for _ in pool:
+        work.put(None)
+    for t in pool:
+        t.join(timeout=5)
+    with open(out_path, "w") as f:
+        json.dump({"sent": n, "done": len(lat), "t0": t0,
+                   "errors": errors[0],
+                   "latencies": [x[0] for x in lat],
+                   "last_done": max((x[1] for x in lat), default=t0)}, f)
+
+
+def _serve_child(port: int) -> None:
+    """One webhook worker process: full general-library client behind a
+    WebhookServer bound with SO_REUSEPORT (the kernel load-balances
+    accepted connections across workers — N single-GIL Python frontends
+    on one port, the one-node analog of N replicas)."""
+    from gatekeeper_tpu.control.webhook import (
+        MicroBatcher, NamespaceLabelHandler, ValidationHandler,
+        WebhookServer)
+
+    _, client = _general_library_client()
     batcher = MicroBatcher(client, max_wait=0.003, max_batch=256)
-    # steady state: warm codegen, device probe EMAs, and memo caches
-    # before the measured window (a resident webhook is warm)
-    driver = client.driver
-    for bs in (32, 128, 256):
-        batch = [r for r in reviews[:bs]]
-        for _ in range(3):
-            driver.review_batch(TARGET, batch)
-    batcher.submit(reviews[0])
-    # standard long-lived-server tuning: the warmed caches (features,
-    # memos, codegen closures) are permanent; freezing them out of the
-    # GC's scan set removes multi-ms gen-2 pauses from the tail
+    validation = ValidationHandler(client, kube=None, batcher=batcher)
+    server = WebhookServer(validation, NamespaceLabelHandler(()),
+                           port=port, reuse_port=True)
+    # warm, then signal readiness on stdout
+    client.driver.review_batch(TARGET, _mixed_reviews(64, seed=9))
     import gc
     gc.collect()
     gc.freeze()
+    print("READY", flush=True)
+    server.server.serve_forever()
 
-    n_requests = int(10_000 * SCALE)
+
+def _run_sweep(port, rates, n_procs, duration, here):
+    import subprocess
+    import tempfile
+
+    sweep = []
+    sustained = None
+    for total_rate in rates:
+        outs = []
+        procs = []
+        for k in range(n_procs):
+            f = tempfile.NamedTemporaryFile("w", suffix=".json",
+                                            delete=False)
+            f.close()
+            outs.append(f.name)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--loadgen", str(port),
+                 str(total_rate / n_procs), str(duration), str(k),
+                 f.name],
+                cwd=here))
+        for p in procs:
+            p.wait(timeout=duration + 90)
+        lats: list = []
+        sent = done = n_err = 0
+        span = duration
+        for path in outs:
+            try:
+                with open(path) as f:
+                    d = json.load(f)
+                sent += d["sent"]
+                done += d["done"]
+                n_err += d.get("errors", 0)
+                lats.extend(d["latencies"])
+                span = max(span, d["last_done"] - d["t0"])
+            finally:
+                os.unlink(path)
+        lats.sort()
+        if not lats:
+            break
+        entry = {"offered_rps": total_rate,
+                 "achieved_rps": round(done / span),
+                 "p50_ms": round(lats[len(lats) // 2] * 1000, 1),
+                 "p99_ms": round(lats[int(len(lats) * 0.99)] * 1000, 1),
+                 "completed": done, "sent": sent, "errors": n_err}
+        sweep.append(entry)
+        # SLO: p99 under 100ms and the offered schedule kept up with
+        if entry["p99_ms"] < 100 and done >= 0.95 * sent:
+            sustained = entry
+        elif sustained is not None:
+            break  # past the knee: stop sweeping
+    return sweep, sustained
+
+
+def config5():
+    """Streaming admission (BASELINE config #5) measured three ways:
+    1. engine: pre-batched reviews through driver.review_batch — the
+       evaluator's capability with batching amortized (the gRPC
+       service's pre-batched ingest path);
+    2. open-loop HTTP: multi-process load generators with scheduled
+       arrivals against the real webhook server, swept upward until
+       p99 degrades — one worker's sustainable rate, then an
+       SO_REUSEPORT multi-worker group's (the one-node replica story);
+    3. the documented ceiling: highest swept rate meeting the SLO.
+    """
+    import socket
+    import subprocess
+
+    driver, client = _general_library_client()
+    reviews = _mixed_reviews(512, seed=3)
+
+    # --- 1. engine capability: pre-batched throughput ------------------
+    driver_batches = [reviews[i:i + 256]
+                      for i in range(0, len(reviews), 256)]
+    for b in driver_batches:  # warm codegen/memos/EMAs
+        driver.review_batch(TARGET, b)
+    import gc
+    gc.collect()
+    gc.freeze()
+    n_eng = 0
+    t0 = time.time()
+    while time.time() - t0 < 3.0:
+        for b in driver_batches:
+            driver.review_batch(TARGET, b)
+            n_eng += len(b)
+    engine_rps = n_eng / (time.time() - t0)
+
+    # --- 2. batcher closed-loop (BENCH_r04 continuity): 64 in-process
+    # threads through batcher.submit — no HTTP, measures the engine +
+    # micro-batching frontier sharing one GIL with its clients
+    import threading
+
+    from gatekeeper_tpu.control.webhook import (
+        MicroBatcher, NamespaceLabelHandler, ValidationHandler,
+        WebhookServer)
+
+    batcher = MicroBatcher(client, max_wait=0.003, max_batch=256)
+    batcher.submit(reviews[0])  # warm the flusher
+    lat_cl: list = []
+    cl_lock = threading.Lock()
+    n_req = int(10_000 * SCALE)
     n_threads = 64
-    latencies: list[float] = []
-    lock = threading.Lock()
 
-    def worker(k: int):
+    def cl_worker(k: int):
         lats = []
-        for j in range(n_requests // n_threads):
+        for j in range(n_req // n_threads):
             r = reviews[(k * 131 + j) % len(reviews)]
             t0 = time.time()
             batcher.submit(r)
             lats.append(time.time() - t0)
-        with lock:
-            latencies.extend(lats)
+        with cl_lock:
+            lat_cl.extend(lats)
 
     t0 = time.time()
-    threads = [threading.Thread(target=worker, args=(k,))
-               for k in range(n_threads)]
-    for t in threads:
+    ths = [threading.Thread(target=cl_worker, args=(k,))
+           for k in range(n_threads)]
+    for t in ths:
         t.start()
-    for t in threads:
+    for t in ths:
         t.join()
-    wall = time.time() - t0
+    cl_wall = time.time() - t0
+    lat_cl.sort()
+    closed_loop = {
+        "rps": round(len(lat_cl) / cl_wall),
+        "p50_ms": round(lat_cl[len(lat_cl) // 2] * 1000, 2),
+        "p99_ms": round(lat_cl[int(len(lat_cl) * 0.99)] * 1000, 2),
+    }
+
+    # --- 3. open-loop HTTP sweep (separate loadgen processes) ----------
+    cores = os.cpu_count() or 1
+    validation = ValidationHandler(client, kube=None, batcher=batcher)
+    server = WebhookServer(validation, NamespaceLabelHandler(()), port=0)
+    server.start()
+    here = os.path.dirname(os.path.abspath(__file__))
+    n_procs = max(1, min(4, cores))
+    duration = float(os.environ.get("BENCH_C5_SECONDS", 4.0))
+    sweep, sustained = _run_sweep(
+        server.port, (500, 1000, 1500, 2000, 3000, 5000, 8000, 12000),
+        n_procs, duration, here)
+    server.server.shutdown()
     batcher.stop()
-    latencies.sort()
-    p50 = latencies[len(latencies) // 2]
-    p99 = latencies[int(len(latencies) * 0.99)]
+
+    # --- 4. SO_REUSEPORT worker group: one port, N serving processes.
+    # Meaningful only with cores for them to run on — on a single-core
+    # host every extra process just divides the same CPU
+    n_workers = int(os.environ.get("BENCH_C5_WORKERS", 0)) or \
+        max(1, min(4, cores // 2))
+    mw_sweep: list = []
+    mw_sustained = None
+    if n_workers > 1:
+        # hold a bound (non-listening) SO_REUSEPORT socket while the
+        # workers bind: nothing else can claim the port in the gap, and
+        # the kernel only balances across LISTENING sockets, so the
+        # placeholder never receives connections
+        holder = socket.socket()
+        holder.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        holder.bind(("127.0.0.1", 0))
+        shared_port = holder.getsockname()[1]
+        workers = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--serve",
+             str(shared_port)],
+            cwd=here, stdout=subprocess.PIPE, text=True)
+            for _ in range(n_workers)]
+        try:
+            for w in workers:
+                line = w.stdout.readline()
+                if "READY" not in line:
+                    raise RuntimeError("webhook worker failed to start")
+            holder.close()
+            base = sustained["offered_rps"] if sustained else 1000
+            rates = sorted({base * m for m in (2, 3, 4, 6, 8)})
+            mw_sweep, mw_sustained = _run_sweep(shared_port, rates,
+                                                n_procs, duration, here)
+        finally:
+            for w in workers:
+                w.kill()
+
+    best = (mw_sustained or sustained
+            or (max(sweep + mw_sweep, key=lambda e: e["achieved_rps"])
+                if sweep + mw_sweep else {}))
     print(json.dumps({
         "config": 5, "metric": "admission_requests_per_sec",
-        "value": round(len(latencies) / wall),
-        "unit": f"req/s ({len(latencies)} reviews, {n_threads} concurrent "
-                f"clients, micro-batched)",
-        "p50_ms": round(p50 * 1000, 2), "p99_ms": round(p99 * 1000, 2),
-        "batches": batcher.batches,
-        "avg_batch": round(batcher.batched_requests /
-                           max(1, batcher.batches), 1),
+        "value": best.get("achieved_rps", 0),
+        "unit": "req/s (open-loop multi-process HTTP vs full general "
+                "library; highest offered rate with p99<100ms, else "
+                "the measured host ceiling)",
+        "slo_met": (mw_sustained or sustained) is not None,
+        "p50_ms": best.get("p50_ms"), "p99_ms": best.get("p99_ms"),
+        "host_cores": cores,
+        "workers": n_workers,
+        "engine_batched_reviews_per_sec": round(engine_rps),
+        "batcher_closed_loop": closed_loop,
+        "tiers_note": "engine = pre-batched driver.review_batch (the "
+                      "gRPC pre-batched ingest path); closed_loop = "
+                      "64 in-process clients on batcher.submit (r4's "
+                      "harness); HTTP sweeps are OPEN-LOOP with "
+                      "separate loadgen processes — on a small host "
+                      "they measure the serving frontend sharing "
+                      "cores with the load generators",
+        "sweep": sweep,
+        "multi_worker_sweep": mw_sweep,
     }))
 
 
 def main() -> None:
+    if sys.argv[1:2] == ["--loadgen"]:
+        port, rate, duration, seed, out = sys.argv[2:7]
+        _loadgen_child(int(port), float(rate), float(duration),
+                       int(seed), out)
+        return
+    if sys.argv[1:2] == ["--serve"]:
+        _serve_child(int(sys.argv[2]))
+        return
     which = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 5]
     for c in which:
         {1: config1, 2: config2, 3: config3, 5: config5}[c]()

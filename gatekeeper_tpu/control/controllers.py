@@ -445,17 +445,24 @@ class ControllerManager:
     def drain(self, timeout: float = 10.0) -> None:
         """Wait until every reconcile queue has no queued OR in-flight
         event (tests; unfinished_tasks covers the popped-but-unhandled
-        gap that a queue-empty check plus settle-sleep raced). Cascades
-        are safe with one pass: a handler emits follow-up events BEFORE
-        its own task_done, so the follow-up is visible in some queue
-        whenever the source task still counts as unfinished."""
+        gap that a queue-empty check plus settle-sleep raced). The
+        all-idle predicate reads each queue at a different instant, so
+        a later-checked worker can emit into an already-checked queue
+        mid-pass — require two consecutive idle passes: a cascade in
+        that window leaves its source task unfinished into the second
+        pass, or its target queued."""
         deadline = time.time() + timeout
         workers = [self.template_ctrl.worker, self.constraint_ctrl.worker,
                    self.sync_ctrl.worker, self.config_ctrl.worker]
+        stable = 0
         while time.time() < deadline:
             if all(w.idle() for w in workers):
-                return
-            time.sleep(0.005)
+                stable += 1
+                if stable >= 2:
+                    return
+            else:
+                stable = 0
+            time.sleep(0.002)
 
     def stop(self) -> None:
         for w in (self.template_ctrl.worker, self.constraint_ctrl.worker,

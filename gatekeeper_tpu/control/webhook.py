@@ -322,10 +322,19 @@ class WebhookServer:
     def __init__(self, validation: ValidationHandler,
                  ns_label: NamespaceLabelHandler,
                  port: int = 8443, certfile: Optional[str] = None,
-                 keyfile: Optional[str] = None, addr: str = ""):
+                 keyfile: Optional[str] = None, addr: str = "",
+                 reuse_port: bool = False):
+        """reuse_port: bind with SO_REUSEPORT so multiple serving
+        PROCESSES share one port (the kernel load-balances accepts) —
+        the single-process Python frontend is GIL-bound, and this is
+        how one node runs N webhook workers without a proxy."""
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            # keep-alive: the API server reuses webhook connections; a
+            # connection (and thread) per request doubles syscall load
+            protocol_version = "HTTP/1.1"
+
             def do_POST(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length)
@@ -333,6 +342,9 @@ class WebhookServer:
                     review = json.loads(body)
                 except json.JSONDecodeError:
                     self.send_response(400)
+                    # explicit zero length: HTTP/1.1 keep-alive clients
+                    # would otherwise wait for a close that never comes
+                    self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
                 if self.path.startswith("/v1/admitlabel"):
@@ -341,6 +353,7 @@ class WebhookServer:
                     out = outer.validation.handle(review)
                 else:
                     self.send_response(404)
+                    self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
                 payload = json.dumps(out).encode()
@@ -355,7 +368,18 @@ class WebhookServer:
 
         self.validation = validation
         self.ns_label = ns_label
-        self.server = http.server.ThreadingHTTPServer((addr, port), Handler)
+        server_cls = http.server.ThreadingHTTPServer
+        if reuse_port:
+            import socket as _socket
+
+            class _ReusePort(http.server.ThreadingHTTPServer):
+                def server_bind(self):
+                    self.socket.setsockopt(_socket.SOL_SOCKET,
+                                           _socket.SO_REUSEPORT, 1)
+                    super().server_bind()
+
+            server_cls = _ReusePort
+        self.server = server_cls((addr, port), Handler)
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(certfile, keyfile)

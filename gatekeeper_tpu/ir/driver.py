@@ -223,7 +223,7 @@ class TpuDriver(RegoDriver):
         self.async_warm = _os.environ.get(
             "GATEKEEPER_TPU_ASYNC_COMPILE", "1") != "0"
         self._warm_done: set = set()
-        self._warm_inflight: set = set()
+        self._warm_inflight: dict = {}           # sig -> done Event
         self._warm_fail: dict = {}               # sig -> failure count
         self._warm_lock = threading.Lock()       # guards the warm sets
         self._warm_sem = threading.Semaphore(1)  # one compile at a time
@@ -566,6 +566,11 @@ class TpuDriver(RegoDriver):
     # mesh dispatch only pays off once per-shard slabs are substantial
     MESH_MIN_REVIEWS = 8192
 
+    # async warm-up serves the host path only while its estimated cost
+    # stays under this; beyond it, blocking on the compile once is
+    # cheaper than minutes of interpretation
+    ASYNC_WARM_MAX_HOST_S = 30.0
+
     def _mesh_shardable(self, n_reviews: int) -> bool:
         """Mesh path gate: enough rows, and the power-of-two extraction
         bucket divides evenly over the data axis."""
@@ -610,14 +615,18 @@ class TpuDriver(RegoDriver):
                                        n_true=n_true)
 
     def _spawn_warm(self, sig, kind, ct, feats, enc, table, derived,
-                    n_true, use_mesh) -> None:
+                    n_true, use_mesh):
         """Run the device sweep once in the background so its jit caches
         populate off the serving path; results are discarded (the
-        foreground already answered from the host path this round)."""
+        foreground already answered from the host path this round).
+        Returns the completion Event (callers whose host alternative is
+        worse than the compile may choose to wait on it)."""
         with self._warm_lock:
-            if sig in self._warm_inflight or sig in self._warm_done:
-                return
-            self._warm_inflight.add(sig)
+            ev = self._warm_inflight.get(sig)
+            if ev is not None or sig in self._warm_done:
+                return ev
+            ev = threading.Event()
+            self._warm_inflight[sig] = ev
 
         def run():
             import time as _time
@@ -655,10 +664,12 @@ class TpuDriver(RegoDriver):
                     type(e).__name__, e)
             finally:
                 with self._warm_lock:
-                    self._warm_inflight.discard(sig)
+                    self._warm_inflight.pop(sig, None)
+                ev.set()
 
         threading.Thread(target=run, daemon=True,
                          name=f"warm-{kind}").start()
+        return ev
 
     def warm_status(self) -> dict:
         """Observability: how many device programs are warm/in-flight
@@ -701,9 +712,22 @@ class TpuDriver(RegoDriver):
                 with self._warm_lock:
                     warm = sig in self._warm_done
                 if not warm:
-                    self._spawn_warm(sig, kind, ct, feats, enc, table,
-                                     derived, len(cand_reviews), use_mesh)
-                    return None  # host path serves this audit
+                    ev = self._spawn_warm(sig, kind, ct, feats, enc,
+                                          table, derived,
+                                          len(cand_reviews), use_mesh)
+                    # host fallback only when it is actually cheaper
+                    # than waiting out the compile: at audit scale
+                    # (e.g. 50M masked pairs) minutes of interpretation
+                    # would be far worse than blocking ~10-90s once
+                    host_est = int(mask.sum()) / self._host_pair_rate
+                    if host_est <= self.ASYNC_WARM_MAX_HOST_S:
+                        return None  # host path serves this audit
+                    if ev is not None:
+                        ev.wait(timeout=600)
+                    with self._warm_lock:
+                        warm = sig in self._warm_done
+                    if not warm:
+                        return None  # warm failed/timed out: host path
             import time as _time
 
             handle = self._dispatch_handle(ct, feats, enc, table, derived,
