@@ -416,11 +416,16 @@ def _loadgen_child(port: int, rate: float, duration: float,
         work.put(None)
     for t in pool:
         t.join(timeout=5)
+    # threads that outlived the join timeout may still append: snapshot
+    # under the lock so done/latencies/last_done agree with each other
+    with lock:
+        snap = list(lat)
+        n_err = errors[0]
     with open(out_path, "w") as f:
-        json.dump({"sent": n, "done": len(lat), "t0": t0,
-                   "errors": errors[0],
-                   "latencies": [x[0] for x in lat],
-                   "last_done": max((x[1] for x in lat), default=t0)}, f)
+        json.dump({"sent": n, "done": len(snap), "t0": t0,
+                   "errors": n_err,
+                   "latencies": [x[0] for x in snap],
+                   "last_done": max((x[1] for x in snap), default=t0)}, f)
 
 
 def _serve_child(port: int) -> None:
@@ -467,7 +472,15 @@ def _run_sweep(port, rates, n_procs, duration, here):
                  f.name],
                 cwd=here))
         for p in procs:
-            p.wait(timeout=duration + 90)
+            try:
+                p.wait(timeout=duration + 90)
+            except subprocess.TimeoutExpired:
+                # a wedged loadgen must not lose the whole config: kill
+                # the stragglers and fold in whatever results exist
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                break
         lats: list = []
         sent = done = n_err = 0
         span = duration
@@ -480,6 +493,8 @@ def _run_sweep(port, rates, n_procs, duration, here):
                 n_err += d.get("errors", 0)
                 lats.extend(d["latencies"])
                 span = max(span, d["last_done"] - d["t0"])
+            except ValueError:
+                pass  # killed child: empty/partial file
             finally:
                 os.unlink(path)
         lats.sort()

@@ -66,6 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--disable-enforcementaction-validation",
                    action="store_true")
     p.add_argument("--exempt-namespace", action="append", default=[])
+    p.add_argument("--webhook-reuse-port", action="store_true",
+                   help="bind the webhook port with SO_REUSEPORT so "
+                        "multiple worker processes share it (the kernel "
+                        "load-balances accepts; one GIL-bound Python "
+                        "frontend per worker)")
     p.add_argument("--fake-kube", action="store_true",
                    help="in-memory cluster (development/testing)")
     return p
@@ -114,9 +119,10 @@ class Runtime:
                 except Exception as e:
                     log.warning("cert bootstrap failed; serving plaintext",
                                 details=str(e))
-            self.webhook = WebhookServer(validation, ns_label,
-                                         port=args.port, certfile=certfile,
-                                         keyfile=keyfile)
+            self.webhook = WebhookServer(
+                validation, ns_label, port=args.port, certfile=certfile,
+                keyfile=keyfile,
+                reuse_port=getattr(args, "webhook_reuse_port", False))
         self.upgrade = UpgradeManager(self.kube)
         self.metrics_server = None
         self.health = None

@@ -332,8 +332,11 @@ class WebhookServer:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             # keep-alive: the API server reuses webhook connections; a
-            # connection (and thread) per request doubles syscall load
+            # connection (and thread) per request doubles syscall load.
+            # The idle timeout bounds how long a half-open or silent
+            # client can pin a serving thread and its socket
             protocol_version = "HTTP/1.1"
+            timeout = 60
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length") or 0)

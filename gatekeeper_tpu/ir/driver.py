@@ -140,6 +140,12 @@ def _expand_parameterless(rows, cols, c_dev: int, n_cons: int):
     return rows, cols
 
 
+class _ServeHostThisRound(Exception):
+    """Internal: a large review batch should evaluate on the host path
+    this round (its device program is still warming in the background);
+    NOT a demotion."""
+
+
 def enable_compile_cache() -> None:
     """Point JAX at a persistent compilation cache (idempotent). A cold
     audit pays ~20-40s of XLA compiles; with the cache, every later
@@ -709,25 +715,14 @@ class TpuDriver(RegoDriver):
             if self.async_warm:
                 sig = self._sweep_sig(kind, feats, enc, table, derived,
                                       len(cand_reviews), use_mesh)
-                with self._warm_lock:
-                    warm = sig in self._warm_done
-                if not warm:
-                    ev = self._spawn_warm(sig, kind, ct, feats, enc,
-                                          table, derived,
-                                          len(cand_reviews), use_mesh)
-                    # host fallback only when it is actually cheaper
-                    # than waiting out the compile: at audit scale
-                    # (e.g. 50M masked pairs) minutes of interpretation
-                    # would be far worse than blocking ~10-90s once
-                    host_est = int(mask.sum()) / self._host_pair_rate
-                    if host_est <= self.ASYNC_WARM_MAX_HOST_S:
-                        return None  # host path serves this audit
-                    if ev is not None:
-                        ev.wait(timeout=600)
-                    with self._warm_lock:
-                        warm = sig in self._warm_done
-                    if not warm:
-                        return None  # warm failed/timed out: host path
+                # host fallback only when it is actually cheaper than
+                # waiting out the compile: at audit scale (e.g. 50M
+                # masked pairs) minutes of interpretation would be far
+                # worse than blocking ~10-90s once
+                if not self._warm_gate(sig, kind, ct, feats, enc, table,
+                                       derived, len(cand_reviews),
+                                       use_mesh, int(mask.sum())):
+                    return None  # host path serves this audit
             import time as _time
 
             handle = self._dispatch_handle(ct, feats, enc, table, derived,
@@ -1174,6 +1169,69 @@ class TpuDriver(RegoDriver):
             return True
         return False
 
+    # review batches at or above this candidate count use the sparse
+    # firing-pair gather (and the mesh when shardable) instead of the
+    # dense verdict tensor — the discovery-mode audit stages the whole
+    # cluster through review_batch, the same scale as cached audits
+    SPARSE_BATCH_MIN = 4096
+
+    def _warm_gate(self, sig, kind, ct, feats, enc, table, derived,
+                   n_true, use_mesh, n_masked_pairs) -> bool:
+        """Shared block-when-cheaper policy for a cold sweep shape:
+        kick the background warm and return False (serve host) when the
+        host alternative is tolerable, else wait the compile out and
+        return whether the program is now warm. True = dispatch on the
+        device."""
+        with self._warm_lock:
+            if sig in self._warm_done:
+                return True
+        ev = self._spawn_warm(sig, kind, ct, feats, enc, table, derived,
+                              n_true, use_mesh)
+        if n_masked_pairs / self._host_pair_rate <= \
+                self.ASYNC_WARM_MAX_HOST_S:
+            return False
+        if ev is not None:
+            ev.wait(timeout=600)
+        with self._warm_lock:
+            return sig in self._warm_done
+
+    def _review_batch_sparse(self, ct, kind, cand, cand_reviews, cons,
+                             mask) -> list:
+        """(review_index, constraint_index) firing pairs for one kind of
+        a large batch, via the audit dispatch machinery (sparse gather,
+        mesh sharding, async warm-up with the same block-when-cheaper
+        rule)."""
+        import time as _time
+
+        use_mesh = self._mesh_shardable(len(cand_reviews))
+        feats, enc, table, derived = self._prepare_eval(
+            ct, kind, cand_reviews, cons, feat_key=None, mesh=use_mesh)
+        if self.async_warm:
+            sig = self._sweep_sig(kind, feats, enc, table, derived,
+                                  len(cand_reviews), use_mesh)
+            if not self._warm_gate(sig, kind, ct, feats, enc, table,
+                                   derived, len(cand_reviews), use_mesh,
+                                   int(mask.sum())):
+                raise _ServeHostThisRound()
+        # latency EMA measured from DISPATCH (post-warm): folding a
+        # compile wait into it would steer batches to the host for ages
+        t0 = _time.time()
+        handle = self._dispatch_handle(ct, feats, enc, table, derived,
+                                       len(cand_reviews), use_mesh)
+        c_dev = _param_c(enc)
+        pairs = []
+        first = True
+        for rows, cols in handle.pairs():
+            if first:
+                self._observe("_dev_batch_lat_s", _time.time() - t0)
+                first = False
+            rows, cols = _expand_parameterless(rows, cols, c_dev,
+                                               len(cons))
+            keep = mask[cand[rows], cols]
+            pairs.extend(zip((int(x) for x in cand[rows[keep]]),
+                             (int(x) for x in cols[keep])))
+        return pairs
+
     def _observe(self, attr: str, value: float, alpha: float = 0.3) -> None:
         prev = getattr(self, attr)
         setattr(self, attr, value if prev is None
@@ -1250,12 +1308,25 @@ class TpuDriver(RegoDriver):
                 cand = np.flatnonzero(mask.any(axis=1))
                 cand_reviews = [reviews[int(i)] for i in cand]
                 try:
-                    t0 = _time.time()
-                    fires = self.eval_compiled(ct, kind, cand_reviews, cons)
-                    self._observe("_dev_batch_lat_s", _time.time() - t0)
-                    hits = np.logical_and(fires, mask[cand])
-                    pairs = [(int(cand[ri]), int(ci))
-                             for ri, ci in zip(*np.nonzero(hits))]
+                    if len(cand_reviews) >= self.SPARSE_BATCH_MIN:
+                        # audit-scale batch (discovery-mode sweeps stage
+                        # the whole cluster here): the sparse firing-row
+                        # gather — mesh-sharded when available — beats
+                        # shipping a dense [N, C] verdict tensor; it
+                        # records its own dispatch-based latency sample
+                        pairs = self._review_batch_sparse(
+                            ct, kind, cand, cand_reviews, cons, mask)
+                    else:
+                        t0 = _time.time()
+                        fires = self.eval_compiled(ct, kind,
+                                                   cand_reviews, cons)
+                        self._observe("_dev_batch_lat_s",
+                                      _time.time() - t0)
+                        hits = np.logical_and(fires, mask[cand])
+                        pairs = [(int(cand[ri]), int(ci))
+                                 for ri, ci in zip(*np.nonzero(hits))]
+                except _ServeHostThisRound:
+                    pass  # host path below; the warm continues
                 except Exception as e:
                     self._demote(kind, "review-eval", e)
                     self._compiled[kind] = None

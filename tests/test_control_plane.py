@@ -638,3 +638,38 @@ def test_webhook_survives_adversarial_payloads(runtime):
                  pyjson.dumps(admission_review(ns("post-fuzz"))),
                  {"Content-Type": "application/json"})
     assert pyjson.loads(conn.getresponse().read())["response"] is not None
+
+
+def test_webhook_reuse_port_flag():
+    """--webhook-reuse-port: two Runtimes share one webhook port (the
+    kernel balances accepts across the SO_REUSEPORT listeners)."""
+    import socket
+
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+        def mk():
+            args = build_parser().parse_args([
+                "--fake-kube", "--port", str(port), "--prometheus-port",
+                "0", "--health-addr", ":0", "--disable-cert-rotation",
+                "--webhook-reuse-port", "--operation", "webhook",
+            ])
+            rt = Runtime(args)
+            rt.args.metrics_backend = "none"
+            rt.start()
+            return rt
+
+        a = mk()
+        b = mk()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        body = json.dumps(admission_review(ns("x")))
+        conn.request("POST", "/v1/admit", body,
+                     {"Content-Type": "application/json"})
+        out = json.loads(conn.getresponse().read())
+        assert out["response"]["allowed"] is True  # no constraints
+    finally:
+        a.stop()
+        b.stop()
