@@ -19,7 +19,7 @@ from __future__ import annotations
 import copy
 import json
 import threading
-from typing import Any, Iterable, Optional, Union
+from typing import Any, Iterable, Union
 
 from .crd import CRDError, create_crd, create_schema, validate_cr, validate_crd
 from .drivers import Driver, hook_audit_path, hook_violation_path

@@ -12,7 +12,7 @@ no client-go scheme machinery to mirror.
 from __future__ import annotations
 
 import re
-from typing import Any, Optional
+from typing import Any
 
 from .templates import CONSTRAINT_GROUP, ConstraintTemplate
 

@@ -10,7 +10,7 @@ versions — the conversion is shape-preserving, so a single loader suffices.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Optional
 
 TEMPLATE_GROUP = "templates.gatekeeper.sh"
 TEMPLATE_VERSIONS = ("v1beta1", "v1alpha1")

@@ -36,9 +36,7 @@ from .util import (
     DEFAULT_ENFORCEMENT_ACTION,
     VALID_ENFORCEMENT_ACTIONS,
     by_pod_status_unchanged,
-    pod_name,
     set_by_pod_status,
-    validate_enforcement_action,
 )
 from .watch import WatchManager
 

@@ -9,7 +9,7 @@ util/constraint/unstructured_ha_status.go:19-133).
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Optional
 
 VALID_ENFORCEMENT_ACTIONS = ("deny", "dryrun")
 DEFAULT_ENFORCEMENT_ACTION = "deny"

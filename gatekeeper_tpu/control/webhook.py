@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Any, Optional
 
-from ..client import Client, ClientError
+from ..client import Client
 from ..target.handler import AugmentedReview
 from . import metrics
 from .config_types import trace_enabled

@@ -42,7 +42,7 @@ from ..target.batch import match_masks
 from .compile import Uncompilable, compile_template
 from .evaljax import CompiledTemplate, EvalError, _param_c
 from .features import extract_batch
-from .params import ParamEncodeError, encode_params
+from .params import encode_params
 
 _PREFIX_RE = re.compile(r'^templates\["([^"]+)"\]\["([^"]+)"\]$')
 

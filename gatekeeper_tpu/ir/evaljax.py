@@ -17,7 +17,6 @@ authoritative; it must never under-fire.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, NamedTuple, Optional
 
 import jax
@@ -28,7 +27,6 @@ from ..ops.strtab import MatchTables, StringTable
 from .prog import (
     And,
     Arith,
-    Axis,
     Cmp,
     Const,
     DerivedVal,

@@ -15,8 +15,7 @@ including intern-id assignment order).
 
 from __future__ import annotations
 
-import math
-from typing import Any, Iterable, Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -30,7 +29,6 @@ from .prog import (
     K_OBJ,
     K_STR,
     K_TRUE,
-    ObjSlotSpec,
     Program,
 )
 

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field, replace as dc_replace
-from typing import Any, Optional
+from typing import Optional
 
 import numpy as np
 

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..ops.strtab import MatchTables, StringTable, canon_num
 from .features import _MISSING, _bucket, _descend_fields, _entries, kind_of
-from .prog import K_ABSENT, K_ARR, K_FALSE, K_NUM, K_OBJ, K_STR, K_TRUE, Program
+from .prog import K_ARR, K_FALSE, K_NUM, K_OBJ, K_STR, K_TRUE, Program
 
 
 class ParamEncodeError(Exception):

@@ -21,7 +21,7 @@ the vocab is large enough to matter.
 from __future__ import annotations
 
 import re
-from typing import Any, Iterable, Optional
+from typing import Any, Iterable
 
 import numpy as np
 

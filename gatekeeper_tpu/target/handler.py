@@ -12,7 +12,7 @@ Rego then mis-splits — is unnecessary here).
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Optional, Tuple
 
 from ..client.types import Result

@@ -23,7 +23,7 @@ sprintf("%v") output of sets.
 
 from __future__ import annotations
 
-from typing import Any, Iterable
+from typing import Any
 
 
 class FrozenDict(dict):
