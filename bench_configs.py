@@ -547,6 +547,40 @@ def config5():
             n_eng += len(b)
     engine_rps = n_eng / (time.time() - t0)
 
+    # the same pre-batched reviews over the REAL gRPC wire (the
+    # production comm backend at the Driver seam): adds JSON + protobuf
+    # framing and the localhost round-trip
+    grpc_rps = None
+    server = rc = None
+    try:
+        from gatekeeper_tpu.service import RemoteClient, make_server
+
+        server, port = make_server(client=client)
+        server.start()
+        rc = RemoteClient(f"127.0.0.1:{port}")
+        # plain review dicts ride the "raw" wire path, so the server
+        # evaluates byte-identical reviews to the engine tier — the
+        # delta between the two numbers is wire framing + RPC, nothing
+        # else
+        for wb in driver_batches:  # warm the wire path
+            rc.review_batch(wb)
+        n_wire = 0
+        t0 = time.time()
+        while time.time() - t0 < 3.0:
+            for wb in driver_batches:
+                rc.review_batch(wb)
+                n_wire += len(wb)
+        grpc_rps = n_wire / (time.time() - t0)
+    except Exception as e:
+        grpc_rps = f"unavailable: {e}"[:120]
+    finally:
+        # leaked server/channel threads would skew every later tier;
+        # stop() returns an event — WAIT for teardown to finish
+        if rc is not None:
+            rc.close()
+        if server is not None:
+            server.stop(grace=None).wait(timeout=30)
+
     # --- 2. batcher closed-loop (BENCH_r04 continuity): 64 in-process
     # threads through batcher.submit — no HTTP, measures the engine +
     # micro-batching frontier sharing one GIL with its clients
@@ -651,6 +685,9 @@ def config5():
         "host_cores": cores,
         "workers": n_workers,
         "engine_batched_reviews_per_sec": round(engine_rps),
+        "grpc_batched_reviews_per_sec": (round(grpc_rps)
+                                         if isinstance(grpc_rps, float)
+                                         else grpc_rps),
         "batcher_closed_loop": closed_loop,
         "tiers_note": "engine = pre-batched driver.review_batch (the "
                       "gRPC pre-batched ingest path); closed_loop = "

@@ -34,6 +34,7 @@ from .types import (
     ClientError,
     ErrorMap,
     MissingTemplateError,
+    Response,
     Responses,
     UnrecognizedConstraintError,
 )
@@ -341,6 +342,50 @@ class Client:
         if errs:
             raise ClientError(str(errs))
         return responses
+
+    def review_batch(self, objs: list, tracing: bool = False
+                     ) -> list[Responses]:
+        """Batched Review: per-object semantics identical to review(),
+        with the driver's vectorized review_batch amortizing evaluation
+        across the whole batch when available (the gRPC ReviewBatch RPC
+        and any bulk caller land here). Tracing and drivers without a
+        batch entry point fall back to per-object review."""
+        driver_batch = getattr(self.driver, "review_batch", None)
+        with self._lock:
+            if tracing or driver_batch is None:
+                return [self._review_locked(o, tracing) for o in objs]
+            out = [Responses() for _ in objs]
+            for name, handler in self.targets.items():
+                reviews: list = []
+                idxs: list[int] = []
+                errs = ErrorMap()
+                for i, obj in enumerate(objs):
+                    try:
+                        handled, review = handler.handle_review(obj)
+                    except Exception as e:
+                        errs[name] = e
+                        continue
+                    if handled:
+                        reviews.append(review)
+                        idxs.append(i)
+                if errs:
+                    # same contract as review(): an unhandleable object
+                    # fails the call (the wire envelope carries it)
+                    raise ClientError(str(errs))
+                try:
+                    batches = driver_batch(name, reviews)
+                    for i, results in zip(idxs, batches):
+                        memo: dict = {}
+                        for r in results:
+                            handler.handle_violation(r, memo)
+                        resp = Response(results=results)
+                        resp.target = name
+                        out[i].by_target[name] = resp
+                except Exception as e:
+                    # same envelope as review(): evaluation AND
+                    # violation-handling failures surface as ClientError
+                    raise ClientError(str(ErrorMap({name: e}))) from e
+            return out
 
     def audit(self, tracing: bool = False) -> Responses:
         with self._lock:

@@ -1256,6 +1256,13 @@ class TpuDriver(RegoDriver):
         by_kind: dict[str, list[dict]] = {}
         for c in constraints:
             by_kind.setdefault(c.get("kind"), []).append(c)
+        # results accumulate per (review, constraint) and assemble in
+        # GLOBAL constraint order at the end, so a review's result list
+        # is ordered exactly as the per-review violation query orders it
+        # (_eval_violation: per constraint, autoreject then evals) — a
+        # batched Review must not be distinguishable by result order
+        auto: dict[tuple[int, int], Result] = {}
+        acc: dict[tuple[int, int], list] = {}
         for r, review in enumerate(reviews):
             for c in constraints:
                 spec = c.get("spec")
@@ -1263,14 +1270,14 @@ class TpuDriver(RegoDriver):
                 match = spec.get("match")
                 match = match if isinstance(match, dict) else {}
                 if needs_autoreject(match, review, lookup_ns):
-                    out[r].append(Result(
+                    auto[(r, id(c))] = Result(
                         msg="Namespace is not cached in OPA.",
                         metadata={"details": {}},
                         constraint=thaw(freeze(c)),
                         review=review,
                         enforcement_action=spec.get("enforcementAction")
                         or "deny",
-                    ))
+                    )
         import time as _time
 
         batch_frz: dict = {}  # id(review) -> frozen, shared across kinds
@@ -1341,11 +1348,19 @@ class TpuDriver(RegoDriver):
                 spec = constraint.get("spec")
                 spec = spec if isinstance(spec, dict) else {}
                 enforcement = spec.get("enforcementAction") or "deny"
-                out[r].extend(self._eval_template_violations(
-                    target, constraint, reviews[r], enforcement, inventory,
-                    None))
+                acc.setdefault((r, id(constraint)), []).extend(
+                    self._eval_template_violations(
+                        target, constraint, reviews[r], enforcement,
+                        inventory, None))
             if t0 is not None and pairs:
                 host_s = _time.time() - t0
                 if host_s > 0:
                     self._observe("_host_pair_rate", len(pairs) / host_s)
+        for r in range(len(reviews)):
+            for c in constraints:
+                key = (r, id(c))
+                a = auto.get(key)
+                if a is not None:
+                    out[r].append(a)
+                out[r].extend(acc.get(key, ()))
         return out

@@ -145,13 +145,13 @@ class PolicyService:
 
     def review_batch(self, req: dict) -> dict:
         """Batched admission: one RPC, many reviews — the micro-batcher's
-        wire form (amortizes RPC + device dispatch overhead)."""
+        wire form. Routes through Client.review_batch so the driver's
+        vectorized evaluation amortizes the whole batch (per-item
+        Client.review here forfeited the batching the RPC exists for)."""
         tracing = bool(req.get("tracing"))
-        out = []
-        for item in req.get("reviews", []):
-            resps = self.client.review(_wrap_review(item), tracing=tracing)
-            out.append(responses_to_wire(resps))
-        return {"responses": out}
+        objs = [_wrap_review(item) for item in req.get("reviews", [])]
+        resps = self.client.review_batch(objs, tracing=tracing)
+        return {"responses": [responses_to_wire(r) for r in resps]}
 
     def audit(self, req: dict) -> dict:
         return responses_to_wire(
