@@ -363,7 +363,9 @@ class Client:
                     try:
                         handled, review = handler.handle_review(obj)
                     except Exception as e:
-                        errs[name] = e
+                        # keyed per batch index: several bad objects in
+                        # one batch must all be reported, with positions
+                        errs[f"{name}[{i}]"] = e
                         continue
                     if handled:
                         reviews.append(review)

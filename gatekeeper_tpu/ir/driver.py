@@ -1263,6 +1263,7 @@ class TpuDriver(RegoDriver):
         # batched Review must not be distinguishable by result order
         auto: dict[tuple[int, int], Result] = {}
         acc: dict[tuple[int, int], list] = {}
+        touched: dict[int, set] = {}  # review -> constraint ids with results
         for r, review in enumerate(reviews):
             for c in constraints:
                 spec = c.get("spec")
@@ -1270,6 +1271,7 @@ class TpuDriver(RegoDriver):
                 match = spec.get("match")
                 match = match if isinstance(match, dict) else {}
                 if needs_autoreject(match, review, lookup_ns):
+                    touched.setdefault(r, set()).add(id(c))
                     auto[(r, id(c))] = Result(
                         msg="Namespace is not cached in OPA.",
                         metadata={"details": {}},
@@ -1348,19 +1350,25 @@ class TpuDriver(RegoDriver):
                 spec = constraint.get("spec")
                 spec = spec if isinstance(spec, dict) else {}
                 enforcement = spec.get("enforcementAction") or "deny"
-                acc.setdefault((r, id(constraint)), []).extend(
-                    self._eval_template_violations(
-                        target, constraint, reviews[r], enforcement,
-                        inventory, None))
+                res = self._eval_template_violations(
+                    target, constraint, reviews[r], enforcement,
+                    inventory, None)
+                if res:
+                    touched.setdefault(r, set()).add(id(constraint))
+                    acc.setdefault((r, id(constraint)), []).extend(res)
             if t0 is not None and pairs:
                 host_s = _time.time() - t0
                 if host_s > 0:
                     self._observe("_host_pair_rate", len(pairs) / host_s)
-        for r in range(len(reviews)):
-            for c in constraints:
-                key = (r, id(c))
-                a = auto.get(key)
+        # assemble per review over only the POPULATED constraints (the
+        # full reviews x constraints cross product would add an O(R*C)
+        # Python pass to the audit-scale hot path), ordered by global
+        # constraint position to match the per-review violation query
+        order = {id(c): k for k, c in enumerate(constraints)}
+        for r, cids in touched.items():
+            for cid in sorted(cids, key=order.__getitem__):
+                a = auto.get((r, cid))
                 if a is not None:
                     out[r].append(a)
-                out[r].extend(acc.get(key, ()))
+                out[r].extend(acc.get((r, cid), ()))
         return out

@@ -71,6 +71,21 @@ class Gen:
             bound.append(c)
         if self.r.random() < 0.4:
             lits.append(f"startswith({v}, {self.r.choice(STRS)})")
+        if self.r.random() < 0.35:
+            # round-5 builtin tail over arbitrary-typed bound values:
+            # most raise BuiltinError on non-string/number inputs, and
+            # the literal going UNDEFINED identically in interpreter
+            # and codegen is the contract worth fuzzing
+            lits.append(self.r.choice([
+                f'glob.quote_meta({v}) != ""',
+                f"time.parse_duration_ns({v}) >= 0",
+                f'net.cidr_contains("10.0.0.0/8", {v})',
+                f'regex.globs_match({v}, "a*")',
+                f'regex.template_match("u:{{.*}}", {v}, "{{", "}}")',
+                f"lt({v}, 5)",
+                f"rem(to_number({v}), 3) == 0",
+                f"not gte({v}, 100)",
+            ]))
         m = self.var()
         w = self.r.sample(bound, min(len(bound), 2))
         fmt = "%v-" * len(w)
