@@ -7,9 +7,12 @@ One JSON line per config:
   #2 full shipped general library x 10k mixed objects — full audit
   #3 full shipped pod-security-policy library x 50k Pods (regex-heavy)
      — full audit
-  #5 streaming admission through the MicroBatcher vs the FULL general
-     library — sustained requests/s and p50/p99 latency under 64
-     closed-loop concurrent clients
+  #5 streaming admission vs the FULL general library, in tiers:
+     pre-batched engine throughput (driver.review_batch), the same
+     batches over the real gRPC wire (ReviewBatch RPC), the 64-client
+     closed-loop micro-batcher harness, and an OPEN-LOOP multi-process
+     HTTP sweep against the real webhook server (plus an SO_REUSEPORT
+     multi-worker group when cores allow)
 
 All audits run steady-state through client.audit() (warm caches), same
 contract as bench.py. Run: python bench_configs.py [1 2 3 5]
