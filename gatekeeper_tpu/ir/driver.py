@@ -155,14 +155,33 @@ def enable_compile_cache() -> None:
 
     import jax
 
-    path = os.environ.get("GATEKEEPER_TPU_COMPILE_CACHE",
-                          os.path.join(os.path.expanduser("~"),
-                                       ".cache", "gatekeeper_tpu_xla"))
     try:
+        # threshold knobs apply wherever the cache lives (respecting an
+        # explicit env override of the compile-time floor)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.5)
+        env_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        if env_dir:
+            # the operator chose the location. JAX only reads this env
+            # var at import time — a sitecustomize jax preimport makes
+            # later os.environ writes silently no-ops — so re-apply it
+            if jax.config.jax_compilation_cache_dir != env_dir:
+                os.makedirs(env_dir, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir", env_dir)
+            return
+        path = os.environ.get("GATEKEEPER_TPU_COMPILE_CACHE")
+        if not path:
+            # per-platform default: a CPU process reloading AOT results
+            # compiled for the TPU host (or vice versa) warns about
+            # machine mismatches and risks SIGILL on feature-gated code.
+            # (An operator-named dir is used exactly as given.)
+            path = os.path.join(os.path.expanduser("~"), ".cache",
+                                "gatekeeper_tpu_xla",
+                                jax.default_backend())
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:  # pragma: no cover - cache is best-effort
         pass
 
