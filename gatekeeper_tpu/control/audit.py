@@ -89,8 +89,16 @@ class AuditManager:
         for action, count in by_action.items():
             metrics.report_violations(action, count)
         self.last_results = results
-        log.info("audit complete", details={
-            "violations": len(results), "duration_s": round(dt, 3)})
+        details = {"violations": len(results), "duration_s": round(dt, 3)}
+        driver = getattr(self.opa, "driver", None)
+        if hasattr(driver, "warm_status"):
+            st = driver.warm_status()
+            metrics.report_device_programs(st["warm"], st["compiling"])
+            details["device_programs"] = st
+            path = getattr(driver, "last_audit_path", None)
+            if path:
+                details["audit_path"] = path
+        log.info("audit complete", details=details)
         return results
 
     def _audit_resources(self) -> list:

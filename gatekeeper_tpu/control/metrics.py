@@ -224,6 +224,17 @@ def report_device_demotion(kind: str, reason: str) -> None:
                          "stay 0 in steady state)", kind=kind, reason=reason)
 
 
+def report_device_programs(warm: int, compiling: int) -> None:
+    REGISTRY.gauge_set("gatekeeper_tpu_device_programs_warm",
+                       "Device sweep programs whose XLA compilation has "
+                       "completed (audits at these shapes run on the "
+                       "device)", warm)
+    REGISTRY.gauge_set("gatekeeper_tpu_device_programs_compiling",
+                       "Device sweep programs currently compiling in the "
+                       "background (audits serve from the host meanwhile)",
+                       compiling)
+
+
 def report_watch_manager(gvk_count: int, intended: int) -> None:
     REGISTRY.gauge_set("watch_manager_watched_gvk",
                        "Total number of watched GroupVersionKinds",

@@ -1223,6 +1223,8 @@ class TpuDriver(RegoDriver):
         import time as _time
 
         use_mesh = self._mesh_shardable(len(cand_reviews))
+        if use_mesh:
+            self._batch_used_mesh = True
         feats, enc, table, derived = self._prepare_eval(
             ct, kind, cand_reviews, cons, feat_key=None, mesh=use_mesh)
         if self.async_warm:
@@ -1275,6 +1277,7 @@ class TpuDriver(RegoDriver):
         by_kind: dict[str, list[dict]] = {}
         for c in constraints:
             by_kind.setdefault(c.get("kind"), []).append(c)
+        self._batch_used_mesh = False
         # results accumulate per (review, constraint) and assemble in
         # GLOBAL constraint order at the end, so a review's result list
         # is ordered exactly as the per-review violation query orders it
@@ -1390,4 +1393,9 @@ class TpuDriver(RegoDriver):
                 if a is not None:
                     out[r].append(a)
                 out[r].extend(acc.get((r, cid), ()))
+        # observability parity with _eval_audit: discovery-mode audits
+        # flow through here, and their log lines report the path too
+        self.last_audit_path = (
+            f"mesh(data={self._mesh.shape['data']})"
+            if self._batch_used_mesh else "single")
         return out

@@ -490,7 +490,8 @@ def test_metrics_rendered(runtime):
     text = REGISTRY.render()
     for name in ("violations", "audit_duration_seconds", "audit_last_run_time",
                  "request_count", "request_duration_seconds", "constraints",
-                 "constraint_templates"):
+                 "constraint_templates",
+                 "gatekeeper_tpu_device_programs_warm"):
         assert name in text, f"metric {name} missing"
 
 
