@@ -95,7 +95,10 @@ class AuditManager:
             st = driver.warm_status()
             metrics.report_device_programs(st["warm"], st["compiling"])
             details["device_programs"] = st
-            path = getattr(driver, "last_audit_path", None)
+            path = getattr(
+                driver,
+                "last_audit_path" if self.audit_from_cache
+                else "last_review_batch_path", None)
             if path:
                 details["audit_path"] = path
         log.info("audit complete", details=details)

@@ -463,8 +463,20 @@ class ControllerManager:
             time.sleep(0.002)
 
     def stop(self) -> None:
-        for w in (self.template_ctrl.worker, self.constraint_ctrl.worker,
-                  self.sync_ctrl.worker, self.config_ctrl.worker):
+        workers = (self.template_ctrl.worker, self.constraint_ctrl.worker,
+                   self.sync_ctrl.worker, self.config_ctrl.worker)
+        for w in workers:
             w.stop()
+        # JOIN before teardown: a worker mid-get() still delivers one
+        # last event, and a template reconcile racing the finalizer
+        # scrub would re-add what teardown just removed. Generous
+        # timeout — a reconcile stuck in status-update retries must get
+        # a chance to finish; if it still hasn't, proceed loudly (best-
+        # effort teardown beats hanging the shutdown forever)
+        for w in workers:
+            w.join(timeout=15.0)
+            if w._thread.is_alive():
+                log.error(f"worker {w.name} still running at shutdown; "
+                          "finalizer teardown may race it")
         self.template_ctrl.teardown()
         self.wm.stop()

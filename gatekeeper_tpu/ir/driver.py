@@ -1223,8 +1223,6 @@ class TpuDriver(RegoDriver):
         import time as _time
 
         use_mesh = self._mesh_shardable(len(cand_reviews))
-        if use_mesh:
-            self._batch_used_mesh = True
         feats, enc, table, derived = self._prepare_eval(
             ct, kind, cand_reviews, cons, feat_key=None, mesh=use_mesh)
         if self.async_warm:
@@ -1251,6 +1249,10 @@ class TpuDriver(RegoDriver):
             keep = mask[cand[rows], cols]
             pairs.extend(zip((int(x) for x in cand[rows[keep]]),
                              (int(x) for x in cols[keep])))
+        if use_mesh:
+            # only after the sweep actually completed on the mesh — a
+            # warm-gate bailout or demotion must not report a mesh path
+            self._batch_used_mesh = True
         return pairs
 
     def _observe(self, attr: str, value: float, alpha: float = 0.3) -> None:
@@ -1393,9 +1395,11 @@ class TpuDriver(RegoDriver):
                 if a is not None:
                     out[r].append(a)
                 out[r].extend(acc.get((r, cid), ()))
-        # observability parity with _eval_audit: discovery-mode audits
-        # flow through here, and their log lines report the path too
-        self.last_audit_path = (
+        # observability parity with _eval_audit — on a SEPARATE field:
+        # webhook micro-batches also land here, and they must not
+        # clobber last_audit_path (the cached audit's record) between
+        # an audit finishing and its log line reading the field
+        self.last_review_batch_path = (
             f"mesh(data={self._mesh.shape['data']})"
             if self._batch_used_mesh else "single")
         return out

@@ -723,3 +723,22 @@ def test_audit_from_cache_sweeps_synced_inventory_only():
         assert stored["status"]["totalViolations"] == len(names)
     finally:
         rt.stop()
+
+
+def test_teardown_scrubs_finalizers_on_shutdown(runtime):
+    """TearDownState analog (reference main.go:221-246 +
+    constrainttemplate_controller.go:466-556): graceful shutdown removes
+    the gatekeeper finalizer from every template so etcd objects are
+    deletable after the controller is gone."""
+    from gatekeeper_tpu.control.controllers import FINALIZER
+
+    kube = runtime.kube
+    kube.create(TEMPLATE)
+    runtime.manager.drain()
+    stored = kube.get(TEMPLATE_GVK, "k8srequiredlabels")
+    assert FINALIZER in (stored["metadata"].get("finalizers") or []), \
+        "reconcile must add the finalizer"
+    runtime.stop()
+    stored = kube.get(TEMPLATE_GVK, "k8srequiredlabels")
+    assert FINALIZER not in (stored["metadata"].get("finalizers") or []), \
+        "shutdown must scrub the finalizer"
