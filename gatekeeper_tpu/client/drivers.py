@@ -223,6 +223,20 @@ class RegoDriver:
 
     # ------------------------------------------------ incremental writes
 
+    def drop_inventory_caches(self) -> None:
+        """Full re-encode backstop: forget every derived inventory cache
+        so the next audit rebuilds from the raw data tree. The
+        incremental audit's --audit-full-resync-every routes here — a
+        reachable from-scratch path self-heals any cache-patching bug."""
+        self._data_rev += 1
+        self._patch_notes.append(("break", self._data_rev))
+        self._inv_reviews_cache.clear()
+        self._inv_key_cache.clear()
+        self._sig_cache.clear()
+        self._inv_tree_cache.clear()
+        self._audit_frz = (None, {})
+        self._frz_inv = (None, None)
+
     def _note_inventory_write(self, path: tuple, deleted: bool) -> None:
         notes = self._patch_notes
         if len(notes) >= 1024:

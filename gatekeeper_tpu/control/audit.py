@@ -1,4 +1,4 @@
-"""Audit manager: periodic full-cluster sweeps.
+"""Audit manager: periodic full-cluster sweeps, optionally incremental.
 
 Counterpart of the reference pkg/audit/manager.go, re-designed around the
 batched evaluator. The reference's hot loop lists every object of every
@@ -8,23 +8,36 @@ batched sweep (audit-from-cache) or per-GVK batches (discovery mode), then
 violations are aggregated per constraint (manager.go:337-385) and written
 to constraint status with the violations cap, message truncation, and
 conflict-retry loop (manager.go:428-574).
+
+Incremental mode (--audit-incremental) replaces the per-sweep O(cluster)
+re-list + re-encode with a PERSISTENT encoded inventory: a tracked mirror
+of every auditable object keyed (uid, resourceVersion), fed by streaming
+watches, applied to the driver's synced inventory each sweep so the
+driver's journaled caches patch only the dirty rows (feature tensors,
+match masks, and device buffers stay resident between sweeps). Watch gaps
+fall back to a resourceVersion-diff against a paged re-list; every
+--audit-full-resync-every sweeps the whole inventory re-encodes from
+scratch as a self-healing backstop. Constraint-status writes are also
+delta'd: a constraint whose violation set did not change since its last
+written status is skipped entirely.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from ..client import Client
 from . import metrics
-from .kube import KubeError, NotFound
+from .kube import GVK, KubeError, NotFound, WatchEvent
 from .logging import logger
 
 log = logger("audit")
 
 DEFAULT_AUDIT_INTERVAL = 60  # seconds (reference manager.go:36,41)
 DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT = 20  # manager.go:37,42
+DEFAULT_FULL_RESYNC_EVERY = 20  # incremental sweeps per full re-encode
 MSG_SIZE_LIMIT = 256  # bytes (manager.go:35,437-439)
 CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
 
@@ -35,20 +48,373 @@ _SKIP_KINDS = {"Event", "ComponentStatus", "Endpoints", "EndpointSlice",
                "ConstraintTemplate"}
 
 
+def _auditable_gvks(kube) -> list[GVK]:
+    """Discovery-driven auditable GVK set (same filter as the discovery
+    sweep): listable, not control-plane plumbing, not our own CRs."""
+    out = []
+    for r in kube.server_preferred_resources():
+        if "list" not in (r.get("verbs") or []):
+            continue
+        if r.get("kind") in _SKIP_KINDS:
+            continue
+        if r.get("group") in ("templates.gatekeeper.sh", CONSTRAINT_GROUP):
+            continue
+        out.append((r.get("group") or "", r.get("version") or "",
+                    r.get("kind") or ""))
+    # Namespaces first: their labels feed namespaceSelector matching for
+    # everything else, so the initial encode must see them early
+    out.sort(key=lambda g: (g[2] != "Namespace", g))
+    return out
+
+
+def _obj_key(gvk: GVK, obj: dict) -> tuple:
+    meta = obj.get("metadata") or {}
+    return (tuple(gvk), meta.get("namespace") or "", meta.get("name") or "")
+
+
+def _obj_ver(obj: dict) -> tuple:
+    meta = obj.get("metadata") or {}
+    return (meta.get("uid"), meta.get("resourceVersion"))
+
+
+class InventoryTracker:
+    """Persistent encoded-inventory maintenance for the incremental audit.
+
+    Mirrors the auditable cluster state into the policy client's synced
+    inventory: per-GVK streaming watches accumulate a DIRTY MAP (latest
+    event per object key — bounded by the inventory size, so an event
+    burst collapses instead of queueing unboundedly), and each sweep
+    applies only the delta through client.add_data/remove_data, which the
+    driver's patch journal turns into in-place row patches of its cached
+    feature tensors. A `(uid, resourceVersion)` state map suppresses
+    no-op events and detects delete-then-recreate (same name, new uid).
+
+    GVKs whose watch cannot be established (or that signaled a gap — a
+    410 Gone the client could not bridge, an overflowed stream) fall back
+    to a resourceVersion-diff against a paged re-list on every sweep
+    until the watch heals.
+    """
+
+    def __init__(self, kube, opa: Client):
+        self.kube = kube
+        self.opa = opa
+        self._lock = threading.Lock()
+        self._dirty: dict[tuple, tuple] = {}   # key -> (etype, obj)
+        self._state: dict[tuple, tuple] = {}   # key -> (uid, rv)
+        self._cancels: dict[GVK, Callable[[], None]] = {}
+        self._poll: set[GVK] = set()   # watchless GVKs: re-list per sweep
+        self._gaps: set[GVK] = set()   # one-shot resync requests
+        # consecutive full-resyncs a tracked GVK was absent from
+        # discovery: dropping (and purging its inventory) on the FIRST
+        # absence would let one flaky discovery response evict whole
+        # kinds from the shared inventory
+        self._gvk_missing: dict[GVK, int] = {}
+
+    # ------------------------------------------------------------- watches
+
+    def gvks(self) -> list[GVK]:
+        with self._lock:
+            return sorted(set(self._cancels) | self._poll)
+
+    def set_gvks(self, gvks: list[GVK], resync_new: bool = True) -> None:
+        """Reconcile the watched set; newly added GVKs are subscribed
+        FIRST and then resynced, so no event can fall between the list
+        and the watch (racing duplicates are no-op'd by the state map).
+        full_resync passes resync_new=False — its own re-list seeds the
+        state, so the per-GVK resync here would double-list the cluster."""
+        want = {tuple(g) for g in gvks}
+        with self._lock:
+            have = set(self._cancels) | self._poll
+            drop = have - want
+            add = want - have
+            for g in drop:
+                cancel = self._cancels.pop(g, None)
+                if cancel is not None:
+                    cancel()
+                self._poll.discard(g)
+        for g in sorted(drop):
+            self._forget_gvk(g)
+        for g in sorted(add):
+            self._watch_gvk(g)
+            if resync_new:
+                self.resync(g)
+
+    def _watch_gvk(self, gvk: GVK, quiet: bool = False) -> bool:
+        def deliver(event: WatchEvent, _gvk=gvk):
+            self._note_event(_gvk, event)
+
+        try:
+            cancel = self.kube.watch(gvk, deliver, send_initial=False)
+        except Exception as e:
+            # no stream for this GVK: degrade to per-sweep re-list diff
+            # (the reference's ListerWatcher would relist on 410 Gone);
+            # apply_pending retries the subscription every sweep
+            if not quiet:
+                log.warning("watch unavailable; falling back to "
+                            "per-sweep re-list diff",
+                            details={"gvk": list(gvk), "error": str(e)})
+            with self._lock:
+                self._poll.add(tuple(gvk))
+            return False
+        with self._lock:
+            self._cancels[tuple(gvk)] = cancel
+            self._poll.discard(tuple(gvk))
+        return True
+
+    def _note_event(self, gvk: GVK, event: WatchEvent) -> None:
+        obj = event.object or {}
+        key = _obj_key(gvk, obj)
+        with self._lock:
+            self._dirty[key] = (event.type, obj)
+
+    def note_gap(self, gvk: GVK) -> None:
+        """External gap signal (watch stream lost beyond the client's
+        own recovery): the next sweep re-list-diffs this GVK."""
+        with self._lock:
+            self._gaps.add(tuple(gvk))
+
+    def _forget_gvk(self, gvk: GVK) -> None:
+        """Remove a no-longer-audited GVK's objects from the inventory."""
+        gvk = tuple(gvk)
+        with self._lock:
+            doomed = [k for k in self._state if k[0] == gvk]
+            pend = [k for k in self._dirty if k[0] == gvk]
+            for k in pend:
+                del self._dirty[k]
+        for key in doomed:
+            self._remove_key(key)
+
+    def _remove_key(self, key: tuple) -> None:
+        gvk, ns, name = key
+        group, version, kind = gvk
+        api_version = version if not group else f"{group}/{version}"
+        stub = {"apiVersion": api_version, "kind": kind,
+                "metadata": {"name": name}}
+        if ns:
+            stub["metadata"]["namespace"] = ns
+        try:
+            self.opa.remove_data(stub)
+        except Exception as e:
+            # keep the key tracked and requeue the delete: forgetting it
+            # here would orphan the object in the shared inventory with
+            # nothing left to retry (full resyncs only delete TRACKED
+            # keys, and the data tree is never wiped by design)
+            with self._lock:
+                self._state.setdefault(key, (None, None))
+                self._dirty.setdefault(key, ("DELETED", stub))
+            log.error("inventory remove failed; delete retried next "
+                      "sweep", details={"key": str(key), "error": str(e)})
+            return
+        with self._lock:
+            self._state.pop(key, None)
+
+    # -------------------------------------------------------------- deltas
+
+    def resync(self, gvk: GVK) -> None:
+        """resourceVersion-diff against a fresh (paged, when the client
+        pages) re-list: objects whose (uid, resourceVersion) differ from
+        the tracked state become dirty, tracked objects missing from the
+        list become deletes. The watch-gap / 410 Gone fallback.
+
+        Relist semantics: pending dirty events that PREdate the list are
+        superseded by it (a stale MODIFIED for an object the list shows
+        deleted must not resurrect it, and vice versa); events that land
+        while the list is in flight overwrite their pre-list entry, are
+        detected by identity, and win over the list."""
+        gvk = tuple(gvk)
+        with self._lock:
+            pre = {k: v for k, v in self._dirty.items() if k[0] == gvk}
+        try:
+            objs = self.kube.list(gvk)
+        except KubeError as e:
+            log.error("resync list failed; keeping stale state this "
+                      "sweep", details={"gvk": list(gvk), "error": str(e)})
+            return
+        seen = set()
+        with self._lock:
+            for k, v in pre.items():
+                if self._dirty.get(k) is v:  # unchanged during the list
+                    del self._dirty[k]
+            for o in objs:
+                key = _obj_key(gvk, o)
+                seen.add(key)
+                if key in self._dirty:
+                    continue  # raced in mid-list: newer than the list
+                if self._state.get(key) != _obj_ver(o):
+                    self._dirty[key] = ("MODIFIED", o)
+            for key in self._state:
+                if key[0] == gvk and key not in seen and \
+                        key not in self._dirty:
+                    gone = {"metadata": {"namespace": key[1] or None,
+                                         "name": key[2]}}
+                    self._dirty[key] = ("DELETED", gone)
+
+    def apply_pending(self) -> dict:
+        """Drain the dirty map into the client's synced inventory.
+        Returns {"dirty": applied-change count, "total": tracked size}."""
+        with self._lock:
+            polls = sorted(self._poll)
+            gaps = sorted(self._gaps | self._poll)
+            self._gaps.clear()
+        for g in polls:
+            # retry the stream each sweep (quietly) so a transient blip
+            # at subscribe time does not pin the GVK to O(cluster)
+            # re-lists forever; the resync below bridges the gap up to
+            # the moment the new watch attached
+            self._watch_gvk(g, quiet=True)
+        for g in gaps:
+            self.resync(g)
+        with self._lock:
+            drained = self._dirty
+            self._dirty = {}
+        applied = 0
+        for key, (etype, obj) in sorted(drained.items()):
+            if etype == "DELETED":
+                if key in self._state:
+                    self._remove_key(key)
+                    applied += 1
+                continue
+            ver = _obj_ver(obj)
+            if self._state.get(key) == ver:
+                continue  # no-op event (or our own resync echo)
+            try:
+                self.opa.add_data(obj)
+            except Exception as e:
+                # requeue so the NEXT sweep retries — dropping the
+                # drained entry would silently lose the delta until the
+                # full-resync backstop
+                with self._lock:
+                    self._dirty.setdefault(key, (etype, obj))
+                log.error("inventory add failed; object retried next "
+                          "sweep", details={"key": str(key),
+                                            "error": str(e)})
+                continue
+            with self._lock:
+                self._state[key] = ver
+            applied += 1
+        with self._lock:
+            total = len(self._state)
+        return {"dirty": applied, "total": total}
+
+    def full_resync(self, gvks: list[GVK]) -> dict:
+        """From-scratch re-encode: re-list every auditable GVK (in the
+        given order — Namespaces first, so selector lookups resolve as
+        the rebuild progresses), overwrite every tracked object in
+        place, and delete whatever the tracker knew that no list
+        returned. The synced inventory is NOT wiped: other writers
+        co-own it (the config controller's syncOnly kinds feed the same
+        tree), and admission served from this client must never observe
+        a mid-rebuild empty inventory. Divergence in anything the
+        tracker tracks is healed (the --audit-full-resync-every
+        backstop); foreign inventory data is left alone by design.
+
+        A tracked GVK absent from `gvks` is only dropped after TWO
+        consecutive absences: discovery is served per API group and one
+        transient group failure must not purge whole kinds from the
+        shared inventory for the next resync period."""
+        want = {tuple(g) for g in gvks}
+        keep: list[GVK] = [tuple(g) for g in gvks]
+        for g in self.gvks():
+            if g in want:
+                continue
+            misses = self._gvk_missing.get(g, 0) + 1
+            if misses < 2:
+                self._gvk_missing[g] = misses
+                keep.append(g)  # benefit of the doubt this round
+            else:
+                self._gvk_missing.pop(g, None)
+        for g in want:
+            self._gvk_missing.pop(g, None)
+        gvks = keep
+        self.set_gvks(gvks, resync_new=False)
+        with self._lock:
+            old_state = dict(self._state)
+            self._gaps.clear()
+        tracked = set(self.gvks())
+        state: dict[tuple, tuple] = {}
+        n = 0
+        for gvk in gvks:
+            gvk = tuple(gvk)
+            if gvk not in tracked:
+                continue
+            with self._lock:
+                pre = {k: v for k, v in self._dirty.items()
+                       if k[0] == gvk}
+            try:
+                objs = self.kube.list(gvk)
+            except KubeError:
+                # no list, no delete detection: keep this GVK's old
+                # state so its objects are not orphaned in the inventory
+                # (its PENDING events also survive — clearing them
+                # before a successful list would lose mutations the
+                # watch stream has already moved past)
+                state.update({k: v for k, v in old_state.items()
+                              if k[0] == gvk})
+                continue
+            with self._lock:
+                # the list supersedes this GVK's pre-list event backlog
+                # (same relist semantics as resync); mid-list arrivals
+                # overwrote their entry and survive for the next sweep
+                for k, v in pre.items():
+                    if self._dirty.get(k) is v:
+                        del self._dirty[k]
+            for o in objs:
+                try:
+                    self.opa.add_data(o)
+                except Exception:
+                    # transient write failure for a live object must
+                    # NOT turn into a deletion below: keep it tracked
+                    # at its old version so a later event/resync
+                    # re-applies it
+                    key = _obj_key(gvk, o)
+                    if key in old_state:
+                        state[key] = old_state[key]
+                    continue
+                state[_obj_key(gvk, o)] = _obj_ver(o)
+                n += 1
+        with self._lock:
+            self._state = state
+            # events raced during the rebuild stay dirty and re-apply
+            # next sweep; rv no-op suppression keeps that cheap
+            total = len(state)
+        for key in old_state:
+            if key not in state:
+                self._remove_key(key)
+                n += 1
+        return {"dirty": n, "total": total}
+
+    def stop(self) -> None:
+        with self._lock:
+            cancels = list(self._cancels.values())
+            self._cancels.clear()
+            self._poll.clear()
+        for cancel in cancels:
+            cancel()
+
+
 class AuditManager:
     def __init__(self, kube, opa: Client,
                  interval: float = DEFAULT_AUDIT_INTERVAL,
                  constraint_violations_limit: int =
                  DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT,
-                 audit_from_cache: bool = False):
+                 audit_from_cache: bool = False,
+                 incremental: bool = False,
+                 full_resync_every: int = DEFAULT_FULL_RESYNC_EVERY):
         self.kube = kube
         self.opa = opa
         self.interval = interval
         self.limit = constraint_violations_limit
         self.audit_from_cache = audit_from_cache
+        self.incremental = incremental
+        # N <= 0 disables the PERIODIC re-encode (k8s resync-period
+        # convention); the first sweep always encodes from scratch
+        self.full_resync_every = full_resync_every
+        self.tracker: Optional[InventoryTracker] = None
+        self._sweeps = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last_results: list = []
+        self.last_sweep_stats: dict = {}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -59,6 +425,8 @@ class AuditManager:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.tracker is not None:
+            self.tracker.stop()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -72,13 +440,26 @@ class AuditManager:
 
     def audit_once(self) -> list:
         t0 = time.time()
-        if self.audit_from_cache:
+        sweep_stats: dict = {}
+        if self.incremental:
+            results, sweep_stats = self._audit_incremental()
+        elif self.audit_from_cache:
             # one vectorized sweep over the synced inventory
             results = self.opa.audit().results()
+            metrics.report_audit_sweep("full")
         else:
             results = self._audit_resources()
+            metrics.report_audit_sweep("full")
         by_constraint = self._group_by_constraint(results)
-        self._write_audit_results(by_constraint)
+        # delta'd status writes are an INCREMENTAL-mode behavior: the
+        # discovery and from-cache modes keep upstream semantics (every
+        # sweep rewrites every status, refreshing auditTimestamp). In
+        # incremental mode, full-resync sweeps force every write so the
+        # timestamp still refreshes every full_resync_every intervals
+        writes = self._write_audit_results(
+            by_constraint,
+            force=not self.incremental
+            or sweep_stats.get("sweep") == "full_resync")
         dt = time.time() - t0
         metrics.report_audit_duration(dt)
         metrics.report_audit_last_run()
@@ -89,7 +470,9 @@ class AuditManager:
         for action, count in by_action.items():
             metrics.report_violations(action, count)
         self.last_results = results
-        details = {"violations": len(results), "duration_s": round(dt, 3)}
+        self.last_sweep_stats = sweep_stats
+        details = {"violations": len(results), "duration_s": round(dt, 3),
+                   **sweep_stats, **writes}
         driver = getattr(self.opa, "driver", None)
         if hasattr(driver, "warm_status"):
             st = driver.warm_status()
@@ -97,12 +480,49 @@ class AuditManager:
             details["device_programs"] = st
             path = getattr(
                 driver,
-                "last_audit_path" if self.audit_from_cache
+                "last_audit_path"
+                if (self.audit_from_cache or self.incremental)
                 else "last_review_batch_path", None)
             if path:
                 details["audit_path"] = path
         log.info("audit complete", details=details)
         return results
+
+    def _audit_incremental(self) -> tuple[list, dict]:
+        """Delta sweep: apply the tracker's pending adds/updates/deletes
+        to the persistent encoded inventory (the driver patches only the
+        dirty rows), then run the vectorized cached audit. Every
+        full_resync_every-th sweep re-encodes everything from scratch."""
+        driver = getattr(self.opa, "driver", None)
+        strtab = getattr(driver, "strtab", None)
+        snap = strtab.snapshot() if strtab is not None else None
+        if self.tracker is None:
+            self.tracker = InventoryTracker(self.kube, self.opa)
+        full = self._sweeps == 0 or (
+            self.full_resync_every > 0
+            and self._sweeps % self.full_resync_every == 0)
+        self._sweeps += 1
+        t0 = time.time()
+        if full:
+            # drop BEFORE re-adding: with warm caches every re-add would
+            # run the per-object patch machinery whose work the drop
+            # then discards; cold caches make each write an early return
+            if hasattr(driver, "drop_inventory_caches"):
+                driver.drop_inventory_caches()
+            stats = self.tracker.full_resync(_auditable_gvks(self.kube))
+            metrics.report_audit_sweep("full_resync")
+        else:
+            stats = self.tracker.apply_pending()
+            metrics.report_audit_sweep("incremental")
+        sync_s = time.time() - t0
+        results = self.opa.audit().results()
+        grown = strtab.grown_since(snap) if strtab is not None else 0
+        metrics.report_audit_dirty(stats["dirty"], stats["total"], grown)
+        return results, {
+            "sweep": "full_resync" if full else "incremental",
+            "dirty": stats["dirty"], "inventory": stats["total"],
+            "sync_s": round(sync_s, 3), "vocab_grown": grown,
+        }
 
     def _audit_resources(self) -> list:
         """Discovery-driven sweep: list every listable GVK and feed the
@@ -110,13 +530,6 @@ class AuditManager:
         reference reviews one object at a time here)."""
         from ..target.handler import AugmentedUnstructured
 
-        resources = [r for r in self.kube.server_preferred_resources()
-                     if "list" in (r.get("verbs") or [])
-                     and r.get("kind") not in _SKIP_KINDS
-                     and r.get("group") not in ("templates.gatekeeper.sh",
-                                                CONSTRAINT_GROUP)]
-        resources.sort(key=lambda r: (r.get("kind") != "Namespace",
-                                      r.get("group") or "", r.get("kind")))
         # stage all live objects into a scratch audit client: reuse the
         # driver's vectorized audit over inventory (external data paths)
         results = []
@@ -124,13 +537,13 @@ class AuditManager:
         # listed Namespaces, sideloaded onto each namespaced review so
         # namespaceSelector constraints resolve from the live cluster
         # state — NOT just synced inventory (reference wraps every object
-        # as AugmentedUnstructured{obj, ns}, manager.go:250-271); the
-        # sort above lists Namespaces first so the map is complete before
-        # any namespaced object is staged
+        # as AugmentedUnstructured{obj, ns}, manager.go:250-271);
+        # _auditable_gvks (shared with the incremental tracker) lists
+        # Namespaces first so the map is complete before any namespaced
+        # object is staged
         ns_by_name: dict[str, dict] = {}
         saw_ns_kind = False
-        for res in resources:
-            gvk = (res["group"], res["version"], res["kind"])
+        for gvk in _auditable_gvks(self.kube):
             try:
                 objs = self.kube.list(gvk)
             except KubeError:
@@ -232,13 +645,22 @@ class AuditManager:
             grouped.setdefault(key, []).append(r)
         return grouped
 
-    def _write_audit_results(self, by_constraint: dict[tuple, list]) -> None:
+    def _write_audit_results(self, by_constraint: dict[tuple, list],
+                             force: bool = False) -> dict:
         """status.byPod[audit] style update with cap + truncation + retry
         (manager.go:428-574). Constraints with no violations this run get
-        their violation list cleared."""
+        their violation list cleared — but a constraint whose CURRENT
+        status (fresh from the list) already carries exactly the
+        violation set this sweep would publish is skipped, so a
+        steady-state sweep issues O(changed constraints) PATCHes, not
+        O(constraints). Comparing against the live status (not a local
+        fingerprint) means an externally clobbered status self-heals on
+        the next sweep. `force` writes everything (full-resync sweeps
+        use it to refresh auditTimestamp periodically)."""
         target_kinds = set()
         for kind in self.opa.template_kinds():
             target_kinds.add(kind)
+        written = skipped = 0
         for kind in sorted(target_kinds):
             gvk = (CONSTRAINT_GROUP, "v1beta1", kind)
             try:
@@ -248,34 +670,57 @@ class AuditManager:
             for obj in constraints:
                 name = (obj.get("metadata") or {}).get("name") or ""
                 violations = by_constraint.get((kind, name), [])
-                self._update_constraint_status(obj, violations)
+                entries = self._status_entries(violations)
+                cur = obj.get("status") or {}
+                if not force and \
+                        cur.get("totalViolations") == len(violations) \
+                        and (cur.get("violations") or []) == entries:
+                    skipped += 1
+                    continue
+                if self._update_constraint_status(obj, entries,
+                                                  len(violations)):
+                    written += 1
+        metrics.report_audit_status_writes(written, skipped)
+        return {"status_writes": written, "status_skipped": skipped}
 
-    def _update_constraint_status(self, obj: dict, violations: list) -> None:
+    def _status_entries(self, violations: list) -> list:
+        """The capped, truncated violation entries a status write
+        publishes for this violation set. None-valued fields are
+        OMITTED, not written as nulls: a real apiserver's structural-
+        schema pruning drops nulls on write, and the skip-unchanged
+        comparison must match what reads back."""
         entries = []
         for r in violations[: self.limit]:
             res = r.resource or {}
             meta = res.get("metadata") or {}
             msg = r.msg
             if len(msg.encode()) > MSG_SIZE_LIMIT:
-                msg = msg.encode()[:MSG_SIZE_LIMIT].decode("utf-8", "ignore")
-            entries.append({
+                msg = msg.encode()[:MSG_SIZE_LIMIT].decode("utf-8",
+                                                           "ignore")
+            entry = {
                 "message": msg,
                 "enforcementAction": r.enforcement_action,
                 "kind": res.get("kind"),
                 "name": meta.get("name"),
                 "namespace": meta.get("namespace"),
-            })
+            }
+            entries.append({k: v for k, v in entry.items()
+                            if v is not None})
+        return entries
+
+    def _update_constraint_status(self, obj: dict, entries: list,
+                                  total: int) -> bool:
         status = obj.setdefault("status", {})
         status["auditTimestamp"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-        status["totalViolations"] = len(violations)
+        status["totalViolations"] = total
         status["violations"] = entries
         for attempt in range(5):
             try:
                 self.kube.update(obj, subresource="status")
-                return
+                return True
             except NotFound:
-                return
+                return False
             except KubeError:
                 time.sleep(0.01 * (2 ** attempt))
                 try:
@@ -286,4 +731,5 @@ class AuditManager:
                     cur["status"] = status
                     obj = cur
                 except KubeError:
-                    return
+                    return False
+        return False

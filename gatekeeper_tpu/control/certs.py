@@ -16,13 +16,23 @@ import os
 import threading
 from typing import Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+except ImportError:  # gated: rotation raises, the rest of the control
+    x509 = hashes = serialization = rsa = NameOID = None  # plane runs
 
 from .kube import KubeError, NotFound
 from .logging import logger
+
+
+def _require_crypto() -> None:
+    if x509 is None:
+        raise RuntimeError(
+            "cert rotation requires the 'cryptography' package; install "
+            "it or run with --disable-cert-rotation")
 
 log = logger("cert-rotation")
 
@@ -36,7 +46,8 @@ VWH_GVK = ("admissionregistration.k8s.io", "v1beta1",
            "ValidatingWebhookConfiguration")
 
 
-def _new_key() -> rsa.RSAPrivateKey:
+def _new_key():
+    _require_crypto()
     return rsa.generate_private_key(public_exponent=65537, key_size=2048)
 
 
@@ -92,6 +103,7 @@ def generate_server_cert(ca_key, ca_cert, dns_names: list[str]):
 
 
 def _needs_refresh(cert_pem: bytes) -> bool:
+    _require_crypto()
     try:
         cert = x509.load_pem_x509_certificate(cert_pem)
     except ValueError:

@@ -81,6 +81,18 @@ class FakeKube:
         self._watchers: dict[GVK, list[Callable[[WatchEvent], None]]] = {}
         # discovery: gvk -> {"namespaced": bool, "verbs": [...]}
         self._discovery: dict[GVK, dict] = {}
+        # mutation/list call log, for tests asserting API write counts
+        # (e.g. the audit's delta'd status PATCHes). Bounded: --fake-kube
+        # also backs long-running dev control planes, which must not
+        # accumulate one tuple per API call forever
+        self.calls: list[tuple] = []
+
+    _CALL_LOG_CAP = 100_000
+
+    def _record(self, call: tuple) -> None:
+        if len(self.calls) >= self._CALL_LOG_CAP:
+            del self.calls[: self._CALL_LOG_CAP // 2]
+        self.calls.append(call)
 
     # ------------------------------------------------------------ discovery
 
@@ -111,6 +123,7 @@ class FakeKube:
     def create(self, obj: dict) -> dict:
         with self._lock:
             gvk = gvk_of(obj)
+            self._record(("create", gvk, _key(obj)))
             bucket = self._store.setdefault(gvk, {})
             key = _key(obj)
             if key in bucket:
@@ -134,6 +147,7 @@ class FakeKube:
     def update(self, obj: dict, subresource: str = "") -> dict:
         with self._lock:
             gvk = gvk_of(obj)
+            self._record(("update", gvk, _key(obj), subresource))
             bucket = self._store.setdefault(gvk, {})
             key = _key(obj)
             cur = bucket.get(key)
@@ -167,6 +181,7 @@ class FakeKube:
 
     def delete(self, gvk: GVK, name: str, namespace: str = "") -> None:
         with self._lock:
+            self._record(("delete", tuple(gvk), (namespace, name)))
             bucket = self._store.get(tuple(gvk), {})
             obj = bucket.pop((namespace, name), None)
             if obj is None:
@@ -175,6 +190,7 @@ class FakeKube:
 
     def list(self, gvk: GVK, namespace: Optional[str] = None) -> list[dict]:
         with self._lock:
+            self._record(("list", tuple(gvk), namespace))
             out = []
             for (ns, _), obj in sorted(self._store.get(tuple(gvk), {}).items()):
                 if namespace is None or ns == namespace:
@@ -238,14 +254,20 @@ class RestKubeClient:
                  kubeconfig: Optional[str] = None):
         client_cert: Optional[tuple] = None
         if base_url is None and token is None:
-            # out-of-cluster: honor an explicit kubeconfig (or
-            # $KUBECONFIG / ~/.kube/config) when no in-cluster SA exists
-            cfg = self._load_kubeconfig(kubeconfig)
-            if cfg is not None:
-                base_url = cfg.get("server")
-                token = cfg.get("token")
-                ca_file = ca_file or cfg.get("ca_file")
-                client_cert = cfg.get("client_cert")
+            # precedence: an EXPLICIT kubeconfig (argument or $KUBECONFIG)
+            # wins unconditionally; otherwise a mounted in-cluster service
+            # account wins over the implicit ~/.kube/config default — a
+            # pod must talk to its own apiserver, not whatever cluster a
+            # baked-in config file happens to point at
+            explicit = kubeconfig or os.environ.get("KUBECONFIG")
+            in_cluster = os.path.exists(f"{self.SA_DIR}/token")
+            if explicit or not in_cluster:
+                cfg = self._load_kubeconfig(kubeconfig)
+                if cfg is not None:
+                    base_url = cfg.get("server")
+                    token = cfg.get("token")
+                    ca_file = ca_file or cfg.get("ca_file")
+                    client_cert = cfg.get("client_cert")
         host = os.environ.get("KUBERNETES_SERVICE_HOST")
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         self.base_url = base_url or (f"https://{host}:{port}" if host else

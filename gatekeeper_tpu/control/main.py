@@ -25,6 +25,7 @@ from . import metrics
 from .audit import (
     DEFAULT_AUDIT_INTERVAL,
     DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT,
+    DEFAULT_FULL_RESYNC_EVERY,
     AuditManager,
 )
 from .certs import CertRotator
@@ -61,6 +62,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--constraint-violations-limit", type=int,
                    default=DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT)
     p.add_argument("--audit-from-cache", default="false")
+    p.add_argument("--audit-incremental", default="false",
+                   help="maintain a persistent watch-fed encoded "
+                        "inventory and audit only the delta each sweep "
+                        "(steady-state sweeps patch dirty rows instead "
+                        "of re-encoding the cluster)")
+    p.add_argument("--audit-full-resync-every", type=int,
+                   default=DEFAULT_FULL_RESYNC_EVERY,
+                   help="with --audit-incremental: every Nth sweep "
+                        "re-lists and re-encodes the whole inventory "
+                        "from scratch (self-healing backstop); 0 "
+                        "disables the periodic re-encode (the first "
+                        "sweep still encodes from scratch)")
     p.add_argument("--log-denies", action="store_true")
     p.add_argument("--disable-cert-rotation", action="store_true")
     p.add_argument("--disable-enforcementaction-validation",
@@ -97,7 +110,11 @@ class Runtime:
             self.audit = AuditManager(
                 self.kube, self.opa, interval=args.audit_interval,
                 constraint_violations_limit=args.constraint_violations_limit,
-                audit_from_cache=str(args.audit_from_cache).lower() == "true")
+                audit_from_cache=str(args.audit_from_cache).lower() == "true",
+                incremental=str(getattr(args, "audit_incremental",
+                                        "false")).lower() == "true",
+                full_resync_every=getattr(args, "audit_full_resync_every",
+                                          DEFAULT_FULL_RESYNC_EVERY))
         self.webhook = None
         self.cert_rotator = None
         if "webhook" in operations:

@@ -235,6 +235,45 @@ def report_device_programs(warm: int, compiling: int) -> None:
                        compiling)
 
 
+def report_audit_sweep(path: str) -> None:
+    """One audit sweep took `path`: "incremental" (delta-applied encoded
+    inventory), "full_resync" (the periodic from-scratch re-encode
+    backstop), or "full" (discovery / cache sweep without delta
+    tracking)."""
+    REGISTRY.counter_add("gatekeeper_tpu_audit_sweeps_total",
+                         "Audit sweeps by evaluation path", path=path)
+
+
+def report_audit_dirty(dirty: int, total: int, vocab_grown: int = 0) -> None:
+    """Incremental audit delta stats: dirty-set size, tracked inventory
+    size, encoded-row cache hit ratio, and vocab growth this sweep."""
+    REGISTRY.gauge_set("gatekeeper_tpu_audit_dirty_objects",
+                       "Objects re-encoded by the last incremental audit "
+                       "sweep (adds + updates + deletes applied)", dirty)
+    REGISTRY.gauge_set("gatekeeper_tpu_audit_inventory_objects",
+                       "Objects in the audit's tracked encoded inventory",
+                       total)
+    REGISTRY.gauge_set("gatekeeper_tpu_audit_cache_hit_ratio",
+                       "Fraction of the inventory served from the encoded "
+                       "cache by the last incremental sweep",
+                       1.0 if total <= 0 else max(0.0, 1.0 - dirty / total))
+    REGISTRY.gauge_set("gatekeeper_tpu_intern_strings_added",
+                       "Strings interned during the last audit sweep "
+                       "(vocabulary growth from churned label values)",
+                       vocab_grown)
+
+
+def report_audit_status_writes(written: int, skipped: int) -> None:
+    """Constraint-status write deltas: PATCHes issued vs skipped because
+    the constraint's violation set was unchanged since the last write."""
+    REGISTRY.counter_add("gatekeeper_tpu_audit_status_writes_total",
+                         "Constraint status updates by outcome",
+                         written, result="written")
+    REGISTRY.counter_add("gatekeeper_tpu_audit_status_writes_total",
+                         "Constraint status updates by outcome",
+                         skipped, result="skipped")
+
+
 def report_watch_manager(gvk_count: int, intended: int) -> None:
     REGISTRY.gauge_set("watch_manager_watched_gvk",
                        "Total number of watched GroupVersionKinds",

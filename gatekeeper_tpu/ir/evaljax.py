@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.strtab import MatchTables, StringTable
+from ..parallel.mesh import shard_map_wrap as _shard_map_wrap
 from .prog import (
     And,
     Arith,
@@ -687,8 +688,11 @@ class _MeshPairs:
             fn = ct._mesh_pairs_jit(self._mesh, self._chunk, rcap)
             arr = np.asarray(fn(feats, params, table, derived, n_valid))
             counts = arr[:: rcap + 1, 0].astype(np.int64)
-        ct._rows_cap_mesh = max(256, (1 << (int(counts.max()) - 1)
-                                      .bit_length())
+        # RATCHET, like _SlabPairs does for _rows_cap: resetting to this
+        # sweep's count made alternating small/large mesh sweeps re-trip
+        # the overflow re-run (and its jit recompile) on every grow
+        ct._rows_cap_mesh = max(ct._rows_cap_mesh, 256,
+                                (1 << (int(counts.max()) - 1).bit_length())
                                 if counts.max(initial=0) > 1 else 256)
         for k in range(n_shards):
             block = arr[k * (rcap + 1): (k + 1) * (rcap + 1)]
@@ -1015,12 +1019,11 @@ class CompiledTemplate:
                 lambda a: P("data", *([None] * (a.ndim - 1))), feats)
             rep = lambda tree: jax.tree_util.tree_map(
                 lambda a: P(*([None] * a.ndim)), tree)
-            return jax.shard_map(
+            return _shard_map_wrap(
                 local, mesh=mesh,
                 in_specs=(fspec, rep(params), rep(table), rep(derived),
                           P()),
                 out_specs=P("data", None),
-                check_vma=False,
             )(feats, params, table, derived, n_valid)
 
         fn = jax.jit(run)

@@ -86,6 +86,20 @@ class StringTable:
         """Id of s, or PAD_ID if never interned (≠ any real string)."""
         return self._ids.get(s, PAD_ID)
 
+    def snapshot(self) -> tuple[int, int]:
+        """(size, epoch) marker. Interning is append-only — ids handed
+        out before a snapshot are NEVER reassigned — so caches holding
+        encoded rows stay valid across vocab growth and only need to
+        extend vocab-indexed tables past the snapshot size (the
+        incremental audit patches dirty rows against exactly this
+        invariant)."""
+        return (len(self._strs), self.epoch)
+
+    def grown_since(self, snap: tuple[int, int]) -> int:
+        """How many strings were interned after `snap` was taken (the
+        per-sweep vocab-growth signal the audit metrics report)."""
+        return len(self._strs) - snap[0]
+
     def string(self, i: int) -> str:
         return self._strs[i]
 

@@ -20,7 +20,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+from .mesh import shard_map_wrap
 
 
 def make_audit_step(eval_fn, mesh: Mesh):
@@ -56,12 +57,11 @@ def make_audit_step(eval_fn, mesh: Mesh):
         # derived columns are vocab-indexed lookup tables — replicated,
         # like the match table
         derived_specs = jax.tree_util.tree_map(lambda a: P(), derived)
-        return shard_map(
+        return shard_map_wrap(
             local, mesh=mesh,
             in_specs=(feats_specs, params_specs, P(None, None),
                       derived_specs, P()),
             out_specs=(P("data", "model"), P("model")),
-            check_rep=False,
         )(feats, params, table, derived, n_valid)
 
     return jax.jit(step)

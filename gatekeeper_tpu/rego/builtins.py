@@ -1143,15 +1143,24 @@ def _bi_jwt_decode(token):
     return (freeze(header), freeze(payload), sig)
 
 
-def _bi_jwt_verify_hs256(token, secret):
-    parts = _need_str(token, "io.jwt.verify_hs256").split(".")
+_HS_DIGESTS = {"HS256": _hashlib.sha256, "HS384": _hashlib.sha384,
+               "HS512": _hashlib.sha512}
+
+
+def _jwt_verify_hs(token, secret, algo: str) -> bool:
+    fn = f"io.jwt.verify_{algo.lower()}"
+    parts = _need_str(token, fn).split(".")
     if len(parts) != 3:
         return False
-    mac = _hmac_mod.new(_need_str(secret, "io.jwt.verify_hs256").encode(),
+    mac = _hmac_mod.new(_need_str(secret, fn).encode(),
                         f"{parts[0]}.{parts[1]}".encode(),
-                        _hashlib.sha256).digest()
+                        _HS_DIGESTS[algo]).digest()
     return _hmac_mod.compare_digest(
-        mac, _b64url_decode_pad(parts[2], "io.jwt.verify_hs256"))
+        mac, _b64url_decode_pad(parts[2], fn))
+
+
+def _bi_jwt_verify_hs256(token, secret):
+    return _jwt_verify_hs(token, secret, "HS256")
 
 
 BUILTINS.update({
@@ -1340,11 +1349,15 @@ def _go_layout_convert(layout: str, fn: str, formatting: bool):
             j = i + 1
             while j < n and layout[j] == c:
                 j += 1
-            fraction = (c, j - i - 1)
-            if formatting:
-                out.append(_FRAC_MARK)
-            i = j
-            continue
+            # Go's nextStdChunk: a fractional second only when the digit
+            # run ends the digit string — ".0" in "2006.01.02" is a
+            # literal dot before the std01 month token, not a fraction
+            if j >= n or layout[j] not in "0123456789":
+                fraction = (c, j - i - 1)
+                if formatting:
+                    out.append(_FRAC_MARK)
+                i = j
+                continue
         matched = False
         for tok, kind in _TZ_TOKENS:
             if layout.startswith(tok, i):
@@ -1855,6 +1868,11 @@ def _jwt_pubkey(cert_or_key: str, fn: str):
         raise BuiltinError(f"{fn}: {e}") from None
 
 
+# JOSE raw ECDSA signature widths: 2 coordinates of the curve byte size
+# (P-256 -> 32, P-384 -> 48, P-521 -> 66)
+_ES_SIG_LEN = {"ES256": 64, "ES384": 96, "ES512": 132}
+
+
 def _jwt_verify_asym(token, cert, algo: str) -> bool:
     fn = f"io.jwt.verify_{algo.lower()}"
     parts = _need_str(token, fn).split(".")
@@ -1868,24 +1886,28 @@ def _jwt_verify_asym(token, cert, algo: str) -> bool:
     from cryptography.hazmat.primitives.asymmetric import (
         ec, padding, utils as asym_utils)
 
+    family, bits = algo[:2], algo[2:]
+    if family not in ("RS", "PS", "ES") or bits not in ("256", "384", "512"):
+        raise BuiltinError(f"{fn}: unsupported algorithm")
+    digest = {"256": hashes.SHA256, "384": hashes.SHA384,
+              "512": hashes.SHA512}[bits]()
     try:
-        if algo == "RS256":
-            key.verify(sig, signed, padding.PKCS1v15(), hashes.SHA256())
-        elif algo == "PS256":
+        if family == "RS":
+            key.verify(sig, signed, padding.PKCS1v15(), digest)
+        elif family == "PS":
             key.verify(sig, signed,
-                       padding.PSS(mgf=padding.MGF1(hashes.SHA256()),
-                                   salt_length=hashes.SHA256.digest_size),
-                       hashes.SHA256())
-        elif algo == "ES256":
-            # JOSE: raw r||s (two 32-byte ints) -> DER for cryptography
-            if len(sig) != 64:
-                return False
-            r = int.from_bytes(sig[:32], "big")
-            s_ = int.from_bytes(sig[32:], "big")
-            der = asym_utils.encode_dss_signature(r, s_)
-            key.verify(der, signed, ec.ECDSA(hashes.SHA256()))
+                       padding.PSS(mgf=padding.MGF1(digest),
+                                   salt_length=digest.digest_size),
+                       digest)
         else:
-            raise BuiltinError(f"{fn}: unsupported algorithm")
+            # JOSE: raw r||s (two fixed-width big-endian ints) -> DER
+            if len(sig) != _ES_SIG_LEN[algo]:
+                return False
+            half = len(sig) // 2
+            r = int.from_bytes(sig[:half], "big")
+            s_ = int.from_bytes(sig[half:], "big")
+            der = asym_utils.encode_dss_signature(r, s_)
+            key.verify(der, signed, ec.ECDSA(digest))
         return True
     except InvalidSignature:
         return False
@@ -1901,6 +1923,16 @@ def _bi_jwt_decode_verify(token, constraints):
     iss/aud, exp/nbf against `time` or now)."""
     fn = "io.jwt.decode_verify"
     _need(constraints, "object", fn)
+    # exactly one key constraint (topdown/tokens.go parseTokenConstraints:
+    # zero keys cannot verify anything, both is ambiguous) — an ERROR,
+    # not a false verdict, so policies fail loudly on misconfiguration
+    n_keys = ("cert" in constraints) + ("secret" in constraints)
+    if n_keys == 0:
+        raise BuiltinError(f"{fn}: no key constraint: one of "
+                           "'cert' or 'secret' is required")
+    if n_keys > 1:
+        raise BuiltinError(f"{fn}: duplicate key constraints: 'cert' and "
+                           "'secret' are mutually exclusive")
     try:
         header, payload, _sig = _bi_jwt_decode(token)
     except BuiltinError:
@@ -1910,9 +1942,10 @@ def _bi_jwt_decode_verify(token, constraints):
     if want_alg is not None and alg != want_alg:
         return (False, FrozenDict(), FrozenDict())
     ok = False
-    if alg == "HS256" and "secret" in constraints:
-        ok = _bi_jwt_verify_hs256(token, constraints["secret"])
-    elif alg in ("RS256", "PS256", "ES256") and "cert" in constraints:
+    if alg in _HS_DIGESTS and "secret" in constraints:
+        ok = _jwt_verify_hs(token, constraints["secret"], alg)
+    elif alg in ("RS256", "PS256", "ES256", "RS384", "PS384", "ES384",
+                 "RS512", "PS512", "ES512") and "cert" in constraints:
         ok = _jwt_verify_asym(token, constraints["cert"], alg)
     if not ok:
         return (False, FrozenDict(), FrozenDict())
@@ -2163,12 +2196,12 @@ BUILTINS.update({
         _bi_regex_find_all_string_submatch_n,
     ("glob", "quote_meta"): _bi_glob_quote_meta,
     ("crypto", "x509", "parse_certificates"): _bi_x509_parse_certificates,
-    ("io", "jwt", "verify_rs256"): lambda t, c: _jwt_verify_asym(
-        t, c, "RS256"),
-    ("io", "jwt", "verify_ps256"): lambda t, c: _jwt_verify_asym(
-        t, c, "PS256"),
-    ("io", "jwt", "verify_es256"): lambda t, c: _jwt_verify_asym(
-        t, c, "ES256"),
+    **{("io", "jwt", f"verify_{fam}{bits}"):
+       (lambda t, c, _a=f"{fam.upper()}{bits}": _jwt_verify_asym(t, c, _a))
+       for fam in ("rs", "ps", "es") for bits in ("256", "384", "512")},
+    **{("io", "jwt", f"verify_hs{bits}"):
+       (lambda t, c, _a=f"HS{bits}": _jwt_verify_hs(t, c, _a))
+       for bits in ("384", "512")},
     ("io", "jwt", "decode_verify"): _bi_jwt_decode_verify,
     ("io", "jwt", "encode_sign"): _bi_jwt_encode_sign,
     ("io", "jwt", "encode_sign_raw"): _bi_jwt_encode_sign_raw,

@@ -36,8 +36,12 @@ os.environ.setdefault("GATEKEEPER_TPU_ASYNC_COMPILE", "0")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-if not jax.config.jax_num_cpu_devices or jax.config.jax_num_cpu_devices < 8:
-    jax.config.update("jax_num_cpu_devices", 8)
+if hasattr(jax.config, "jax_num_cpu_devices"):
+    # newer jax: the host-device count is a config knob (the XLA_FLAGS
+    # path above covers older versions, where this attribute is absent)
+    if not jax.config.jax_num_cpu_devices or \
+            jax.config.jax_num_cpu_devices < 8:
+        jax.config.update("jax_num_cpu_devices", 8)
 
 import pathlib
 

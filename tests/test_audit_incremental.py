@@ -1,0 +1,387 @@
+"""Incremental delta audit (ISSUE 1 tentpole).
+
+The correctness invariant: an incremental sweep (persistent encoded
+inventory + dirty-row patching + results delta cache) must produce
+identical violation sets to a from-scratch full sweep over the same
+cluster state — asserted differentially under randomized churn
+(creates / updates / deletes, vocabulary-growing label values, and
+namespace-label flips that change namespaceSelector outcomes), with the
+full-sweep reference running on the independent interpreter engine.
+
+Mechanism pins: steady sweeps issue ZERO constraint-status PATCHes and
+re-extract nothing; the watch-gap (410 Gone) fallback re-list-diffs;
+delete-then-recreate under the same name but a new uid is applied.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from gatekeeper_tpu.client import Backend, RegoDriver
+from gatekeeper_tpu.control.audit import AuditManager, InventoryTracker
+from gatekeeper_tpu.control.kube import FakeKube, KubeError
+from gatekeeper_tpu.ir import TpuDriver
+from gatekeeper_tpu.parallel.workload import REQUIRED_LABELS_TEMPLATE
+from gatekeeper_tpu.target import K8sValidationTarget
+
+CONSTRAINT_GVK = ("constraints.gatekeeper.sh", "v1beta1",
+                  "K8sRequiredLabels")
+
+CONSTRAINTS = [
+    {  # every Namespace needs a regex-conforming owner label
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "ns-owner", "uid": "c-1"},
+        "spec": {
+            "match": {"kinds": [{"apiGroups": [""],
+                                 "kinds": ["Namespace"]}]},
+            "parameters": {"labels": [
+                {"key": "owner",
+                 "allowedRegex": "^[a-z]+[.]corp[.]example$"}]},
+        },
+    },
+    {  # Pods in env=prod namespaces need a team label
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "prod-team", "uid": "c-2"},
+        "spec": {
+            "match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+                      "namespaceSelector":
+                          {"matchLabels": {"env": "prod"}}},
+            "parameters": {"labels": [{"key": "team"}]},
+        },
+    },
+]
+
+
+def _ns(name, labels=None, uid=None):
+    o = {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": name}}
+    if labels is not None:
+        o["metadata"]["labels"] = labels
+    if uid is not None:
+        o["metadata"]["uid"] = uid
+    return o
+
+
+def _pod(name, namespace, labels=None, uid=None):
+    o = {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": name, "namespace": namespace}}
+    if labels is not None:
+        o["metadata"]["labels"] = labels
+    if uid is not None:
+        o["metadata"]["uid"] = uid
+    return o
+
+
+def _cluster():
+    kube = FakeKube()
+    kube.register_kind(("", "v1", "Namespace"), namespaced=False)
+    kube.register_kind(("", "v1", "Pod"), namespaced=True)
+    for i in range(4):
+        kube.create(_ns(f"ns-{i}",
+                        {"env": "prod" if i % 2 else "dev",
+                         "owner": "alpha.corp.example"}, uid=f"ns-u{i}"))
+    for i in range(40):
+        labels = {}
+        if i % 3 == 0:
+            labels["team"] = "payments"
+        kube.create(_pod(f"p-{i}", f"ns-{i % 4}", labels, uid=f"p-u{i}"))
+    return kube
+
+
+def _manager(kube, driver, full_resync_every):
+    client = Backend(driver).new_client([K8sValidationTarget()])
+    client.add_template(REQUIRED_LABELS_TEMPLATE)
+    for c in CONSTRAINTS:
+        client.add_constraint(c)
+        kube.apply(dict(c))
+    return client, AuditManager(kube, client, incremental=True,
+                                full_resync_every=full_resync_every)
+
+
+def _key(results):
+    return sorted(
+        ((r.constraint.get("metadata") or {}).get("name", ""), r.msg,
+         (r.resource or {}).get("kind", ""),
+         ((r.resource or {}).get("metadata") or {}).get("namespace") or "",
+         ((r.resource or {}).get("metadata") or {}).get("name") or "",
+         r.enforcement_action)
+        for r in results)
+
+
+def _apply_churn(kube, rng, round_):
+    """Randomized creates/updates/deletes, including vocabulary-growing
+    label values and namespace env flips (namespaceSelector outcomes)."""
+    ops = []
+    for _ in range(8):
+        op = rng.choice(["update", "update", "create", "delete",
+                         "ns-flip", "owner-churn"])
+        ops.append(op)
+        if op == "update":
+            i = rng.randrange(40)
+            labels = {}
+            if rng.random() < 0.5:
+                labels["team"] = f"team-{round_}-{i}"  # new vocab
+            try:
+                cur = kube.get(("", "v1", "Pod"), f"p-{i}", f"ns-{i % 4}")
+            except KubeError:
+                continue
+            cur["metadata"]["labels"] = labels
+            kube.update(cur)
+        elif op == "create":
+            name = f"extra-{round_}-{rng.randrange(1000)}"
+            try:
+                kube.create(_pod(name, f"ns-{rng.randrange(4)}",
+                                 uid=f"u-{name}"))
+            except KubeError:
+                pass
+        elif op == "delete":
+            i = rng.randrange(40)
+            try:
+                kube.delete(("", "v1", "Pod"), f"p-{i}", f"ns-{i % 4}")
+            except KubeError:
+                pass
+        elif op == "ns-flip":
+            i = rng.randrange(4)
+            cur = kube.get(("", "v1", "Namespace"), f"ns-{i}")
+            labels = cur["metadata"].setdefault("labels", {})
+            labels["env"] = "dev" if labels.get("env") == "prod" \
+                else "prod"
+            kube.update(cur)
+        else:  # owner-churn: regex-relevant value growth
+            i = rng.randrange(4)
+            cur = kube.get(("", "v1", "Namespace"), f"ns-{i}")
+            labels = cur["metadata"].setdefault("labels", {})
+            labels["owner"] = rng.choice(
+                ["beta.corp.example", f"BAD-{round_}", "x.corp.example"])
+            kube.update(cur)
+    return ops
+
+
+def test_differential_incremental_vs_full_under_churn():
+    """Every churn round: the incremental sweep (TpuDriver, patched
+    caches, never resyncing) must equal a from-scratch full re-encode
+    sweep (independent interpreter engine, resyncing every sweep)."""
+    kube = _cluster()
+    # 0 = periodic re-encode disabled (the first sweep still encodes
+    # from scratch): the incremental side must never fall back
+    _ci, inc = _manager(kube, TpuDriver(), full_resync_every=0)
+    _cf, full = _manager(kube, RegoDriver(), full_resync_every=1)
+    assert _key(inc.audit_once()) == _key(full.audit_once())
+    rng = random.Random(42)
+    for round_ in range(6):
+        ops = _apply_churn(kube, rng, round_)
+        got, want = _key(inc.audit_once()), _key(full.audit_once())
+        assert got == want, f"round {round_} diverged after {ops}"
+        assert inc.last_sweep_stats["sweep"] == "incremental"
+    assert want, "differential went vacuous (no violations at the end)"
+    inc.stop()
+    full.stop()
+
+
+def test_steady_sweep_is_delta_and_writes_nothing():
+    """Acceptance: a sweep with zero changes performs ZERO status
+    PATCHes (fake kube call log) and re-extracts nothing — the results
+    delta cache answers and the encoded inventory stays resident."""
+    kube = _cluster()
+    drv = TpuDriver()
+    _client, mgr = _manager(kube, drv, full_resync_every=10 ** 9)
+    mgr.audit_once()
+    first = mgr.audit_once()  # fingerprints settle
+
+    import gatekeeper_tpu.ir.driver as drvmod
+    calls = {"extract": 0}
+    orig = drvmod.extract_batch
+    drvmod.extract_batch = lambda *a, **k: (
+        calls.__setitem__("extract", calls["extract"] + 1), orig(*a, **k)
+    )[1]
+    try:
+        n0 = len(kube.calls)
+        out = mgr.audit_once()
+        new_calls = kube.calls[n0:]
+    finally:
+        drvmod.extract_batch = orig
+    assert _key(out) == _key(first)
+    status_writes = [c for c in new_calls
+                     if c[0] == "update" and c[3] == "status"]
+    assert status_writes == [], status_writes
+    assert calls["extract"] == 0, "steady sweep re-extracted features"
+    assert drv.last_audit_path.startswith("delta("), drv.last_audit_path
+    assert mgr.last_sweep_stats["dirty"] == 0
+
+    # one object changes -> O(changed constraints) writes: only the
+    # ns-owner constraint's violation set changes
+    cur = kube.get(("", "v1", "Namespace"), "ns-0")
+    cur["metadata"]["labels"] = {"env": "dev"}  # owner label gone
+    kube.update(cur)
+    n0 = len(kube.calls)
+    mgr.audit_once()
+    writes = [c for c in kube.calls[n0:]
+              if c[0] == "update" and c[3] == "status"]
+    assert [c[2] for c in writes] == [("", "ns-owner")]
+    mgr.stop()
+
+
+def test_full_resync_backstop_heals_divergence():
+    """--audit-full-resync-every: the from-scratch re-encode must repair
+    lost updates AND lost deletes (watch events that never arrived),
+    while leaving inventory data it does not own untouched (the config
+    controller co-owns the tree — full resync must not wipe it)."""
+    kube = _cluster()
+    client, mgr = _manager(kube, TpuDriver(), full_resync_every=2)
+    mgr.audit_once()  # sweep 0: full resync
+    # inventory data owned by another writer (config-synced kind)
+    client.add_data({"apiVersion": "v1", "kind": "Endpoints",
+                     "metadata": {"name": "foreign", "namespace": "ns-0"}})
+    # divergence: one update and one delete whose events are LOST
+    cur = kube.get(("", "v1", "Namespace"), "ns-1")
+    cur["metadata"]["labels"] = {"env": "prod"}  # owner label dropped
+    kube.update(cur)
+    kube.delete(("", "v1", "Pod"), "p-1", "ns-1")
+    with mgr.tracker._lock:
+        mgr.tracker._dirty.clear()
+    r1 = _key(mgr.audit_once())  # sweep 1: incremental, still stale
+    assert mgr.last_sweep_stats["sweep"] == "incremental"
+    assert not any(name == "ns-1" for (_c, _m, _k, _n, name, _e) in r1)
+    r2 = _key(mgr.audit_once())  # sweep 2: full resync heals both
+    assert mgr.last_sweep_stats["sweep"] == "full_resync"
+    assert any(c == "ns-owner" and name == "ns-1"
+               for (c, _m, _k, _n, name, _e) in r2)
+    key = ((("", "v1", "Pod")), "ns-1", "p-1")
+    assert key not in mgr.tracker._state
+    # the foreign object survived the resync (no inventory wipe)
+    assert client.driver.get_data(
+        ("external", "admission.k8s.gatekeeper.sh", "namespace", "ns-0",
+         "v1", "Endpoints", "foreign")) is not None
+    mgr.stop()
+
+
+class _WatchlessKube(FakeKube):
+    """Streams always fail (a server whose watch RVs are expired: every
+    subscription dies with 410 Gone) — the tracker must fall back to a
+    per-sweep resourceVersion-diff re-list."""
+
+    def watch(self, gvk, callback, send_initial=True):
+        raise KubeError("watch: HTTP 410 Gone", 410)
+
+
+def test_watch_gap_falls_back_to_relist_diff():
+    kube = _WatchlessKube()
+    kube.register_kind(("", "v1", "Namespace"), namespaced=False)
+    kube.register_kind(("", "v1", "Pod"), namespaced=True)
+    kube.create(_ns("ns-0", {"env": "prod", "owner": "a.corp.example"},
+                    uid="n0"))
+    kube.create(_pod("p-0", "ns-0", uid="u0"))
+    _client, mgr = _manager(kube, TpuDriver(), full_resync_every=10 ** 9)
+    r0 = _key(mgr.audit_once())
+    assert mgr.tracker._poll, "no GVK degraded to the re-list path"
+    assert any(name == "p-0" for (_c, _m, _k, _ns_, name, _e) in r0)
+    # churn is only observable through the re-list diff
+    cur = kube.get(("", "v1", "Pod"), "p-0", "ns-0")
+    cur["metadata"]["labels"] = {"team": "x"}
+    kube.update(cur)
+    kube.create(_pod("p-1", "ns-0", uid="u1"))
+    r1 = _key(mgr.audit_once())
+    assert mgr.last_sweep_stats["dirty"] == 2
+    assert not any(name == "p-0" for (_c, _m, _k, _ns_, name, _e) in r1)
+    assert any(name == "p-1" for (_c, _m, _k, _ns_, name, _e) in r1)
+    kube.delete(("", "v1", "Pod"), "p-1", "ns-0")
+    r2 = _key(mgr.audit_once())
+    assert not any(name == "p-1" for (_c, _m, _k, _ns_, name, _e) in r2)
+    mgr.stop()
+
+
+def test_note_gap_triggers_one_shot_resync():
+    """note_gap(gvk): the operator/watch-layer signal for a stream that
+    died beyond the client's own recovery — the next sweep re-list-diffs
+    that GVK once, picking up changes whose events were lost."""
+    kube = _cluster()
+    _client, mgr = _manager(kube, TpuDriver(), full_resync_every=10 ** 9)
+    mgr.audit_once()
+    # make ns-0 prod so p-0's team label is load-bearing
+    cur = kube.get(("", "v1", "Namespace"), "ns-0")
+    cur["metadata"]["labels"]["env"] = "prod"
+    kube.update(cur)
+    r = _key(mgr.audit_once())
+    assert not any(c == "prod-team" and name == "p-0"
+                   for (c, _m, _k, _n, name, _e) in r)
+    # p-0 loses its team label, but the event is LOST (dead stream)
+    cur = kube.get(("", "v1", "Pod"), "p-0", "ns-0")
+    cur["metadata"]["labels"] = {}
+    kube.update(cur)
+    with mgr.tracker._lock:
+        mgr.tracker._dirty.clear()  # simulate the lost delivery
+    r = _key(mgr.audit_once())  # stale: the change was never seen
+    assert not any(c == "prod-team" and name == "p-0"
+                   for (c, _m, _k, _n, name, _e) in r)
+    mgr.tracker.note_gap(("", "v1", "Pod"))
+    r = _key(mgr.audit_once())  # one-shot resync heals it
+    assert any(c == "prod-team" and name == "p-0"
+               for (c, _m, _k, _n, name, _e) in r)
+    assert mgr.last_sweep_stats["dirty"] == 1
+    mgr.stop()
+
+
+def test_resync_supersedes_stale_pending_events():
+    """A stale MODIFIED event pending for an object whose DELETED event
+    was lost must not resurrect it: the resync re-list supersedes the
+    pre-list event backlog (informer relist semantics)."""
+    kube = _cluster()
+    _client, mgr = _manager(kube, TpuDriver(), full_resync_every=10 ** 9)
+    mgr.audit_once()
+    cur = kube.get(("", "v1", "Pod"), "p-2", "ns-2")
+    cur["metadata"]["labels"] = {"x": "y"}
+    kube.update(cur)
+    kube.delete(("", "v1", "Pod"), "p-2", "ns-2")
+    key = (("", "v1", "Pod"), "ns-2", "p-2")
+    with mgr.tracker._lock:
+        # simulate the DELETED event being lost mid-gap: only the stale
+        # MODIFIED remains pending
+        mgr.tracker._dirty[key] = ("MODIFIED", cur)
+    mgr.tracker.note_gap(("", "v1", "Pod"))
+    r = _key(mgr.audit_once())
+    assert key not in mgr.tracker._state
+    assert not any(name == "p-2" for (_c, _m, _k, _n, name, _e) in r)
+    mgr.stop()
+
+
+def test_delete_then_recreate_same_name_new_uid():
+    """A delete + recreate under the same name but a new uid (collapsed
+    into one watch gap) must apply the NEW object's state."""
+    kube = _cluster()
+    _client, mgr = _manager(kube, TpuDriver(), full_resync_every=10 ** 9)
+    mgr.audit_once()
+    key = ((("", "v1", "Pod")), "ns-0", "p-0")
+    assert mgr.tracker._state[key][0] == "p-u0"
+    # p-0 (i%3==0) carries a team label; the recreate drops it, so in
+    # prod namespaces the prod-team violation must appear
+    kube.delete(("", "v1", "Pod"), "p-0", "ns-0")
+    kube.create(_pod("p-0", "ns-0", uid="p-u0-reborn"))
+    r = _key(mgr.audit_once())
+    assert mgr.tracker._state[key][0] == "p-u0-reborn"
+    # ns-0 is env=dev in _cluster (i%2==0 -> dev): flip it to prod to
+    # make the recreated pod's missing team label observable
+    cur = kube.get(("", "v1", "Namespace"), "ns-0")
+    cur["metadata"]["labels"]["env"] = "prod"
+    kube.update(cur)
+    r = _key(mgr.audit_once())
+    assert any(c == "prod-team" and name == "p-0"
+               for (c, _m, _k, _ns_, name, _e) in r)
+    mgr.stop()
+
+
+def test_strtab_snapshot_append_only():
+    """The invariant the encoded-inventory cache leans on: interning
+    never reassigns ids across growth."""
+    from gatekeeper_tpu.ops.strtab import StringTable
+
+    t = StringTable()
+    ids = {s: t.intern(s) for s in ("a", "b", "c")}
+    snap = t.snapshot()
+    t.intern_many(["d", "e", "a"])
+    assert t.grown_since(snap) == 2
+    for s, i in ids.items():
+        assert t.intern(s) == i and t.string(i) == s

@@ -51,6 +51,7 @@ def test_codegen_matches_interpreter_on_reference_corpus(dirpath):
     assert checked > 0 and fired > 0, f"{dirpath}: corpus vacuous"
 
 
+@requires_reference
 def test_driver_uses_codegen_for_library_template():
     """The wiring, not just the compiler: RegoDriver must route violation
     materialization through the generated evaluator."""
@@ -78,6 +79,7 @@ def test_driver_uses_codegen_for_library_template():
     assert msgs and "owner" in msgs[0]
 
 
+@requires_reference
 def test_codegen_runtime_failure_falls_back_loudly(caplog):
     """A generated evaluator that crashes must log, permanently disable
     itself for the kind, and still answer via the interpreter."""

@@ -706,6 +706,7 @@ def test_rest_client_kubeconfig(tmp_path):
     import base64
     import textwrap
 
+    pytest.importorskip("cryptography")
     from gatekeeper_tpu.control.certs import _pem_cert, generate_ca
 
     _, ca = generate_ca()
@@ -733,3 +734,70 @@ def test_rest_client_kubeconfig(tmp_path):
     kube = RestKubeClient(kubeconfig=str(cfg))
     assert kube.base_url == "https://10.9.8.7:6443"
     assert kube.token == "kubeconfig-token"
+
+
+def _minimal_kubeconfig(path, server, token):
+    import textwrap
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(f"""
+        apiVersion: v1
+        kind: Config
+        current-context: test
+        contexts:
+        - name: test
+          context:
+            cluster: c1
+            user: u1
+        clusters:
+        - name: c1
+          cluster:
+            server: {server}
+        users:
+        - name: u1
+          user:
+            token: {token}
+    """))
+
+
+def test_rest_client_config_precedence(tmp_path, monkeypatch):
+    """In-cluster service account wins over the implicit ~/.kube/config
+    default; an EXPLICIT kubeconfig (argument or $KUBECONFIG) wins over
+    the in-cluster account unconditionally."""
+    home = tmp_path / "home"
+    _minimal_kubeconfig(home / ".kube" / "config",
+                        "https://from-home:6443", "home-token")
+    explicit = tmp_path / "explicit-config"
+    _minimal_kubeconfig(explicit, "https://from-explicit:6443",
+                        "explicit-token")
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("sa-token")
+    monkeypatch.setenv("HOME", str(home))
+    monkeypatch.delenv("KUBECONFIG", raising=False)
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.11.12.13")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+    monkeypatch.setattr(RestKubeClient, "SA_DIR", str(sa))
+
+    # 1. in-cluster SA beats the implicit ~/.kube/config
+    kube = RestKubeClient()
+    assert kube.token == "sa-token"
+    assert kube.base_url == "https://10.11.12.13:443"
+
+    # 2. explicit kubeconfig argument beats the in-cluster account
+    kube = RestKubeClient(kubeconfig=str(explicit))
+    assert kube.token == "explicit-token"
+    assert kube.base_url == "https://from-explicit:6443"
+
+    # 3. $KUBECONFIG beats the in-cluster account too
+    monkeypatch.setenv("KUBECONFIG", str(explicit))
+    kube = RestKubeClient()
+    assert kube.token == "explicit-token"
+    assert kube.base_url == "https://from-explicit:6443"
+
+    # 4. no in-cluster SA: the implicit ~/.kube/config applies again
+    monkeypatch.delenv("KUBECONFIG", raising=False)
+    monkeypatch.setattr(RestKubeClient, "SA_DIR", str(tmp_path / "absent"))
+    kube = RestKubeClient()
+    assert kube.token == "home-token"
+    assert kube.base_url == "https://from-home:6443"

@@ -25,6 +25,11 @@ from gatekeeper_tpu.control.metrics import REGISTRY
 from gatekeeper_tpu.control.upgrade import UpgradeManager
 from gatekeeper_tpu.control.watch import WatchManager
 
+requires_crypto = pytest.mark.skipif(
+    __import__("importlib").util.find_spec("cryptography") is None,
+    reason="cryptography not installed (cert rotation is gated on it)")
+
+
 TEMPLATE = {
     "apiVersion": "templates.gatekeeper.sh/v1beta1",
     "kind": "ConstraintTemplate",
@@ -317,6 +322,7 @@ def test_namespace_label_webhook_exemption():
     assert h.handle(admission_review(exempt))["response"]["allowed"] is True
 
 
+@requires_crypto
 def test_webhook_over_https(runtime):
     """Full transport path: TLS server + cert rotation against the fake
     apiserver (secret + CA files), then a real HTTPS admission request."""
@@ -356,6 +362,7 @@ def test_webhook_over_https(runtime):
             server.server.shutdown()
 
 
+@requires_crypto
 def test_cert_rotation_injects_vwh(runtime):
     kube = runtime.kube
     kube.create({
@@ -377,6 +384,7 @@ def test_cert_rotation_injects_vwh(runtime):
     assert all(bundles)
 
 
+@requires_crypto
 def test_vwh_recreate_reinjects_ca_bundle(runtime):
     """ReconcileVWH analog (reference certs.go:454-530): a VWH recreated
     between 12-hour refresh ticks must get the caBundle re-injected by

@@ -444,6 +444,10 @@ def test_async_warm_serves_host_then_hot_swaps():
             break
         time.sleep(0.05)
     assert drv.warm_status()["warm"] >= 1, "device program never warmed"
+    # the results delta cache would (correctly) answer this unchanged
+    # re-audit without dispatching; drop it so the test exercises the
+    # post-warm DEVICE sweep it exists to pin
+    drv._audit_results_cache.clear()
     got2 = sorted((r.msg, r.resource["metadata"]["name"])
                   for r in client.audit().results())
     assert got2 == want

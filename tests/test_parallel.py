@@ -226,12 +226,14 @@ def test_driver_mesh_audit_equals_single_device():
     assert got_mesh == got_single == got_interp
     assert len(got_mesh) == N - (N + 2) // 3, "non-vacuous"
 
-    # steady state re-audit over resident sharded buffers
+    # steady state re-audit: nothing changed, so the results delta cache
+    # answers without re-dispatching the sweep
     assert _audit_key(cm.audit().results()) == got_mesh
-    assert dm.last_audit_path == "mesh(data=8)"
+    assert dm.last_audit_path == "delta(1/1)", dm.last_audit_path
 
-    # single-object churn: the patch journal must keep the sharded
-    # feature tensors coherent (row update lands on the right shard)
+    # single-object churn via DELETE: the journal breaks, the delta
+    # cache is bypassed, and the full mesh sweep must run again over
+    # rebuilt sharded buffers
     for c in (cm, cs):
         c.remove_data({"apiVersion": "v1", "kind": "Namespace",
                        "metadata": {"name": "ns00001"}})
@@ -263,6 +265,68 @@ def test_driver_mesh_gather_capacity_retry():
     assert len(out) == N, f"{len(out)} != {N} (rows lost in retry?)"
     ct = dm.compiled_for("K8sRequiredLabels")
     assert ct._rows_cap_mesh >= 512
+
+
+def test_driver_mesh_gather_capacity_ratchets():
+    """Alternating small/large mesh sweeps: the per-shard gather
+    capacity must RATCHET (like the single-device slab path) instead of
+    resetting to each sweep's count — a shrink must not make the next
+    grow re-trip the overflow re-run."""
+    dm = _mesh_driver()
+    cm = Backend(dm).new_client([K8sValidationTarget()])
+    from gatekeeper_tpu import policies
+
+    cm.add_template(policies.load("general/requiredlabels"))
+    cm.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels", "metadata": {"name": "need-owner"},
+        "spec": {"parameters": {"labels": [{"key": "owner"}]}}})
+    N = 4096  # 512 firing rows per shard > the 256 initial capacity
+    for i in range(N):
+        cm.add_data({"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": f"ns{i:05d}"}})
+    assert len(cm.audit().results()) == N
+    assert dm.last_audit_path == "mesh(data=8)"
+    ct = dm.compiled_for("K8sRequiredLabels")
+    cap_grown = ct._rows_cap_mesh
+    assert cap_grown >= 512
+
+    def relabel(owner: bool):
+        # in-place churn (same N, same buckets) so every sweep stays on
+        # the mesh path with identical tensor shapes
+        for i in range(N):
+            o = {"apiVersion": "v1", "kind": "Namespace",
+                 "metadata": {"name": f"ns{i:05d}"}}
+            if owner:
+                o["metadata"]["labels"] = {"owner": "me"}
+            cm.add_data(o)
+        dm._audit_results_cache.clear()  # force the device sweep
+        dm._dev_batch_lat_s = 1e-4  # re-pin: the consume path's real
+        # CPU latency sample would route the next sweep to the host
+
+    relabel(owner=True)  # shrink: ~0 firing rows
+    assert cm.audit().results() == []
+    assert dm.last_audit_path == "mesh(data=8)"
+    assert ct._rows_cap_mesh >= cap_grown, \
+        "gather capacity shrank after a small sweep"
+
+    relabel(owner=False)  # grow again: 512 firing rows per shard
+    jit_calls = []
+    orig = ct._mesh_pairs_jit
+
+    def counting(*a, **k):
+        jit_calls.append(a)
+        return orig(*a, **k)
+
+    ct._mesh_pairs_jit = counting
+    out = cm.audit().results()
+    ct._mesh_pairs_jit = orig
+    assert dm.last_audit_path == "mesh(data=8)"
+    assert len(out) == N
+    # dispatch resolves the jit exactly once; with the pre-ratchet reset
+    # the overflow retry loop would resolve it a second time mid-consume
+    assert len(jit_calls) == 1, \
+        f"overflow re-run re-triggered: {len(jit_calls)} jit lookups"
 
 
 def test_driver_mesh_respects_min_reviews():

@@ -486,6 +486,85 @@ forged[p] {
     assert out2 is UNDEF or thaw(out2) == []
 
 
+def _hs_token(alg, payload, secret=b"topsecret"):
+    import base64 as b64
+    import hashlib
+    import hmac as hmac_mod
+    import json as pyjson
+
+    digest = {"HS256": hashlib.sha256, "HS384": hashlib.sha384,
+              "HS512": hashlib.sha512}[alg]
+
+    def seg(d):
+        return b64.urlsafe_b64encode(
+            pyjson.dumps(d).encode()).decode().rstrip("=")
+
+    hdr, pl = seg({"alg": alg}), seg(payload)
+    sig = b64.urlsafe_b64encode(hmac_mod.new(
+        secret, f"{hdr}.{pl}".encode(), digest).digest()
+    ).decode().rstrip("=")
+    return f"{hdr}.{pl}.{sig}"
+
+
+def test_jwt_decode_verify_key_constraints_and_hs_variants():
+    """OPA parity: decode_verify errors on zero or duplicate key
+    constraints; HS384/HS512 (and the standalone verify_hs384/512
+    builtins) verify correctly."""
+    from gatekeeper_tpu.rego.builtins import BUILTINS, BuiltinError
+    from gatekeeper_tpu.utils.values import thaw as _thaw
+
+    dv = BUILTINS[("io", "jwt", "decode_verify")]
+    tok = _hs_token("HS384", {"sub": "me"})
+    with pytest.raises(BuiltinError, match="no key constraint"):
+        dv(tok, freeze({}))
+    with pytest.raises(BuiltinError, match="duplicate key constraints"):
+        dv(tok, freeze({"secret": "topsecret", "cert": "x"}))
+    ok, _hdr, payload = dv(tok, freeze({"secret": "topsecret"}))
+    assert ok is True and _thaw(payload) == {"sub": "me"}
+    bad, h, p = dv(tok, freeze({"secret": "wrong"}))
+    assert (bad, _thaw(h), _thaw(p)) == (False, {}, {})
+    # alg pin must reject a mismatched header
+    assert dv(tok, freeze({"secret": "topsecret", "alg": "HS256"}))[0] \
+        is False
+    for alg in ("HS384", "HS512"):
+        t = _hs_token(alg, {"a": 1})
+        assert BUILTINS[("io", "jwt", f"verify_{alg.lower()}")](
+            t, "topsecret") is True
+        assert BUILTINS[("io", "jwt", f"verify_{alg.lower()}")](
+            t, "wrong") is False
+        assert dv(t, freeze({"secret": "topsecret"}))[0] is True
+    # registry carries every RS/PS/ES 256/384/512 variant OPA supports
+    for fam in ("rs", "ps", "es"):
+        for bits in ("256", "384", "512"):
+            assert ("io", "jwt", f"verify_{fam}{bits}") in BUILTINS
+
+
+def test_go_layout_dotted_dates_and_fractions():
+    """Go nextStdChunk parity: a dot before a digit run is only a
+    fractional-second token when the run ends the digit string — dotted
+    date layouts like 2006.01.02 must parse and format as literals."""
+    from gatekeeper_tpu.rego.builtins import (
+        _bi_time_format,
+        _bi_time_parse_ns,
+        _go_layout_convert,
+    )
+
+    fmt, fraction, _tz = _go_layout_convert("2006.01.02", "t", False)
+    assert (fmt, fraction) == ("%Y.%m.%d", None)
+    fmt, fraction, _tz = _go_layout_convert("15:04:05.000", "t", False)
+    assert fmt == "%H:%M:%S" and fraction == ("0", 3)
+    # dotted date round-trips (parse landed on 2021-03-04 00:00 UTC)
+    ns = _bi_time_parse_ns("2006.01.02", "2021.03.04")
+    assert ns == 1614816000000000000
+    assert _bi_time_format((ns, "UTC", "2006.01.02")) == "2021.03.04"
+    assert _bi_time_format((ns, "UTC", "02.01.2006")) == "04.03.2021"
+    # fractions still work when the digit run ends the digit string
+    assert _bi_time_format((ns + 123_456_789, "UTC",
+                            "15:04:05.000")) == "00:00:00.123"
+    assert _bi_time_format((ns + 120_000_000, "UTC",
+                            "15:04:05.999")) == "00:00:00.12"
+
+
 def test_breadth_builtins_round5():
     """Round-5 builtin tail (crypto.x509/io.jwt asymmetric/time parse+
     format/cidr tail/regex tail/named operators) through actual rego;
@@ -568,6 +647,7 @@ def test_x509_and_asymmetric_jwt_in_rego():
     real keys, through interpreter and codegen."""
     import base64 as b64
 
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
 
