@@ -22,8 +22,13 @@ a Go-OPA-relative estimate.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+`--config N [N ...]` runs only the named side configs (bench_configs.py)
+in-process and prints their JSON lines — e.g. `python bench.py --config 7`
+for the mutation micro-batch bench (reports `mutate_s` + mutation p50).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -54,7 +59,17 @@ def _device_sanity() -> None:
 
 
 def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", type=int, nargs="+", default=None,
+                   help="run only these bench_configs.py configs "
+                        "(e.g. --config 7 for the mutation micro-batch "
+                        "bench) and skip the audit headline")
+    args = p.parse_args()
     _device_sanity()
+    if args.config:
+        import bench_configs
+        bench_configs.run(args.config)
+        return
     t_setup = time.time()
     from gatekeeper_tpu.client import Backend
     from gatekeeper_tpu.ir import TpuDriver
@@ -188,7 +203,7 @@ def main() -> None:
         proc = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(
                 os.path.abspath(__file__)), "bench_configs.py"),
-             "1", "2", "3", "5", "6"],
+             "1", "2", "3", "5", "6", "7"],
             capture_output=True, text=True, env=env,
             timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 2700)))
         for line in proc.stdout.splitlines():
@@ -235,6 +250,9 @@ def main() -> None:
         "device_programs": driver.warm_status(),
         "n_devices": len(__import__("jax").devices()),
         "mutate_audit_s": round(mutate_audit_s, 3),
+        # mutating-admission headline (config 7): one micro-batch's
+        # batched mutate pass at the largest mutator-library size
+        "mutate_s": (configs.get("7") or {}).get("mutate_s"),
         "objects": N_OBJECTS,
         "constraints": N_CONSTRAINTS,
         "violating_pairs": n_pairs,

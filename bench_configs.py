@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""BASELINE.md configs #1, #2, #3, #5 (config #4 is bench.py's headline).
+"""BASELINE.md configs #1, #2, #3, #5, #6, #7 (config #4 is bench.py's
+headline).
 
 One JSON line per config:
   #1 requiredlabels x 1k Namespaces     — full audit wall-clock + the
@@ -16,9 +17,12 @@ One JSON line per config:
   #6 steady-state audit @ 1% churn — PSP library x 50k pods with ~1% of
      objects mutated between sweeps: incremental (journal-patched)
      sweep vs the full re-encode sweep
+  #7 mutating admission: micro-batched /v1/mutate throughput + p50 at
+     three mutator-library sizes (batched applicability matching +
+     host apply-to-convergence + RFC-6902 patch generation)
 
 All audits run steady-state through client.audit() (warm caches), same
-contract as bench.py. Run: python bench_configs.py [1 2 3 5 6]
+contract as bench.py. Run: python bench_configs.py [1 2 3 5 6 7]
 """
 
 from __future__ import annotations
@@ -398,6 +402,159 @@ def config6():
         "first_audit_s": round(first, 2),
         "violations": n_inc,
         "violations_full_path": n_full,
+    }))
+
+
+# --------------------------------------------------------------- config 7
+
+
+def _synth_mutators(n: int) -> list[dict]:
+    """A mutator library shaped like real fleets: imagePullPolicy /
+    metadata-label / toleration mutators with varied match selectors so
+    applicability actually discriminates across the batch."""
+    out = []
+    for i in range(n):
+        shape = i % 3
+        if shape == 0:
+            out.append({
+                "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+                "kind": "Assign",
+                "metadata": {"name": f"pull-policy-{i}"},
+                "spec": {
+                    "applyTo": [{"groups": [""], "versions": ["v1"],
+                                 "kinds": ["Pod"]}],
+                    "match": {"kinds": [{"apiGroups": [""],
+                                         "kinds": ["Pod"]}],
+                              "namespaces": [f"ns{i % 20}"]},
+                    "location": "spec.containers[name: *].imagePullPolicy",
+                    "parameters": {"assign": {"value": "IfNotPresent"}},
+                },
+            })
+        elif shape == 1:
+            out.append({
+                "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+                "kind": "AssignMetadata",
+                "metadata": {"name": f"owner-label-{i}"},
+                "spec": {
+                    "match": {"labelSelector":
+                              {"matchLabels": {"app": f"app{i % 50}"}}},
+                    "location": f"metadata.labels.injected-{i}",
+                    "parameters": {"assign": {"value": f"v{i}"}},
+                },
+            })
+        else:
+            out.append({
+                "apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+                "kind": "ModifySet",
+                "metadata": {"name": f"tolerations-{i}"},
+                "spec": {
+                    "applyTo": [{"groups": [""], "versions": ["v1"],
+                                 "kinds": ["Pod"]}],
+                    "match": {"kinds": [{"apiGroups": [""],
+                                         "kinds": ["Pod"]}]},
+                    "location": "spec.tolerations",
+                    "parameters": {
+                        "operation": "merge",
+                        "values": {"fromList": [
+                            {"key": f"pool-{i % 4}",
+                             "operator": "Exists"}]},
+                    },
+                },
+            })
+    return out
+
+
+def config7():
+    """Mutating admission (micro-batched /v1/mutate) at three
+    mutator-library sizes: per-batch applicability rides the vectorized
+    matcher once per micro-batch, the host applies matched mutators to
+    convergence, and the handler emits the RFC-6902 patch. Headline
+    `mutate_s` is the wall-clock of one 512-review batched mutation
+    pass at the largest library; p50 comes from a 32-thread closed loop
+    through the real MutationHandler (envelope + patch encode
+    included)."""
+    import threading
+
+    from gatekeeper_tpu.control.webhook import MutationHandler
+    from gatekeeper_tpu.mutation import MutationSystem
+
+    sizes = [max(1, int(s * SCALE)) for s in (30, 150, 600)]
+    n_reviews = max(16, int(512 * SCALE))
+    reviews = _mixed_reviews(n_reviews, seed=11)
+    per_size = []
+    mutate_s = None
+    p50_ms = None
+    for n_mut in sizes:
+        system = MutationSystem()
+        for m in _synth_mutators(n_mut):
+            system.upsert(m)
+        assert not system.conflicts(), "synthetic library must be clean"
+        # --- batched engine path: one vectorized applicability sweep +
+        # host convergence for the whole batch
+        system.mutate_batch(reviews)  # warm matcher signature caches
+        best = float("inf")
+        n_batched = 0
+        t_all = time.time()
+        while time.time() - t_all < 2.0:
+            t0 = time.time()
+            outs = system.mutate_batch(reviews)
+            best = min(best, time.time() - t0)
+            n_batched += len(outs)
+        batched_rps = n_batched / (time.time() - t_all)
+        # --- closed loop through the real handler (micro-batcher +
+        # JSONPatch emission), 32 in-process clients
+        handler = MutationHandler(system, batch_max_wait=0.003)
+        payloads = [{"apiVersion": "admission.k8s.io/v1",
+                     "kind": "AdmissionReview",
+                     "request": dict(r, uid=f"u{k}",
+                                     userInfo={"username": "bench"})}
+                    for k, r in enumerate(reviews)]
+        handler.handle(payloads[0])  # warm the flusher
+        lats: list = []
+        lock = threading.Lock()
+        n_req = max(64, int(4000 * SCALE))
+        n_threads = 32
+
+        def worker(k: int):
+            mine = []
+            for j in range(n_req // n_threads):
+                t0 = time.time()
+                handler.handle(payloads[(k * 131 + j) % len(payloads)])
+                mine.append(time.time() - t0)
+            with lock:
+                lats.extend(mine)
+
+        t0 = time.time()
+        ths = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_threads)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        wall = time.time() - t0
+        handler.batcher.stop()
+        lats.sort()
+        entry = {
+            "mutators": n_mut,
+            "mutate_s": round(best, 4),
+            "batched_reviews_per_sec": round(batched_rps),
+            "handler_rps": round(len(lats) / wall),
+            "p50_ms": round(lats[len(lats) // 2] * 1000, 2),
+            "p99_ms": round(lats[int(len(lats) * 0.99)] * 1000, 2),
+        }
+        per_size.append(entry)
+        mutate_s = entry["mutate_s"]  # largest library wins (last)
+        p50_ms = entry["p50_ms"]
+    print(json.dumps({
+        "config": 7, "metric": "mutate_batch_wall_clock_s",
+        "value": mutate_s,
+        "unit": f"s (one {n_reviews}-review micro-batch mutated vs a "
+                f"{sizes[-1]}-mutator library: vectorized applicability "
+                "+ host convergence)",
+        "mutate_s": mutate_s,
+        "p50_ms": p50_ms,
+        "reviews_per_batch": n_reviews,
+        "sizes": per_size,
     }))
 
 
@@ -790,6 +947,17 @@ def config5():
     }))
 
 
+def run(which: list[int]) -> None:
+    table = {1: config1, 2: config2, 3: config3, 5: config5, 6: config6,
+             7: config7}
+    for c in which:
+        if c not in table:
+            sys.exit(f"unknown bench config {c}: choose from "
+                     f"{sorted(table)} (config 4 is bench.py's headline — "
+                     "run `python bench.py` with no --config)")
+        table[c]()
+
+
 def main() -> None:
     if sys.argv[1:2] == ["--loadgen"]:
         port, rate, duration, seed, out = sys.argv[2:7]
@@ -799,9 +967,7 @@ def main() -> None:
     if sys.argv[1:2] == ["--serve"]:
         _serve_child(int(sys.argv[2]))
         return
-    which = [int(a) for a in sys.argv[1:]] or [1, 2, 3, 5, 6]
-    for c in which:
-        {1: config1, 2: config2, 3: config3, 5: config5, 6: config6}[c]()
+    run([int(a) for a in sys.argv[1:]] or [1, 2, 3, 5, 6, 7])
 
 
 if __name__ == "__main__":

@@ -41,9 +41,12 @@ LOOKAHEAD = datetime.timedelta(days=90)
 CHECK_INTERVAL = 12 * 3600  # seconds
 SECRET_NAME = "gatekeeper-webhook-server-cert"
 VWH_NAME = "gatekeeper-validating-webhook-configuration"
+MWH_NAME = "gatekeeper-mutating-webhook-configuration"
 SECRET_GVK = ("", "v1", "Secret")
 VWH_GVK = ("admissionregistration.k8s.io", "v1beta1",
            "ValidatingWebhookConfiguration")
+MWH_GVK = ("admissionregistration.k8s.io", "v1beta1",
+           "MutatingWebhookConfiguration")
 
 
 def _new_key():
@@ -117,7 +120,8 @@ class CertRotator:
                  service_name: str = "gatekeeper-webhook-service",
                  namespace: str = "gatekeeper-system",
                  secret_name: str = SECRET_NAME,
-                 vwh_name: str = VWH_NAME):
+                 vwh_name: str = VWH_NAME,
+                 mwh_name: str = MWH_NAME):
         self.kube = kube
         self.cert_dir = cert_dir
         self.dns_names = [
@@ -127,6 +131,7 @@ class CertRotator:
         self.namespace = namespace
         self.secret_name = secret_name
         self.vwh_name = vwh_name
+        self.mwh_name = mwh_name
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._reconcile_thread: Optional[threading.Thread] = None
@@ -174,6 +179,7 @@ class CertRotator:
         until the next tick."""
         reg = watch_manager.registrar("cert-reconciler")
         reg.add_watch(VWH_GVK)
+        reg.add_watch(MWH_GVK)
         reg.add_watch(SECRET_GVK)
         self._registrar = reg
         self._reconcile_thread = threading.Thread(
@@ -199,8 +205,9 @@ class CertRotator:
         obj = event.object or {}
         meta = obj.get("metadata") or {}
         kind = obj.get("kind")
-        if kind == "ValidatingWebhookConfiguration":
-            if meta.get("name") != self.vwh_name or \
+        if kind in ("ValidatingWebhookConfiguration",
+                    "MutatingWebhookConfiguration"):
+            if meta.get("name") not in (self.vwh_name, self.mwh_name) or \
                     event.type == "DELETED":
                 return
             if self._ca_pem:
@@ -276,22 +283,25 @@ class CertRotator:
         os.chmod(os.path.join(self.cert_dir, "tls.key"), 0o600)
 
     def inject_ca(self, ca_pem: bytes) -> None:
-        """caBundle injection into every webhook of the VWH
-        (certs.go:170-233)."""
-        try:
-            vwh = self.kube.get(VWH_GVK, self.vwh_name)
-        except (NotFound, KubeError):
-            return
+        """caBundle injection into every webhook of the VWH and (when
+        deployed) the MutatingWebhookConfiguration (certs.go:170-233)."""
         bundle = base64.b64encode(ca_pem).decode()
-        changed = False
-        for wh in vwh.get("webhooks") or []:
-            cc = wh.setdefault("clientConfig", {})
-            if cc.get("caBundle") != bundle:
-                cc["caBundle"] = bundle
-                changed = True
-        if changed:
+        for gvk, name in ((VWH_GVK, self.vwh_name),
+                          (MWH_GVK, self.mwh_name)):
             try:
-                self.kube.update(vwh)
-                log.info("injected CA bundle into webhook configuration")
-            except KubeError as e:
-                log.warning("CA injection failed", details=str(e))
+                cfg = self.kube.get(gvk, name)
+            except (NotFound, KubeError):
+                continue
+            changed = False
+            for wh in cfg.get("webhooks") or []:
+                cc = wh.setdefault("clientConfig", {})
+                if cc.get("caBundle") != bundle:
+                    cc["caBundle"] = bundle
+                    changed = True
+            if changed:
+                try:
+                    self.kube.update(cfg)
+                    log.info("injected CA bundle into webhook "
+                             "configuration", details={"name": name})
+                except KubeError as e:
+                    log.warning("CA injection failed", details=str(e))

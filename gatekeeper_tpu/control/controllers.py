@@ -44,6 +44,9 @@ TEMPLATE_GVK = ("templates.gatekeeper.sh", "v1beta1", "ConstraintTemplate")
 CONFIG_GVK = ("config.gatekeeper.sh", "v1alpha1", "Config")
 CRD_GVK = ("apiextensions.k8s.io", "v1beta1", "CustomResourceDefinition")
 CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
+MUTATOR_GROUP = "mutations.gatekeeper.sh"
+MUTATOR_GVKS = tuple((MUTATOR_GROUP, "v1alpha1", kind)
+                     for kind in ("Assign", "AssignMetadata", "ModifySet"))
 FINALIZER = "finalizers.gatekeeper.sh/constrainttemplate"
 
 log = logger("controller")
@@ -412,6 +415,96 @@ class SyncController:
             metrics.report_sync("active", k, len(bucket))
 
 
+# ------------------------------------------------------------------- mutator
+
+
+class MutatorController:
+    """Reconciles Assign / AssignMetadata / ModifySet CRs into the
+    MutationSystem (reference pkg/controller/mutators/*): level-triggered
+    upsert with semantic-equal dedupe inside the system, ingestion
+    metrics, per-kind mutator gauges, and the schema-conflict quarantine
+    surfaced as a byPod status condition — on EVERY mutator whose
+    conflict state flips, not just the event's subject (a new mutator
+    can quarantine an old one, and a deletion can clear it)."""
+
+    def __init__(self, kube, system, wm: WatchManager):
+        self.kube = kube
+        self.system = system
+        self.wm = wm
+        self.registrar = wm.registrar("mutator")
+        self.worker = _Worker("mutator", self.registrar, self.reconcile)
+
+    def start(self) -> None:
+        for gvk in MUTATOR_GVKS:
+            self.registrar.add_watch(gvk)
+        self.worker.start()
+
+    def reconcile(self, event: WatchEvent) -> None:
+        from ..mutation import MutationError
+
+        obj = event.object
+        kind = obj.get("kind") or ""
+        name = (obj.get("metadata") or {}).get("name") or ""
+        if event.type != "DELETED":
+            # level-triggered: act on the watch cache, never a possibly
+            # stale event payload (same rationale as ConstraintController)
+            cur = self.wm.cached_get(gvk_of(obj), name, "")
+            if cur is None:
+                event = WatchEvent("DELETED", obj)
+            else:
+                obj = cur
+        if event.type == "DELETED":
+            changed = self.system.remove((kind, name))
+            metrics.report_mutators(self.system.counts())
+            self._refresh_statuses(changed - {(kind, name)})
+            log.info("mutator deleted", mutator_kind=kind,
+                     mutator_name=name)
+            return
+        t0 = time.time()
+        try:
+            mutator, changed = self.system.upsert(obj)
+        except MutationError as e:
+            metrics.report_mutator_ingestion("error", time.time() - t0)
+            log.error("mutator ingestion failed", mutator_kind=kind,
+                      mutator_name=name, details=str(e))
+            self._status(obj, enforced=False, errors=[str(e)])
+            return
+        metrics.report_mutator_ingestion("ok", time.time() - t0)
+        metrics.report_mutators(self.system.counts())
+        reason = self.system.conflicts().get(mutator.id)
+        self._status(obj, enforced=reason is None,
+                     errors=[reason] if reason else None)
+        self._refresh_statuses(changed - {mutator.id})
+        log.info("mutator ingested", mutator_kind=kind, mutator_name=name,
+                 quarantined=bool(reason))
+
+    def _refresh_statuses(self, ids: set) -> None:
+        conflicts = self.system.conflicts()
+        for kind, name in sorted(ids):
+            # the registrar already watches every mutator GVK: serve the
+            # object from the informer cache, no API round-trip
+            obj = self.wm.cached_get((MUTATOR_GROUP, "v1alpha1", kind),
+                                     name, "")
+            if obj is None:
+                continue
+            reason = conflicts.get((kind, name))
+            self._status(obj, enforced=reason is None,
+                         errors=[reason] if reason else None)
+
+    def _status(self, obj: dict, enforced: bool,
+                errors: Optional[list] = None) -> None:
+        entry: dict[str, Any] = {"enforced": enforced,
+                                 "observedGeneration":
+                                 (obj.get("metadata") or {}).get("generation",
+                                                                 0)}
+        if errors:
+            entry["errors"] = [{"message": e} for e in errors]
+        if by_pod_status_unchanged(obj, entry):
+            return
+        set_by_pod_status(obj, entry)
+        _retry_status_update(self.kube, obj)
+
+
 # ------------------------------------------------------------------- manager
 
 
@@ -420,7 +513,7 @@ class ControllerManager:
     pkg/controller/controller.go:41-60 AddToManager)."""
 
     def __init__(self, kube, opa: Client, wm: Optional[WatchManager] = None,
-                 validate_actions: bool = True):
+                 validate_actions: bool = True, mutation_system=None):
         self.kube = kube
         self.opa = opa
         self.wm = wm or WatchManager(kube)
@@ -433,12 +526,18 @@ class ControllerManager:
         self.sync_ctrl = SyncController(kube, opa, self.wm)
         self.config_ctrl = ConfigController(kube, opa, self.wm,
                                             self.sync_ctrl)
+        self.mutator_ctrl = None
+        if mutation_system is not None:
+            self.mutator_ctrl = MutatorController(kube, mutation_system,
+                                                  self.wm)
 
     def start(self) -> None:
         self.constraint_ctrl.start()
         self.template_ctrl.start()
         self.sync_ctrl.start()
         self.config_ctrl.start()
+        if self.mutator_ctrl is not None:
+            self.mutator_ctrl.start()
 
     def drain(self, timeout: float = 10.0) -> None:
         """Wait until every reconcile queue has no queued OR in-flight
@@ -452,6 +551,8 @@ class ControllerManager:
         deadline = time.time() + timeout
         workers = [self.template_ctrl.worker, self.constraint_ctrl.worker,
                    self.sync_ctrl.worker, self.config_ctrl.worker]
+        if self.mutator_ctrl is not None:
+            workers.append(self.mutator_ctrl.worker)
         stable = 0
         while time.time() < deadline:
             if all(w.idle() for w in workers):
@@ -463,8 +564,10 @@ class ControllerManager:
             time.sleep(0.002)
 
     def stop(self) -> None:
-        workers = (self.template_ctrl.worker, self.constraint_ctrl.worker,
-                   self.sync_ctrl.worker, self.config_ctrl.worker)
+        workers = [self.template_ctrl.worker, self.constraint_ctrl.worker,
+                   self.sync_ctrl.worker, self.config_ctrl.worker]
+        if self.mutator_ctrl is not None:
+            workers.append(self.mutator_ctrl.worker)
         for w in workers:
             w.stop()
         # JOIN before teardown: a worker mid-get() still delivers one

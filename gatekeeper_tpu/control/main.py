@@ -34,12 +34,26 @@ from .kube import FakeKube, RestKubeClient
 from .upgrade import UpgradeManager
 from .webhook import (
     MicroBatcher,
+    MutationHandler,
     NamespaceLabelHandler,
     ValidationHandler,
     WebhookServer,
 )
 
 log = glog.logger("main")
+
+
+def _parse_fail_closed(value: str) -> bool:
+    """--fail-closed value parser: booleans or the webhook
+    failurePolicy spellings, so deploy templating can feed the one
+    failurePolicy value to both the API object and this process."""
+    v = str(value).strip().lower()
+    if v in ("true", "1", "yes", "fail"):
+        return True
+    if v in ("false", "0", "no", "ignore"):
+        return False
+    raise argparse.ArgumentTypeError(
+        f"cannot parse {value!r}; use true/false or Fail/Ignore")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -49,8 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     # flag parity with the reference (SURVEY.md §5 config/flag system)
     p.add_argument("--operation", action="append", default=None,
-                   choices=["webhook", "audit"],
-                   help="operations to run; repeatable; all when unset")
+                   choices=["webhook", "audit", "mutation-webhook"],
+                   help="operations to run; repeatable; webhook+audit "
+                        "when unset (mutation-webhook must be requested "
+                        "explicitly)")
     p.add_argument("--port", type=int, default=8443)
     p.add_argument("--cert-dir", default="/certs")
     p.add_argument("--log-level", default="INFO")
@@ -75,6 +91,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "disables the periodic re-encode (the first "
                         "sweep still encodes from scratch)")
     p.add_argument("--log-denies", action="store_true")
+    p.add_argument("--fail-closed", nargs="?", const=True, default=False,
+                   type=_parse_fail_closed,
+                   help="internal webhook errors DENY instead of the "
+                        "default fail-open allow (match this to the "
+                        "deployed failurePolicy); errored decisions are "
+                        "reported as status=error either way. Bare flag "
+                        "or a value: true/false or the failurePolicy "
+                        "spelling Fail/Ignore. Applies to every webhook "
+                        "unless --mutation-fail-closed overrides the "
+                        "mutating one")
+    p.add_argument("--mutation-fail-closed", nargs="?", const=True,
+                   default=None, type=_parse_fail_closed,
+                   help="failure stance of the MUTATING webhook only "
+                        "(same value forms as --fail-closed; defaults "
+                        "to --fail-closed when unset). The chart "
+                        "templates --mutation-fail-closed="
+                        "{{ .Values.mutations.failurePolicy }} so the "
+                        "MutatingWebhookConfiguration and the process "
+                        "flip together without touching the validating "
+                        "webhook's stance")
+    p.add_argument("--mutation-max-iterations", type=int, default=10,
+                   help="convergence pass budget for the mutating "
+                        "webhook; a review whose matched mutators still "
+                        "change the object after N full passes errors "
+                        "instead of admitting a half-mutated object")
+    p.add_argument("--mutation-batch-max-wait", type=float, default=0.005,
+                   help="mutating webhook micro-batch collection window "
+                        "(seconds)")
     p.add_argument("--disable-cert-rotation", action="store_true")
     p.add_argument("--disable-enforcementaction-validation",
                    action="store_true")
@@ -102,9 +146,15 @@ class Runtime:
             self._register_builtin_kinds()
         driver = TpuDriver()
         self.opa = Backend(driver).new_client([K8sValidationTarget()])
+        self.mutation_system = None
+        if "mutation-webhook" in operations:
+            from ..mutation import MutationSystem
+            self.mutation_system = MutationSystem(
+                max_iterations=getattr(args, "mutation_max_iterations", 10))
         self.manager = ControllerManager(
             self.kube, self.opa,
-            validate_actions=not args.disable_enforcementaction_validation)
+            validate_actions=not args.disable_enforcementaction_validation,
+            mutation_system=self.mutation_system)
         self.audit = None
         if "audit" in operations:
             self.audit = AuditManager(
@@ -117,15 +167,34 @@ class Runtime:
                                           DEFAULT_FULL_RESYNC_EVERY))
         self.webhook = None
         self.cert_rotator = None
-        if "webhook" in operations:
-            batcher = MicroBatcher(self.opa)
-            validation = ValidationHandler(
-                self.opa, kube=self.kube, batcher=batcher,
-                log_denies=args.log_denies,
-                validate_enforcement=not
-                args.disable_enforcementaction_validation,
-                traces_provider=lambda: self.manager.config_ctrl.traces)
-            ns_label = NamespaceLabelHandler(tuple(args.exempt_namespace))
+        if "webhook" in operations or "mutation-webhook" in operations:
+            fail_closed = getattr(args, "fail_closed", False)
+            validation = ns_label = None
+            if "webhook" in operations:
+                # a mutation-only process must NOT serve /v1/admit — a
+                # leftover VWC would get decisions from an operation the
+                # operator turned off (unserved endpoints 404)
+                batcher = MicroBatcher(self.opa)
+                validation = ValidationHandler(
+                    self.opa, kube=self.kube, batcher=batcher,
+                    log_denies=args.log_denies,
+                    validate_enforcement=not
+                    args.disable_enforcementaction_validation,
+                    traces_provider=lambda:
+                    self.manager.config_ctrl.traces,
+                    fail_closed=fail_closed)
+                ns_label = NamespaceLabelHandler(
+                    tuple(args.exempt_namespace))
+            mutation = None
+            if self.mutation_system is not None:
+                mut_fail_closed = getattr(args, "mutation_fail_closed",
+                                          None)
+                mutation = MutationHandler(
+                    self.mutation_system, kube=self.kube,
+                    fail_closed=fail_closed if mut_fail_closed is None
+                    else mut_fail_closed,
+                    batch_max_wait=getattr(args, "mutation_batch_max_wait",
+                                           0.005))
             certfile = keyfile = None
             if not args.disable_cert_rotation:
                 self.cert_rotator = CertRotator(self.kube, args.cert_dir)
@@ -139,7 +208,8 @@ class Runtime:
             self.webhook = WebhookServer(
                 validation, ns_label, port=args.port, certfile=certfile,
                 keyfile=keyfile,
-                reuse_port=getattr(args, "webhook_reuse_port", False))
+                reuse_port=getattr(args, "webhook_reuse_port", False),
+                mutation=mutation)
         self.upgrade = UpgradeManager(self.kube)
         self.metrics_server = None
         self.health = None
@@ -159,6 +229,12 @@ class Runtime:
               "CustomResourceDefinition"), False),
             (("admissionregistration.k8s.io", "v1beta1",
               "ValidatingWebhookConfiguration"), False),
+            (("admissionregistration.k8s.io", "v1beta1",
+              "MutatingWebhookConfiguration"), False),
+            (("mutations.gatekeeper.sh", "v1alpha1", "Assign"), False),
+            (("mutations.gatekeeper.sh", "v1alpha1", "AssignMetadata"),
+             False),
+            (("mutations.gatekeeper.sh", "v1alpha1", "ModifySet"), False),
         ]:
             self.kube.register_kind(gvk, namespaced=namespaced)
 

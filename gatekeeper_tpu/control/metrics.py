@@ -185,6 +185,48 @@ def report_request(admission_status: str, seconds: float) -> None:
                      admission_status=admission_status)
 
 
+def report_batch_timeout(n: int = 1) -> None:
+    """A MicroBatcher.submit() waiter gave up before its batch flushed
+    (the entry is dropped from the queue so the flush never evaluates a
+    request nobody is waiting for)."""
+    REGISTRY.counter_add("admission_batch_timeouts",
+                         "Admission requests that timed out waiting for "
+                         "their micro-batch to flush", n)
+
+
+def report_mutation_request(admission_status: str, seconds: float) -> None:
+    """One /v1/mutate decision (reference mutation stats reporter
+    metric names)."""
+    REGISTRY.counter_add("mutation_request_count",
+                         "Count of mutation admission requests",
+                         admission_status=admission_status)
+    REGISTRY.observe("mutation_request_duration_seconds",
+                     "Latency of mutation admission requests", seconds,
+                     admission_status=admission_status)
+
+
+def report_mutator_ingestion(status: str, seconds: float) -> None:
+    REGISTRY.counter_add("mutator_ingestion_count",
+                         "Count of mutator ingestion actions by outcome",
+                         status=status)
+    REGISTRY.observe("mutator_ingestion_duration_seconds",
+                     "Latency of mutator ingestion", seconds, status=status)
+
+
+def report_mutators(counts: dict) -> None:
+    """Cached-mutator gauges: per-kind counts plus the schema-conflict
+    quarantine size ({"Assign": n, ..., "conflicting": n})."""
+    for key, count in counts.items():
+        if key == "conflicting":
+            REGISTRY.gauge_set("mutator_conflicting_count",
+                               "Mutators quarantined by the schema "
+                               "conflict detector", count)
+        else:
+            REGISTRY.gauge_set("mutators",
+                               "Current number of cached mutators", count,
+                               kind=key)
+
+
 def report_constraints(action: str, count: int) -> None:
     REGISTRY.gauge_set("constraints", "Current number of known constraints",
                        count, enforcement_action=action)

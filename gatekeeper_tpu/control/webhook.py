@@ -1,10 +1,14 @@
-"""Admission webhooks: /v1/admit and /v1/admitlabel.
+"""Admission webhooks: /v1/admit, /v1/admitlabel, and /v1/mutate.
 
-Counterpart of the reference pkg/webhook/policy.go + namespacelabel.go,
-with one structural change (BASELINE config #5): requests are MICRO-BATCHED
-— handler threads enqueue reviews and a flusher thread ships whole batches
-through the driver's vectorized review_batch, so admission latency rides
-the batched evaluator instead of per-request interpretation.
+Counterpart of the reference pkg/webhook/policy.go + namespacelabel.go +
+mutation.go, with one structural change (BASELINE config #5): requests are
+MICRO-BATCHED — handler threads enqueue reviews and a flusher thread ships
+whole batches through the driver's vectorized review_batch, so admission
+latency rides the batched evaluator instead of per-request interpretation.
+The mutating webhook rides the same batcher: applicability for the whole
+micro-batch is computed in one vectorized matcher sweep, then the host
+applies the matched mutators to convergence and answers with an RFC-6902
+JSONPatch (MutationHandler below).
 
 Behavior parity:
   * self-service-account requests short-circuit to allow (policy.go:122-124)
@@ -22,12 +26,13 @@ Behavior parity:
 
 from __future__ import annotations
 
+import base64
 import http.server
 import json
 import ssl
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from ..client import Client
 from ..target.handler import AugmentedReview
@@ -57,15 +62,23 @@ class _Pending:
 
 class MicroBatcher:
     """Deadline-bounded admission batching: collect pending reviews for up
-    to `max_wait`, flush them through driver.review_batch as one sweep."""
+    to `max_wait`, flush them through driver.review_batch as one sweep.
 
-    def __init__(self, opa: Client, max_wait: float = 0.005,
+    `evaluate` swaps the flush body: it receives the batch's review list
+    and returns one outcome per review (an Exception instance fails just
+    that request). The default evaluates violations through the driver;
+    the mutation webhook passes MutationSystem.mutate_batch and rides
+    the identical collector/flusher pipeline."""
+
+    def __init__(self, opa: Optional[Client], max_wait: float = 0.005,
                  max_batch: int = 256,
-                 target: str = "admission.k8s.gatekeeper.sh"):
+                 target: str = "admission.k8s.gatekeeper.sh",
+                 evaluate: Optional[Callable[[list], list]] = None):
         self.opa = opa
         self.max_wait = max_wait
         self.max_batch = max_batch
         self.target = target
+        self._evaluate = evaluate or self._evaluate_violations
         self._queue: list[_Pending] = []
         self._cv = threading.Condition()
         self._stop = threading.Event()
@@ -82,6 +95,7 @@ class MicroBatcher:
         self._fthread.start()
         self.batches = 0
         self.batched_requests = 0
+        self.timeouts = 0
 
     def submit(self, review: dict, timeout: float = 60.0) -> list:
         p = _Pending(review)
@@ -93,6 +107,17 @@ class MicroBatcher:
                 # notify per submit makes it spin once per caller thread
                 self._cv.notify()
         if not p.done.wait(timeout):
+            # nobody will consume the result: drop the entry so a later
+            # flush doesn't evaluate (and set results on) an abandoned
+            # request; if it already sealed into a batch the flush's
+            # done.set() is harmless — the waiter is gone either way
+            with self._cv:
+                try:
+                    self._queue.remove(p)
+                except ValueError:
+                    pass  # already sealed / mid-flush
+            self.timeouts += 1
+            metrics.report_batch_timeout()
             raise TimeoutError("admission batch timed out")
         if p.error is not None:
             raise p.error
@@ -141,65 +166,89 @@ class MicroBatcher:
     def _flush(self, batch: list[_Pending]) -> None:
         self.batches += 1
         self.batched_requests += len(batch)
-        driver = self.opa.driver
         try:
-            handler = self.opa.targets[self.target]
-            if hasattr(driver, "review_batch"):
-                outs = driver.review_batch(self.target,
-                                           [p.review for p in batch])
-            else:
-                outs = []
-                for p in batch:
-                    resp = driver.query(
-                        ("hooks", self.target, "violation"),
-                        {"review": p.review})
-                    outs.append(resp.results)
+            outs = self._evaluate([p.review for p in batch])
             for p, results in zip(batch, outs):
-                for r in results:
-                    handler.handle_violation(r)
-                p.results = results
+                if isinstance(results, Exception):
+                    p.error = results
+                else:
+                    p.results = results
                 p.done.set()
         except Exception as e:
             for p in batch:
                 p.error = e
                 p.done.set()
 
+    def _evaluate_violations(self, reviews: list[dict]) -> list:
+        driver = self.opa.driver
+        handler = self.opa.targets[self.target]
+        if hasattr(driver, "review_batch"):
+            outs = driver.review_batch(self.target, reviews)
+        else:
+            outs = []
+            for review in reviews:
+                resp = driver.query(("hooks", self.target, "violation"),
+                                    {"review": review})
+                outs.append(resp.results)
+        for results in outs:
+            for r in results:
+                handler.handle_violation(r)
+        return outs
+
+
+def _envelope(admission_review: dict, response: dict) -> dict:
+    """AdmissionReview response envelope. admission.k8s.io/v1 REQUIRES
+    the response to echo the request's apiVersion/kind (the v1beta1 API
+    server tolerated their absence); both are echoed verbatim with the
+    legacy defaults for envelope-free callers."""
+    return {
+        "apiVersion": admission_review.get("apiVersion")
+        or "admission.k8s.io/v1beta1",
+        "kind": admission_review.get("kind") or "AdmissionReview",
+        "response": response,
+    }
+
 
 class ValidationHandler:
-    """The /v1/admit logic, transport-independent."""
+    """The /v1/admit logic, transport-independent.
+
+    fail_closed flips the internal-error stance: the deployed
+    failurePolicy is Ignore (fail-open) and the default matches it, but
+    a cluster that prefers blocking to unvalidated admission runs
+    --fail-closed and errors become denies. Either way the decision is
+    reported to metrics as status="error", not "allow"."""
 
     def __init__(self, opa: Client, kube=None,
                  batcher: Optional[MicroBatcher] = None,
                  log_denies: bool = False,
                  validate_enforcement: bool = True,
-                 traces_provider=None):
+                 traces_provider=None,
+                 fail_closed: bool = False):
         self.opa = opa
         self.kube = kube
         self.batcher = batcher or MicroBatcher(opa)
         self.log_denies = log_denies
         self.validate_enforcement = validate_enforcement
         self.traces_provider = traces_provider or (lambda: [])
+        self.fail_closed = fail_closed
 
     def handle(self, admission_review: dict) -> dict:
         t0 = time.time()
         request = admission_review.get("request") or {}
         uid = request.get("uid") or ""
+        status = None
         try:
             response = self._decide(request)
         except Exception as e:
-            # webhook is deployed fail-open; internal errors allow
             log.error("admission error", details=str(e))
-            response = {"allowed": True,
+            status = "error"
+            response = {"allowed": not self.fail_closed,
                         "status": {"code": 500, "message": str(e)}}
-        status = "allow" if response.get("allowed") else "deny"
+        if status is None:
+            status = "allow" if response.get("allowed") else "deny"
         metrics.report_request(status, time.time() - t0)
         response["uid"] = uid
-        return {
-            "apiVersion": admission_review.get("apiVersion",
-                                               "admission.k8s.io/v1beta1"),
-            "kind": "AdmissionReview",
-            "response": response,
-        }
+        return _envelope(admission_review, response)
 
     def _decide(self, request: dict) -> dict:
         username = (request.get("userInfo") or {}).get("username")
@@ -308,22 +357,105 @@ class NamespaceLabelHandler:
         response: dict[str, Any] = {"uid": uid, "allowed": allowed}
         if not allowed:
             response["status"] = {"code": 403, "reason": reason}
+        return _envelope(admission_review, response)
+
+
+class MutationHandler:
+    """The /v1/mutate logic (reference pkg/webhook/mutation.go),
+    transport-independent.
+
+    Rides the same MicroBatcher as validation: handler threads enqueue
+    gk-reviews; the flusher ships the whole batch through
+    MutationSystem.mutate_batch, which computes applicability for the
+    entire micro-batch in ONE vectorized matcher sweep (the same
+    signature-grouped path the validation mask uses) and then applies
+    each review's matched mutators on the host, pass after pass, to
+    convergence. The response is an RFC-6902 JSONPatch (base64, as the
+    API server expects) or a plain allow when nothing changed."""
+
+    def __init__(self, system, kube=None,
+                 batcher: Optional[MicroBatcher] = None,
+                 fail_closed: bool = False,
+                 batch_max_wait: float = 0.005):
+        self.system = system
+        self.kube = kube
+        self.batcher = batcher or MicroBatcher(
+            None, max_wait=batch_max_wait, evaluate=self._evaluate_batch)
+        self.fail_closed = fail_closed
+
+    def _lookup_namespace(self, name: str):
+        if self.kube is None:
+            return None
+        try:
+            return self.kube.get(("", "v1", "Namespace"), name)
+        except NotFound:
+            return None
+
+    def _evaluate_batch(self, reviews: list[dict]) -> list:
+        return self.system.mutate_batch(reviews, self._lookup_namespace)
+
+    def handle(self, admission_review: dict) -> dict:
+        t0 = time.time()
+        request = admission_review.get("request") or {}
+        uid = request.get("uid") or ""
+        status = "allow"
+        try:
+            response = self._decide(request)
+        except Exception as e:
+            log.error("mutation error", details=str(e))
+            status = "error"
+            response = {"allowed": not self.fail_closed,
+                        "status": {"code": 500, "message": str(e)}}
+        metrics.report_mutation_request(status, time.time() - t0)
+        response["uid"] = uid
+        return _envelope(admission_review, response)
+
+    def _decide(self, request: dict) -> dict:
+        username = (request.get("userInfo") or {}).get("username")
+        if username == SERVICE_ACCOUNT:
+            return {"allowed": True}
+        kind = request.get("kind") or {}
+        if (kind.get("group") or "") in (TEMPLATE_GROUP, CONSTRAINT_GROUP,
+                                         "mutations.gatekeeper.sh"):
+            # gatekeeper's own resources are never mutated
+            return {"allowed": True}
+        obj = request.get("object")
+        if not isinstance(obj, dict):
+            return {"allowed": True}  # DELETE / subresource: nothing to patch
+        if not self.system.active():
+            # empty (or fully quarantined) mutator library: don't pay the
+            # micro-batch wait — the MWC matches the whole cluster, so
+            # this is the hot path until mutators are installed
+            return {"allowed": True}
+        # no per-request namespace prefetch: the batched matcher resolves
+        # namespaces through _lookup_namespace only for mutators whose
+        # match actually needs them (once per projection group, not per
+        # request)
+        mutated = self.batcher.submit(dict(request))
+        if mutated is None:
+            return {"allowed": True}
+        from ..mutation.patch import json_patch
+
+        patch = json_patch(obj, mutated)
+        if not patch:
+            return {"allowed": True}
         return {
-            "apiVersion": admission_review.get("apiVersion",
-                                               "admission.k8s.io/v1beta1"),
-            "kind": "AdmissionReview",
-            "response": response,
+            "allowed": True,
+            "patchType": "JSONPatch",
+            "patch": base64.b64encode(
+                json.dumps(patch).encode()).decode(),
         }
 
 
 class WebhookServer:
     """HTTPS transport over the handlers."""
 
-    def __init__(self, validation: ValidationHandler,
-                 ns_label: NamespaceLabelHandler,
+    def __init__(self, validation: Optional[ValidationHandler],
+                 ns_label: Optional[NamespaceLabelHandler],
                  port: int = 8443, certfile: Optional[str] = None,
                  keyfile: Optional[str] = None, addr: str = "",
-                 reuse_port: bool = False):
+                 reuse_port: bool = False,
+                 mutation: Optional[MutationHandler] = None):
         """reuse_port: bind with SO_REUSEPORT so multiple serving
         PROCESSES share one port (the kernel load-balances accepts) —
         the single-process Python frontend is GIL-bound, and this is
@@ -350,10 +482,18 @@ class WebhookServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
-                if self.path.startswith("/v1/admitlabel"):
+                # un-served endpoints 404 (an operation not requested
+                # must not answer admission decisions for it)
+                if self.path.startswith("/v1/admitlabel") \
+                        and outer.ns_label is not None:
                     out = outer.ns_label.handle(review)
-                elif self.path.startswith("/v1/admit"):
+                elif self.path.startswith("/v1/admit") \
+                        and not self.path.startswith("/v1/admitlabel") \
+                        and outer.validation is not None:
                     out = outer.validation.handle(review)
+                elif self.path.startswith("/v1/mutate") \
+                        and outer.mutation is not None:
+                    out = outer.mutation.handle(review)
                 else:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")
@@ -371,11 +511,27 @@ class WebhookServer:
 
         self.validation = validation
         self.ns_label = ns_label
-        server_cls = http.server.ThreadingHTTPServer
+        self.mutation = mutation
+
+        class _Server(http.server.ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # keep-alive clients dropping a connection mid-request
+                # (reset, broken pipe, idle timeout) are routine — log
+                # one line instead of a traceback on stderr
+                import sys
+                exc = sys.exc_info()[1]
+                if isinstance(exc, (ConnectionError, TimeoutError,
+                                    ssl.SSLError)):
+                    log.info("client connection dropped",
+                             details=str(exc))
+                    return
+                super().handle_error(request, client_address)
+
+        server_cls = _Server
         if reuse_port:
             import socket as _socket
 
-            class _ReusePort(http.server.ThreadingHTTPServer):
+            class _ReusePort(_Server):
                 def server_bind(self):
                     self.socket.setsockopt(_socket.SOL_SOCKET,
                                            _socket.SO_REUSEPORT, 1)
@@ -397,4 +553,7 @@ class WebhookServer:
 
     def stop(self) -> None:
         self.server.shutdown()
-        self.validation.batcher.stop()
+        if self.validation is not None:
+            self.validation.batcher.stop()
+        if self.mutation is not None:
+            self.mutation.batcher.stop()

@@ -315,6 +315,51 @@ def test_namespace_label_webhook(runtime):
     assert plain["response"]["allowed"] is True
 
 
+def test_admission_review_envelope_echoes_request(runtime):
+    """admission.k8s.io/v1 requires the response to echo the request's
+    apiVersion/kind; v1beta1 callers keep their version, and an
+    envelope-free review gets the legacy defaults (regression for the
+    envelope-fidelity satellite — both handlers)."""
+    review = admission_review(
+        ns("anything"),
+        username="system:serviceaccount:gatekeeper-system:gatekeeper-admin")
+    for handler in (runtime.webhook.validation, runtime.webhook.ns_label):
+        v1 = dict(review, apiVersion="admission.k8s.io/v1")
+        out = handler.handle(v1)
+        assert out["apiVersion"] == "admission.k8s.io/v1"
+        assert out["kind"] == "AdmissionReview"
+        out = handler.handle(review)
+        assert out["apiVersion"] == "admission.k8s.io/v1beta1"
+        assert out["kind"] == "AdmissionReview"
+        bare = handler.handle({"request": review["request"]})
+        assert bare["apiVersion"] == "admission.k8s.io/v1beta1"
+        assert bare["kind"] == "AdmissionReview"
+
+
+def test_validation_failure_policy_flag():
+    """--fail-closed: internal errors deny instead of the fail-open
+    default, and either way the decision lands in metrics as
+    status="error", not "allow"."""
+    from gatekeeper_tpu.control.webhook import ValidationHandler
+
+    class _Boom:
+        def __getattr__(self, name):
+            raise RuntimeError("engine exploded")
+
+    review = admission_review(ns("shipping"))
+    open_h = ValidationHandler(_Boom(), batcher=object())
+    out = open_h.handle(review)
+    assert out["response"]["allowed"] is True  # deployed fail-open
+    assert out["response"]["status"]["code"] == 500
+
+    closed_h = ValidationHandler(_Boom(), batcher=object(),
+                                 fail_closed=True)
+    out = closed_h.handle(review)
+    assert out["response"]["allowed"] is False
+    assert out["response"]["status"]["code"] == 500
+    assert 'request_count{admission_status="error"}' in REGISTRY.render()
+
+
 def test_namespace_label_webhook_exemption():
     from gatekeeper_tpu.control.webhook import NamespaceLabelHandler
     h = NamespaceLabelHandler(exempt_namespaces=("kube-system",))

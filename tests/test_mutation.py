@@ -1,0 +1,657 @@
+"""Mutation subsystem: location-path parser, apply semantics, schema
+conflict quarantine, convergence, JSONPatch emission, batched
+applicability (differential vs the per-object predicate), the /v1/mutate
+webhook, and the mutator controller lifecycle."""
+
+import base64
+import copy
+import http.client
+import json
+import random
+import time
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.control.main import Runtime, build_parser
+from gatekeeper_tpu.control.metrics import REGISTRY
+from gatekeeper_tpu.control.webhook import MicroBatcher, MutationHandler
+from gatekeeper_tpu.mutation import (
+    MutationError,
+    MutationSystem,
+    PathError,
+    apply_patch,
+    json_patch,
+    load_mutator,
+    parse,
+    render,
+)
+from gatekeeper_tpu.mutation.path import ListNode, ObjectNode
+from gatekeeper_tpu.target.matcher import constraint_matches
+
+
+def assign(name, location, value, apply_to=None, match=None):
+    spec = {
+        "applyTo": apply_to if apply_to is not None else [
+            {"groups": [""], "versions": ["v1"], "kinds": ["Pod"]}],
+        "location": location,
+        "parameters": {"assign": {"value": value}},
+    }
+    if match is not None:
+        spec["match"] = match
+    return {"apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+            "kind": "Assign", "metadata": {"name": name}, "spec": spec}
+
+
+def assign_meta(name, location, value, match=None):
+    spec = {"location": location,
+            "parameters": {"assign": {"value": value}}}
+    if match is not None:
+        spec["match"] = match
+    return {"apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+            "kind": "AssignMetadata", "metadata": {"name": name},
+            "spec": spec}
+
+
+def modify_set(name, location, values, operation="merge", match=None):
+    spec = {
+        "applyTo": [{"groups": [""], "versions": ["v1"], "kinds": ["Pod"]}],
+        "location": location,
+        "parameters": {"operation": operation,
+                       "values": {"fromList": values}},
+    }
+    if match is not None:
+        spec["match"] = match
+    return {"apiVersion": "mutations.gatekeeper.sh/v1alpha1",
+            "kind": "ModifySet", "metadata": {"name": name}, "spec": spec}
+
+
+def pod_review(name="p", ns="default", labels=None, containers=None):
+    obj = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": name, "namespace": ns},
+           "spec": {"containers": containers if containers is not None
+                    else [{"name": "main", "image": "x"}]}}
+    if labels is not None:
+        obj["metadata"]["labels"] = labels
+    return {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "name": name, "namespace": ns, "operation": "CREATE",
+            "object": obj}
+
+
+# ---------------------------------------------------------------- parser
+
+
+PATH_CASES = [
+    "spec.replicas",
+    "spec.containers[name: *].imagePullPolicy",
+    "spec.containers[name: sidecar].resources.limits",
+    'metadata.labels."corp.example/team"',
+    'spec."weird.field"[key: "v.1"].x',
+    "spec.template.spec.tolerations",
+]
+
+
+@pytest.mark.parametrize("path", PATH_CASES)
+def test_path_round_trip(path):
+    nodes = parse(path)
+    assert parse(render(nodes)) == nodes
+    # canonical form is a fixpoint
+    assert render(parse(render(nodes))) == render(nodes)
+
+
+def test_path_nodes_shape():
+    nodes = parse("spec.containers[name: *].imagePullPolicy")
+    assert nodes == [ObjectNode("spec"),
+                     ListNode("containers", "name", None, glob=True),
+                     ObjectNode("imagePullPolicy")]
+    keyed = parse("spec.containers[name: sidecar]")
+    assert keyed[-1] == ListNode("containers", "name", "sidecar")
+
+
+def test_path_integer_list_keys():
+    """Bare numeric key values are ints (real Pods carry int-typed
+    containerPort); quoting forces a string. Both round-trip."""
+    nodes = parse("spec.ports[containerPort: 8080].protocol")
+    assert nodes[1] == ListNode("ports", "containerPort", 8080)
+    assert parse(render(nodes)) == nodes
+    quoted = parse('spec.ports[containerPort: "8080"].protocol')
+    assert quoted[1] == ListNode("ports", "containerPort", "8080")
+    assert parse(render(quoted)) == quoted
+    assert nodes != quoted
+
+    m = load_mutator(assign(
+        "proto", "spec.ports[containerPort: 8080].protocol", "TCP"))
+    obj = {"spec": {"ports": [{"containerPort": 8080}]}}
+    assert m.apply(obj) is True
+    # matched the existing int-keyed element; no duplicate appended
+    assert obj["spec"]["ports"] == [{"containerPort": 8080,
+                                     "protocol": "TCP"}]
+
+
+def test_assign_rejects_glob_list_terminal():
+    """A glob terminal would rewrite every element with one identical
+    value (dropping the key field) — rejected at ingestion."""
+    with pytest.raises(MutationError, match="glob"):
+        load_mutator(assign("a", "spec.containers[name: *]",
+                            {"image": "x"}))
+
+
+@pytest.mark.parametrize("bad", [
+    "", "   ", "spec.", ".spec", "spec..x", "spec.containers[name]",
+    "spec.containers[name: ]", "spec.containers[name: *",
+    "spec.x[*: y]", 'spec."unterminated', "spec.a b",
+])
+def test_path_rejects_malformed(bad):
+    with pytest.raises(PathError):
+        parse(bad)
+
+
+# ----------------------------------------------------------------- apply
+
+
+def test_assign_creates_intermediates_and_keyed_elements():
+    m = load_mutator(assign("a", "spec.template.metadata.annotations.x",
+                            "y"))
+    obj = {"spec": {}}
+    assert m.apply(obj) is True
+    assert obj["spec"]["template"]["metadata"]["annotations"]["x"] == "y"
+    assert m.apply(obj) is False  # second application: no change
+
+    keyed = load_mutator(assign(
+        "b", "spec.containers[name: sidecar].image", "img:v1"))
+    obj = {"spec": {"containers": [{"name": "main", "image": "x"}]}}
+    assert keyed.apply(obj) is True
+    assert obj["spec"]["containers"][1] == {"name": "sidecar",
+                                            "image": "img:v1"}
+
+
+def test_assign_glob_never_creates():
+    m = load_mutator(assign("a", "spec.containers[name: *].imagePullPolicy",
+                            "Always"))
+    obj = {"spec": {}}
+    assert m.apply(obj) is False
+    assert obj == {"spec": {}}  # no containers list conjured
+    obj = {"spec": {"containers": [{"name": "a"}, {"name": "b"}]}}
+    assert m.apply(obj) is True
+    assert [c["imagePullPolicy"] for c in obj["spec"]["containers"]] == \
+        ["Always", "Always"]
+
+
+def test_assign_rejects_metadata_location():
+    with pytest.raises(MutationError):
+        load_mutator(assign("a", "metadata.labels.x", "y"))
+
+
+def test_assign_metadata_only_sets_when_absent():
+    m = load_mutator(assign_meta("a", "metadata.labels.team", "platform"))
+    obj = {"metadata": {"labels": {"team": "existing"}}}
+    assert m.apply(obj) is False
+    assert obj["metadata"]["labels"]["team"] == "existing"
+    obj = {"metadata": {}}
+    assert m.apply(obj) is True
+    assert obj["metadata"]["labels"]["team"] == "platform"
+
+
+def test_assign_metadata_location_constrained():
+    with pytest.raises(MutationError):
+        load_mutator(assign_meta("a", "spec.labels.x", "y"))
+    with pytest.raises(MutationError):
+        load_mutator(assign_meta("a", "metadata.name", "y"))
+    with pytest.raises(MutationError):
+        load_mutator(assign_meta("a", "metadata.labels.x", {"not": "str"}))
+
+
+def test_modify_set_merge_and_prune():
+    merge = load_mutator(modify_set(
+        "m", "spec.tolerations", [{"key": "gpu", "operator": "Exists"}]))
+    obj = {"spec": {}}
+    assert merge.apply(obj) is True
+    assert obj["spec"]["tolerations"] == [{"key": "gpu",
+                                           "operator": "Exists"}]
+    assert merge.apply(obj) is False  # already present: set semantics
+
+    prune = load_mutator(modify_set(
+        "p", "spec.tolerations", [{"key": "gpu", "operator": "Exists"}],
+        operation="prune"))
+    assert prune.apply(obj) is True
+    assert obj["spec"]["tolerations"] == []
+    # prune of a missing list must not create it
+    fresh = {"spec": {}}
+    assert prune.apply(fresh) is False
+    assert fresh == {"spec": {}}
+
+
+# ------------------------------------------------------------- conflicts
+
+
+def test_conflict_detector_quarantines_disagreeing_pair():
+    system = MutationSystem()
+    _, ch1 = system.upsert(assign(
+        "as-list", "spec.containers[name: *].imagePullPolicy", "Always"))
+    assert ch1 == set()
+    assert system.conflicts() == {}
+    # same prefix traversed as a plain object: terminal-type disagreement
+    _, ch2 = system.upsert(assign("as-object", "spec.containers.image",
+                                  "img"))
+    conflicts = system.conflicts()
+    assert set(conflicts) == {("Assign", "as-list"),
+                              ("Assign", "as-object")}
+    assert ch2 == set(conflicts)
+    assert "spec.containers" in conflicts[("Assign", "as-list")]
+    # quarantined mutators do not apply (None = nothing applied at all)
+    assert system.mutate(pod_review()) is None
+    # removal clears the quarantine for the survivor
+    ch3 = system.remove(("Assign", "as-object"))
+    assert system.conflicts() == {}
+    assert ("Assign", "as-list") in ch3
+    out = system.mutate(pod_review())
+    assert out["spec"]["containers"][0]["imagePullPolicy"] == "Always"
+
+
+def test_conflict_scoped_by_apply_to():
+    """Disagreeing implied types only conflict when the mutators'
+    applyTo scopes can select the same object (the reference's schema
+    DB binds per GVK): a Pod list-mutator and a CRD object-mutator on
+    the same path prefix coexist."""
+    system = MutationSystem()
+    system.upsert(assign(
+        "pod-list", "spec.containers[name: *].imagePullPolicy", "Always",
+        apply_to=[{"groups": [""], "versions": ["v1"], "kinds": ["Pod"]}]))
+    system.upsert(assign(
+        "crd-object", "spec.containers.image", "img",
+        apply_to=[{"groups": ["widgets.example"], "versions": ["v1"],
+                   "kinds": ["Widget"]}]))
+    assert system.conflicts() == {}
+    # a wildcard scope overlaps everything and re-introduces the clash
+    system.upsert(assign(
+        "star-object", "spec.containers.image", "img",
+        apply_to=[{"groups": ["*"], "versions": ["*"], "kinds": ["*"]}]))
+    assert {("Assign", "pod-list"), ("Assign", "star-object")} <= \
+        set(system.conflicts())
+
+
+def test_conflict_reason_refreshes_when_third_mutator_joins():
+    """A mutator joining an EXISTING conflict must flip the original
+    pair into the changed set (their reason text now cites it), and its
+    later removal must flip them again."""
+    system = MutationSystem()
+    system.upsert(assign("a-list", "spec.containers[name: *].x", "v"))
+    system.upsert(assign("b-object", "spec.containers.y", "v"))
+    _, ch = system.upsert(assign("c-object", "spec.containers.z", "v"))
+    # a-list's opponents grew (its reason now cites c-object); b-object's
+    # reason is unchanged, so only the affected pair is in the set
+    assert {("Assign", "a-list"), ("Assign", "c-object")} <= ch
+    assert "c-object" in system.conflicts()[("Assign", "a-list")]
+    ch2 = system.remove(("Assign", "c-object"))
+    assert {("Assign", "a-list"), ("Assign", "c-object")} <= ch2
+    assert "c-object" not in system.conflicts()[("Assign", "a-list")]
+
+
+def test_modifyset_terminal_implies_list_conflict():
+    system = MutationSystem()
+    system.upsert(modify_set("ms", "spec.tolerations", [{"key": "a"}]))
+    system.upsert(assign("as", "spec.tolerations.effect", "NoSchedule"))
+    assert set(system.conflicts()) == {("ModifySet", "ms"),
+                                       ("Assign", "as")}
+
+
+# ----------------------------------------------------- convergence + patch
+
+
+def test_convergence_cap_errors_on_ping_pong_pair():
+    system = MutationSystem(max_iterations=5)
+    system.upsert(assign("ping", "spec.priorityClassName", "low"))
+    system.upsert(assign("pong", "spec.priorityClassName", "high"))
+    with pytest.raises(MutationError, match="did not converge"):
+        system.mutate(pod_review())
+    # the batched entry carries the error instead of raising
+    outs = system.mutate_batch([pod_review()])
+    assert isinstance(outs[0], MutationError)
+
+
+def test_second_pass_idempotence_yields_empty_patch():
+    system = MutationSystem()
+    system.upsert(assign(
+        "pull", "spec.containers[name: *].imagePullPolicy", "Always"))
+    system.upsert(assign_meta("team", "metadata.labels.team", "plat"))
+    system.upsert(modify_set("tol", "spec.tolerations", [{"key": "gpu"}]))
+    review = pod_review()
+    mutated = system.mutate(review)
+    patch = json_patch(review["object"], mutated)
+    assert patch  # first pass did mutate
+    # a second trip through the webhook sees the already-mutated object
+    second = dict(review, object=mutated)
+    remutated = system.mutate(second)
+    assert json_patch(mutated, remutated) == []
+
+
+def test_json_patch_round_trip_and_escaping():
+    before = {"metadata": {"labels": {"a/b": "x", "t~e": "y"}},
+              "spec": {"items": [1, 2, 3], "drop": True}}
+    after = {"metadata": {"labels": {"a/b": "z", "new": "n"}},
+             "spec": {"items": [1, 9], "add": {"k": "v"}}}
+    ops = json_patch(before, after)
+    assert apply_patch(before, ops) == after
+    paths = [op["path"] for op in ops]
+    assert "/metadata/labels/a~1b" in paths  # RFC-6901 '/' escape
+    assert any(p.startswith("/metadata/labels/t~0e") for p in paths)
+    assert json_patch(after, after) == []
+
+
+# --------------------------------------------- batched applicability (diff)
+
+
+def _random_match(rng):
+    match = {}
+    if rng.random() < 0.5:
+        match["kinds"] = [{
+            "apiGroups": rng.choice(([""], ["*"], ["apps"])),
+            "kinds": rng.choice((["Pod"], ["*"], ["Deployment"],
+                                 ["Pod", "Service"])),
+        }]
+    if rng.random() < 0.35:
+        match["namespaces"] = rng.sample(
+            ["prod", "dev", "staging", "default"], rng.randrange(1, 3))
+    if rng.random() < 0.25:
+        match["excludedNamespaces"] = [rng.choice(["prod", "dev"])]
+    if rng.random() < 0.4:
+        match["labelSelector"] = rng.choice((
+            {"matchLabels": {"app": "web"}},
+            {"matchExpressions": [{"key": "tier", "operator": "Exists"}]},
+            {"matchExpressions": [{"key": "app", "operator": "In",
+                                   "values": ["web", "api"]}]},
+        ))
+    if rng.random() < 0.3:
+        match["namespaceSelector"] = {"matchLabels": {"env": "prod"}}
+    return match
+
+
+def _random_review(rng, i):
+    kind = rng.choice((("", "v1", "Pod"), ("", "v1", "Service"),
+                       ("apps", "v1", "Deployment"),
+                       ("", "v1", "Namespace")))
+    labels = rng.choice((None, {"app": "web"}, {"app": "api", "tier": "be"},
+                         {"tier": "fe"}))
+    obj = {"apiVersion": "v1", "kind": kind[2],
+           "metadata": {"name": f"o{i}"}}
+    if labels is not None:
+        obj["metadata"]["labels"] = labels
+    review = {"kind": {"group": kind[0], "version": kind[1],
+                       "kind": kind[2]},
+              "name": f"o{i}", "object": obj}
+    if kind[2] != "Namespace" and rng.random() < 0.8:
+        ns = rng.choice(["prod", "dev", "staging", "default", "unknown"])
+        review["namespace"] = ns
+        obj["metadata"]["namespace"] = ns
+    return review
+
+
+def test_batched_applicability_matches_per_object_predicate():
+    """The micro-batch mask must agree with per-object
+    constraint_matches AND the applyTo gate on every (review, mutator)
+    pair — ≥200 randomized reviews x a mixed mutator library."""
+    rng = random.Random(42)
+    ns_cache = {
+        "prod": {"apiVersion": "v1", "kind": "Namespace",
+                 "metadata": {"name": "prod", "labels": {"env": "prod"}}},
+        "dev": {"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "dev", "labels": {"env": "dev"}}},
+        "default": {"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "default", "labels": {}}},
+    }
+    lookup = ns_cache.get
+    system = MutationSystem()
+    mutators = []
+    for i in range(24):
+        shape = i % 3
+        match = _random_match(rng)
+        if shape == 0:
+            cr = assign(f"a{i}", "spec.one", "v", match=match,
+                        apply_to=[{
+                            "groups": rng.choice(([""], ["*"], ["apps"])),
+                            "versions": ["*"],
+                            "kinds": rng.choice((["Pod"], ["*"],
+                                                 ["Deployment"]))}])
+        elif shape == 1:
+            cr = assign_meta(f"m{i}", f"metadata.labels.x{i}", "v",
+                             match=match)
+        else:
+            cr = modify_set(f"s{i}", "spec.two", ["v"], match=match)
+        mut, _ = system.upsert(cr)
+        mutators.append(mut)
+    reviews = [_random_review(rng, i) for i in range(240)]
+    mask = system.match_mask(mutators, reviews, lookup)
+    assert mask.shape == (240, 24)
+    for r, review in enumerate(reviews):
+        kind = review["kind"]
+        for c, mut in enumerate(mutators):
+            want = constraint_matches({"spec": {"match": mut.match}},
+                                      review, lookup) and \
+                mut.applies_to_gvk(kind["group"], kind["version"],
+                                   kind["kind"])
+            assert mask[r, c] == want, (
+                f"disagreement at review {r} ({kind}), mutator "
+                f"{mut.id}: batched={mask[r, c]} per-object={want}")
+
+
+# -------------------------------------------------------- webhook handler
+
+
+def test_mutation_handler_patches_and_envelope():
+    system = MutationSystem()
+    system.upsert(assign(
+        "pull", "spec.containers[name: *].imagePullPolicy", "Always"))
+    handler = MutationHandler(system)
+    try:
+        review = pod_review()
+        out = handler.handle({
+            "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": dict(review, uid="u-1",
+                            userInfo={"username": "alice"})})
+        # envelope fidelity (required by admission.k8s.io/v1)
+        assert out["apiVersion"] == "admission.k8s.io/v1"
+        assert out["kind"] == "AdmissionReview"
+        resp = out["response"]
+        assert resp["uid"] == "u-1"
+        assert resp["allowed"] is True
+        assert resp["patchType"] == "JSONPatch"
+        ops = json.loads(base64.b64decode(resp["patch"]))
+        patched = apply_patch(review["object"], ops)
+        assert patched["spec"]["containers"][0]["imagePullPolicy"] == \
+            "Always"
+        # idempotence over the wire: mutated object → no patch key
+        again = handler.handle({
+            "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": dict(review, object=patched, uid="u-2",
+                            userInfo={"username": "alice"})})
+        assert "patch" not in again["response"]
+        assert again["response"]["allowed"] is True
+    finally:
+        handler.batcher.stop()
+
+
+def test_mutation_handler_failure_policy():
+    system = MutationSystem(max_iterations=2)
+    system.upsert(assign("ping", "spec.x", "a"))
+    system.upsert(assign("pong", "spec.x", "b"))
+    review = {"apiVersion": "admission.k8s.io/v1",
+              "kind": "AdmissionReview",
+              "request": dict(pod_review(), uid="u",
+                              userInfo={"username": "alice"})}
+    open_h = MutationHandler(system)
+    closed_h = MutationHandler(system, fail_closed=True)
+    try:
+        allowed = open_h.handle(copy.deepcopy(review))["response"]
+        denied = closed_h.handle(copy.deepcopy(review))["response"]
+    finally:
+        open_h.batcher.stop()
+        closed_h.batcher.stop()
+    assert allowed["allowed"] is True  # fail-open default
+    assert allowed["status"]["code"] == 500
+    assert denied["allowed"] is False  # --fail-closed
+    assert denied["status"]["code"] == 500
+    rendered = REGISTRY.render()
+    assert 'mutation_request_count{admission_status="error"}' in rendered
+
+
+def test_mutation_handler_skips_gatekeeper_resources_and_deletes():
+    system = MutationSystem()
+    system.upsert(assign_meta("lbl", "metadata.labels.x", "y"))
+    handler = MutationHandler(system)
+    try:
+        delete = handler.handle({"request": {
+            "uid": "d", "kind": {"group": "", "version": "v1",
+                                 "kind": "Pod"},
+            "operation": "DELETE", "object": None,
+            "userInfo": {"username": "alice"}}})
+        assert "patch" not in delete["response"]
+        own = handler.handle({"request": {
+            "uid": "o",
+            "kind": {"group": "mutations.gatekeeper.sh",
+                     "version": "v1alpha1", "kind": "Assign"},
+            "object": assign("x", "spec.a", "b"),
+            "userInfo": {"username": "alice"}}})
+        assert "patch" not in own["response"]
+    finally:
+        handler.batcher.stop()
+
+
+# -------------------------------------------------- micro-batcher timeout
+
+
+def test_microbatcher_timeout_drops_queued_entry():
+    """Satellite regression: a submit() that times out must remove its
+    queue entry (so a later flush never evaluates a request nobody
+    waits for) and count into admission_batch_timeouts."""
+    flushed: list = []
+
+    def evaluate(reviews):
+        flushed.extend(reviews)
+        return [[] for _ in reviews]
+
+    # collection window far past the submit timeout: the entry is still
+    # queued (not yet sealed) when the waiter gives up
+    b = MicroBatcher(None, max_wait=0.5, max_batch=64, evaluate=evaluate)
+    try:
+        before = b.timeouts
+        with pytest.raises(TimeoutError):
+            b.submit({"probe": 1}, timeout=0.05)
+        assert b.timeouts == before + 1
+        with b._cv:
+            assert b._queue == []  # the timed-out entry is gone
+        assert 'admission_batch_timeouts' in REGISTRY.render()
+        # the batcher still serves later requests; the abandoned review
+        # never reaches the evaluator
+        assert b.submit({"probe": 2}, timeout=5.0) == []
+        assert {"probe": 1} not in flushed
+    finally:
+        b.stop()
+
+
+# -------------------------------------------------- controller lifecycle
+
+
+@pytest.fixture
+def mutation_runtime():
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--health-addr", ":0", "--disable-cert-rotation",
+        "--operation", "webhook", "--operation", "mutation-webhook",
+    ])
+    rt = Runtime(args)
+    rt.args.metrics_backend = "none"
+    rt.start()
+    yield rt
+    rt.stop()
+
+
+def test_mutator_controller_lifecycle(mutation_runtime):
+    rt = mutation_runtime
+    kube = rt.kube
+    gvk = ("mutations.gatekeeper.sh", "v1alpha1", "Assign")
+    kube.create(assign("pull", "spec.containers[name: *].imagePullPolicy",
+                       "Always"))
+    rt.manager.drain()
+    assert rt.mutation_system.counts()["Assign"] == 1
+    status = kube.get(gvk, "pull").get("status") or {}
+    assert status["byPod"][0]["enforced"] is True
+
+    # conflicting mutator quarantines BOTH, including the pre-existing one
+    kube.create(assign("clash", "spec.containers.image", "img"))
+    rt.manager.drain()
+    assert set(rt.mutation_system.conflicts()) == {
+        ("Assign", "pull"), ("Assign", "clash")}
+    for name in ("pull", "clash"):
+        st = kube.get(gvk, name).get("status") or {}
+        assert st["byPod"][0]["enforced"] is False
+        assert "schema conflict" in st["byPod"][0]["errors"][0]["message"]
+
+    # deletion clears the quarantine and refreshes the survivor's status
+    kube.delete(gvk, "clash")
+    rt.manager.drain()
+    assert rt.mutation_system.conflicts() == {}
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        st = kube.get(gvk, "pull").get("status") or {}
+        if st["byPod"][0]["enforced"]:
+            break
+        time.sleep(0.02)
+    assert st["byPod"][0]["enforced"] is True
+
+    # invalid mutator: ingestion error surfaces in status
+    kube.create(assign("bad", "metadata.labels.x", "y"))
+    rt.manager.drain()
+    st = kube.get(gvk, "bad").get("status") or {}
+    assert st["byPod"][0]["enforced"] is False
+    assert rt.mutation_system.get(("Assign", "bad")) is None
+
+
+def test_mutation_only_operation_does_not_serve_validation():
+    """--operation mutation-webhook alone: /v1/admit and /v1/admitlabel
+    404 (a leftover VWC must not get decisions from an operation the
+    operator turned off); /v1/mutate serves."""
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--health-addr", ":0", "--disable-cert-rotation",
+        "--operation", "mutation-webhook"])
+    rt = Runtime(args)
+    rt.args.metrics_backend = "none"
+    rt.start()
+    try:
+        assert rt.webhook.validation is None
+        assert rt.webhook.ns_label is None
+        body = json.dumps({"apiVersion": "admission.k8s.io/v1",
+                           "kind": "AdmissionReview",
+                           "request": dict(pod_review(), uid="u",
+                                           userInfo={"username": "a"})})
+        for path, want in (("/v1/admit", 404), ("/v1/admitlabel", 404),
+                           ("/v1/mutate", 200)):
+            conn = http.client.HTTPConnection("127.0.0.1",
+                                              rt.webhook.port, timeout=10)
+            conn.request("POST", path, body,
+                         {"Content-Type": "application/json"})
+            assert conn.getresponse().status == want, path
+    finally:
+        rt.stop()
+
+
+def test_mutate_webhook_over_http(mutation_runtime):
+    rt = mutation_runtime
+    rt.kube.create(assign_meta("team", "metadata.labels.team", "plat"))
+    rt.manager.drain()
+    review = {"apiVersion": "admission.k8s.io/v1",
+              "kind": "AdmissionReview",
+              "request": dict(pod_review(), uid="uid-7",
+                              userInfo={"username": "alice"})}
+    conn = http.client.HTTPConnection("127.0.0.1", rt.webhook.port,
+                                      timeout=10)
+    conn.request("POST", "/v1/mutate", json.dumps(review),
+                 {"Content-Type": "application/json"})
+    out = json.loads(conn.getresponse().read())
+    assert out["apiVersion"] == "admission.k8s.io/v1"
+    assert out["kind"] == "AdmissionReview"
+    resp = out["response"]
+    assert resp["uid"] == "uid-7"
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    assert {"op": "add", "path": "/metadata/labels",
+            "value": {"team": "plat"}} in ops

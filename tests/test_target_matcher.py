@@ -258,3 +258,89 @@ def test_match_masks_equals_bruteforce_grid():
     got1 = match_masks(cons[:5], reviews, lookup, cache)
     got2 = match_masks(cons[5:], reviews, lookup, cache)
     assert (np.concatenate([got1, got2], axis=1) == want).all()
+
+
+# --------------------------------------------------- native edge-case grid
+# (no reference checkout required: these pin the label-selector semantics
+# the differential suites above cover only when /root/reference exists)
+
+
+def _match_constraint(match):
+    return {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "TestKind", "metadata": {"name": "edge"},
+            "spec": {"match": match}}
+
+
+def _pod_review(labels=None, ns="prod"):
+    obj = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "a", "namespace": ns}}
+    if labels is not None:
+        obj["metadata"]["labels"] = labels
+    return {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "namespace": ns, "object": obj}
+
+
+def _lookup(name):
+    return NS_OBJECTS.get(name)
+
+
+@pytest.mark.parametrize("op,values,labels,want", [
+    # Exists / DoesNotExist on a missing key
+    ("Exists", None, {}, False),
+    ("Exists", None, {"app": "web"}, True),
+    ("DoesNotExist", None, {}, True),
+    ("DoesNotExist", None, {"app": "web"}, False),
+    # NotIn on a missing key is NOT violated (src.rego:168-172 requires
+    # the key to be present for a NotIn violation)
+    ("NotIn", ["web"], {}, True),
+    ("NotIn", ["web"], {"app": "web"}, False),
+    ("NotIn", ["web"], {"app": "api"}, True),
+    # empty values: In is violated only by a missing key; NotIn never
+    ("In", [], {"app": "web"}, True),
+    ("In", [], {}, False),
+    ("NotIn", [], {"app": "web"}, True),
+    ("NotIn", [], {}, True),
+])
+def test_label_selector_expression_edges(op, values, labels, want):
+    expr = {"key": "app", "operator": op}
+    if values is not None:
+        expr["values"] = values
+    c = _match_constraint({"labelSelector": {"matchExpressions": [expr]}})
+    assert constraint_matches(c, _pod_review(labels), _lookup) is want
+
+
+def test_nsselector_vs_cluster_scoped_reviews():
+    c = _match_constraint(
+        {"namespaceSelector": {"matchLabels": {"env": "prod"}}})
+    # a cluster-scoped non-Namespace review has no resolvable namespace:
+    # the constraint never matches (src.rego:286-302 get_ns undefined)
+    crd_review = {"kind": {"group": "apiextensions.k8s.io",
+                           "version": "v1beta1",
+                           "kind": "CustomResourceDefinition"},
+                  "object": {"apiVersion": "apiextensions.k8s.io/v1beta1",
+                             "kind": "CustomResourceDefinition",
+                             "metadata": {"name": "crd"}}}
+    assert constraint_matches(c, crd_review, _lookup) is False
+    # but a Namespace-kind review matches against its OWN labels
+    ns_review = {"kind": {"group": "", "version": "v1",
+                          "kind": "Namespace"},
+                 "object": NS_OBJECTS["prod"], "name": "prod"}
+    assert constraint_matches(c, ns_review, _lookup) is True
+    dev_review = {"kind": {"group": "", "version": "v1",
+                           "kind": "Namespace"},
+                  "object": NS_OBJECTS["dev"], "name": "dev"}
+    assert constraint_matches(c, dev_review, _lookup) is False
+    # namespaced review in an uncached namespace: no match (autoreject
+    # territory), while a cached one selects via the cache
+    assert constraint_matches(c, _pod_review({}, ns="prod"), _lookup)
+    assert not constraint_matches(c, _pod_review({}, ns="nowhere"),
+                                  _lookup)
+
+
+def test_nsselector_missing_key_expressions_on_namespace_labels():
+    c = _match_constraint({"namespaceSelector": {"matchExpressions": [
+        {"key": "team", "operator": "DoesNotExist"}]}})
+    assert constraint_matches(c, _pod_review({}, ns="prod"), _lookup)
+    c2 = _match_constraint({"namespaceSelector": {"matchExpressions": [
+        {"key": "team", "operator": "Exists"}]}})
+    assert not constraint_matches(c2, _pod_review({}, ns="prod"), _lookup)
