@@ -1,6 +1,6 @@
 #!/usr/bin/env python
-"""BASELINE.md configs #1, #2, #3, #5, #6, #7 (config #4 is bench.py's
-headline).
+"""BASELINE.md configs #1, #2, #3, #5, #6, #7, #8 (config #4 is
+bench.py's headline).
 
 One JSON line per config:
   #1 requiredlabels x 1k Namespaces     — full audit wall-clock + the
@@ -20,9 +20,13 @@ One JSON line per config:
   #7 mutating admission: micro-batched /v1/mutate throughput + p50 at
      three mutator-library sizes (batched applicability matching +
      host apply-to-convergence + RFC-6902 patch generation)
+  #8 resilience under overload: a 64-thread closed loop against a
+     deliberately slowed flusher with a bounded queue and 2s propagated
+     deadlines — shed/deadline fractions plus the worst decision
+     latency as a fraction of the deadline (must stay < 1.0)
 
 All audits run steady-state through client.audit() (warm caches), same
-contract as bench.py. Run: python bench_configs.py [1 2 3 5 6 7]
+contract as bench.py. Run: python bench_configs.py [1 2 3 5 6 7 8]
 """
 
 from __future__ import annotations
@@ -947,9 +951,99 @@ def config5():
     }))
 
 
+# --------------------------------------------------------------- config 8
+
+
+def config8():
+    """Resilience under overload: a 64-thread closed loop drives the
+    micro-batched ValidationHandler through a flusher slowed to well
+    below the offered load, with a bounded queue and 2s propagated
+    deadlines. Measures the shed fraction, the deadline-answer fraction,
+    and — the resilience headline — the WORST decision latency as a
+    fraction of the deadline: every request must be answered before the
+    API server would have given up, no matter the overload."""
+    import threading
+
+    from gatekeeper_tpu.control.webhook import (
+        MicroBatcher,
+        ValidationHandler,
+    )
+
+    _, client = _general_library_client()
+    reviews = _mixed_reviews(max(64, int(256 * SCALE)), seed=5)
+    inner = None
+
+    def slowed(batch):
+        time.sleep(0.05)  # force overload: capacity ~20 batches/s
+        return inner(batch)
+
+    batcher = MicroBatcher(client, max_wait=0.002, max_batch=16,
+                           evaluate=slowed, max_queue=64)
+    inner = batcher._evaluate_violations
+    handler = ValidationHandler(client, batcher=batcher)
+    timeout_s = 2
+    payloads = [{"apiVersion": "admission.k8s.io/v1",
+                 "kind": "AdmissionReview",
+                 "request": dict(r, uid=f"u{k}", timeoutSeconds=timeout_s,
+                                 userInfo={"username": "bench"})}
+                for k, r in enumerate(reviews)]
+    handler.handle(payloads[0])
+    counts: dict[str, int] = {}
+    lats: list = []
+    lock = threading.Lock()
+    n_threads = 64
+    duration = 4.0 * max(SCALE, 0.25)
+    stop = time.time() + duration
+
+    def worker(k: int):
+        mine: list = []
+        mcounts: dict[str, int] = {}
+        j = 0
+        while time.time() < stop:
+            t0 = time.time()
+            out = handler.handle(payloads[(k * 131 + j) % len(payloads)])
+            dt = time.time() - t0
+            mine.append(dt)
+            resp = out["response"]
+            code = (resp.get("status") or {}).get("code")
+            key = {429: "shed", 504: "deadline"}.get(code, "decided")
+            mcounts[key] = mcounts.get(key, 0) + 1
+            j += 1
+        with lock:
+            lats.extend(mine)
+            for key, n in mcounts.items():
+                counts[key] = counts.get(key, 0) + n
+
+    ths = [threading.Thread(target=worker, args=(k,))
+           for k in range(n_threads)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    batcher.stop()
+    lats.sort()
+    total = len(lats)
+    worst_frac = (lats[-1] / timeout_s) if lats else 0.0
+    print(json.dumps({
+        "config": 8, "metric": "overload_worst_latency_deadline_frac",
+        "value": round(worst_frac, 3),
+        "unit": f"worst decision latency / {timeout_s}s deadline under "
+                f"{n_threads}-thread overload (must stay < 1.0: every "
+                "request answered before the API server gives up)",
+        "requests": total,
+        "decided_frac": round(counts.get("decided", 0) / max(total, 1), 3),
+        "shed_frac": round(counts.get("shed", 0) / max(total, 1), 3),
+        "deadline_frac": round(counts.get("deadline", 0) / max(total, 1),
+                               3),
+        "p50_ms": round(lats[total // 2] * 1000, 2) if lats else None,
+        "p99_ms": round(lats[int(total * 0.99)] * 1000, 2) if lats
+        else None,
+    }))
+
+
 def run(which: list[int]) -> None:
     table = {1: config1, 2: config2, 3: config3, 5: config5, 6: config6,
-             7: config7}
+             7: config7, 8: config8}
     for c in which:
         if c not in table:
             sys.exit(f"unknown bench config {c}: choose from "

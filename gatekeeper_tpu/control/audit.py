@@ -399,7 +399,8 @@ class AuditManager:
                  DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT,
                  audit_from_cache: bool = False,
                  incremental: bool = False,
-                 full_resync_every: int = DEFAULT_FULL_RESYNC_EVERY):
+                 full_resync_every: int = DEFAULT_FULL_RESYNC_EVERY,
+                 write_breaker=None):
         self.kube = kube
         self.opa = opa
         self.interval = interval
@@ -409,12 +410,20 @@ class AuditManager:
         # N <= 0 disables the PERIODIC re-encode (k8s resync-period
         # convention); the first sweep always encodes from scratch
         self.full_resync_every = full_resync_every
+        # shared kube-write circuit breaker (resilience.CircuitBreaker):
+        # while open, status writes are deferred for the sweep instead
+        # of hot-looping retries against a down API server — the skip-
+        # unchanged delta logic re-issues them once writes heal
+        self.write_breaker = write_breaker
         self.tracker: Optional[InventoryTracker] = None
         self._sweeps = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last_results: list = []
         self.last_sweep_stats: dict = {}
+        # liveness heartbeat: stamped every loop iteration; healthy()
+        # flags a dead/stalled audit loop for the k8s liveness probe
+        self.heartbeat = time.monotonic()
 
     # ------------------------------------------------------------- lifecycle
 
@@ -430,16 +439,34 @@ class AuditManager:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            self.heartbeat = time.monotonic()
             try:
                 self.audit_once()
             except Exception as e:
                 log.error("audit failed", details=str(e))
+            self.heartbeat = time.monotonic()
             self._stop.wait(self.interval)
+
+    def healthy(self, max_stall: Optional[float] = None) -> bool:
+        """Liveness: the loop thread is alive and has heartbeaten within
+        max_stall (default: generous multiple of the sweep interval).
+        Sweeps stamp PROGRESS heartbeats (per listed GVK, per status
+        write), so a long sweep that keeps moving never trips the
+        watchdog; only one that stalls for max_stall inside a single
+        step does."""
+        if self._thread is None:
+            return True  # not started yet
+        if not self._thread.is_alive():
+            return self._stop.is_set()  # stopped on purpose is fine
+        if max_stall is None:
+            max_stall = max(10 * self.interval, 300.0)
+        return time.monotonic() - self.heartbeat <= max_stall
 
     # ----------------------------------------------------------------- audit
 
     def audit_once(self) -> list:
         t0 = time.time()
+        self.heartbeat = time.monotonic()
         sweep_stats: dict = {}
         if self.incremental:
             results, sweep_stats = self._audit_incremental()
@@ -474,6 +501,10 @@ class AuditManager:
         details = {"violations": len(results), "duration_s": round(dt, 3),
                    **sweep_stats, **writes}
         driver = getattr(self.opa, "driver", None)
+        if hasattr(driver, "quarantine_status"):
+            q = driver.quarantine_status()
+            if q:
+                details["quarantined"] = q
         if hasattr(driver, "warm_status"):
             st = driver.warm_status()
             metrics.report_device_programs(st["warm"], st["compiling"])
@@ -544,6 +575,10 @@ class AuditManager:
         ns_by_name: dict[str, dict] = {}
         saw_ns_kind = False
         for gvk in _auditable_gvks(self.kube):
+            # progress heartbeat: a legitimately long discovery sweep
+            # keeps beating per GVK, so the liveness watchdog only
+            # trips on a sweep that stopped making progress
+            self.heartbeat = time.monotonic()
             try:
                 objs = self.kube.list(gvk)
             except KubeError:
@@ -657,6 +692,15 @@ class AuditManager:
         fingerprint) means an externally clobbered status self-heals on
         the next sweep. `force` writes everything (full-resync sweeps
         use it to refresh auditTimestamp periodically)."""
+        if self.write_breaker is not None and self.write_breaker.is_open:
+            # API-server writes are circuit-broken: defer ALL status
+            # writes this sweep (no hot-loop of doomed PATCHes). The
+            # violation deltas stay pending — the skip-unchanged
+            # comparison below re-issues them on the first healthy sweep
+            log.warning("kube-write breaker open; deferring constraint "
+                        "status writes this sweep")
+            return {"status_writes": 0, "status_skipped": 0,
+                    "status_deferred": True}
         target_kinds = set()
         for kind in self.opa.template_kinds():
             target_kinds.add(kind)
@@ -668,6 +712,7 @@ class AuditManager:
             except KubeError:
                 continue
             for obj in constraints:
+                self.heartbeat = time.monotonic()  # progress per write
                 name = (obj.get("metadata") or {}).get("name") or ""
                 violations = by_constraint.get((kind, name), [])
                 entries = self._status_entries(violations)
@@ -715,21 +760,20 @@ class AuditManager:
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         status["totalViolations"] = total
         status["violations"] = entries
-        for attempt in range(5):
+        from .resilience import guarded_status_update
+
+        def refresh(cur_obj):
             try:
-                self.kube.update(obj, subresource="status")
-                return True
-            except NotFound:
-                return False
+                meta = cur_obj.get("metadata") or {}
+                cur = self.kube.get(
+                    (CONSTRAINT_GROUP, "v1beta1", cur_obj.get("kind")),
+                    meta.get("name") or "")
             except KubeError:
-                time.sleep(0.01 * (2 ** attempt))
-                try:
-                    meta = obj.get("metadata") or {}
-                    cur = self.kube.get(
-                        (CONSTRAINT_GROUP, "v1beta1", obj.get("kind")),
-                        meta.get("name") or "")
-                    cur["status"] = status
-                    obj = cur
-                except KubeError:
-                    return False
-        return False
+                return None
+            cur["status"] = status
+            return cur
+
+        # shared breaker-aware protocol: breaker refusals and guarded-
+        # client transients return immediately (the next sweep's delta
+        # comparison re-issues the write); only Conflicts refresh-retry
+        return guarded_status_update(self.kube, obj, refresh)

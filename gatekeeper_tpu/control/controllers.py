@@ -32,6 +32,7 @@ from ..target.handler import WipeData
 from . import metrics
 from .kube import GVK, FakeKube, KubeError, NotFound, WatchEvent, gvk_of
 from .logging import logger
+from .resilience import guarded_status_update
 from .util import (
     DEFAULT_ENFORCEMENT_ACTION,
     VALID_ENFORCEMENT_ACTIONS,
@@ -54,22 +55,22 @@ log = logger("controller")
 
 def _retry_status_update(kube, obj: dict, attempts: int = 5) -> None:
     """Status write with conflict retry (reference retry loops, e.g.
-    constrainttemplate_controller.go:548-555)."""
-    for i in range(attempts):
+    constrainttemplate_controller.go:548-555), riding the shared
+    breaker-aware protocol in resilience.guarded_status_update."""
+
+    def refresh(cur_obj):
         try:
-            kube.update(obj, subresource="status")
-            return
+            cur = kube.get(gvk_of(cur_obj),
+                           (cur_obj.get("metadata") or {}).get("name")
+                           or "",
+                           (cur_obj.get("metadata") or {}).get("namespace")
+                           or "")
         except KubeError:
-            time.sleep(0.01 * (2 ** i))
-            try:
-                cur = kube.get(gvk_of(obj),
-                               (obj.get("metadata") or {}).get("name") or "",
-                               (obj.get("metadata") or {}).get("namespace")
-                               or "")
-                cur["status"] = obj.get("status")
-                obj = cur
-            except KubeError:
-                return
+            return None
+        cur["status"] = cur_obj.get("status")
+        return cur
+
+    guarded_status_update(kube, obj, refresh, attempts)
 
 
 class _Worker:
@@ -167,13 +168,33 @@ class TemplateController:
             log.warning("constraint CRD apply failed", template_name=name,
                         details=str(e))
         gvk = (CONSTRAINT_GROUP, "v1beta1", kind)
-        if isinstance(self.kube, FakeKube):
+        # unwrap a resilience.GuardedKube proxy for the fake check
+        if isinstance(getattr(self.kube, "inner", self.kube), FakeKube):
             self.kube.register_kind(gvk, namespaced=False)
         self._tracked[name] = gvk
         self.constraint_ctrl.registrar.add_watch(gvk)
         metrics.report_template_ingestion("ok", time.time() - t0)
         metrics.report_constraint_templates("active", len(self._tracked))
         self._write_status(obj, created=True)
+
+    def note_quarantine(self, kind: str, reason: Optional[str]) -> None:
+        """Driver callback (TpuDriver.on_quarantine): surface a device-
+        path quarantine — or its recovery (reason=None) — on the owning
+        ConstraintTemplate's byPod status, so `kubectl get` shows WHY a
+        template's reviews run degraded."""
+        # snapshot: this runs on a driver notification thread while the
+        # controller worker mutates _tracked (dict-changed-size race)
+        name = next((n for n, g in list(self._tracked.items())
+                     if g[2] == kind), None)
+        if name is None:
+            return
+        try:
+            obj = self.kube.get(TEMPLATE_GVK, name)
+        except KubeError:
+            return
+        errors = [f"device path quarantined: {reason} (interpreter "
+                  "fallback serving reviews)"] if reason else None
+        self._write_status(obj, created=True, errors=errors)
 
     def _handle_delete_by_name(self, name: str) -> None:
         gvk = self._tracked.pop(name, None)

@@ -2,9 +2,11 @@
 
 Counterpart of the reference's healthz/readyz wiring (main.go:205-212:
 a ping checker on /healthz and default-ready /readyz served on
---health-addr). /healthz answers 200 as soon as the server is up (the
-process is alive); /readyz consults the registered readiness checks and
-answers 503 with the failing check names until they all pass.
+--health-addr). /healthz consults the registered LIVENESS watchdogs
+(none registered = plain ping): a wedged micro-batch flusher or a dead
+audit loop fails liveness so k8s restarts the pod. /readyz consults the
+registered readiness checks (including the kube-write circuit breaker)
+and answers 503 with the failing check names until they all pass.
 """
 
 from __future__ import annotations
@@ -39,9 +41,21 @@ class HealthServer:
 
     def __init__(self, host: str, port: int):
         self._checks: dict[str, Callable[[], bool]] = {}
+        self._live: dict[str, Callable[[], bool]] = {}
         self._lock = threading.Lock()
         checks = self._checks
+        live = self._live
         lock = self._lock
+
+        def failing(items) -> list[str]:
+            out = []
+            for name, fn in items:
+                try:
+                    if not fn():
+                        out.append(name)
+                except Exception:
+                    out.append(name)
+            return out
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
@@ -50,21 +64,27 @@ class HealthServer:
             def do_GET(self):
                 path = self.path.split("?", 1)[0].rstrip("/")
                 if path == "/healthz":
-                    self._reply(200, b"ok")
+                    # liveness watchdog: a wedged flusher/audit loop
+                    # fails liveness so k8s restarts the pod (a process
+                    # that is up but not serving is NOT alive)
+                    with lock:
+                        items = list(live.items())
+                    bad = failing(items)
+                    if bad:
+                        log.error("liveness check failing",
+                                  details={"checks": bad})
+                        self._reply(503, ("not alive: "
+                                          + ", ".join(bad)).encode())
+                    else:
+                        self._reply(200, b"ok")
                     return
                 if path == "/readyz":
                     with lock:
                         items = list(checks.items())
-                    failing = []
-                    for name, fn in items:
-                        try:
-                            if not fn():
-                                failing.append(name)
-                        except Exception:
-                            failing.append(name)
-                    if failing:
+                    bad = failing(items)
+                    if bad:
                         self._reply(503, ("not ready: "
-                                          + ", ".join(failing)).encode())
+                                          + ", ".join(bad)).encode())
                     else:
                         self._reply(200, b"ok")
                     return
@@ -88,6 +108,12 @@ class HealthServer:
     def add_readiness(self, name: str, check: Callable[[], bool]) -> None:
         with self._lock:
             self._checks[name] = check
+
+    def add_liveness(self, name: str, check: Callable[[], bool]) -> None:
+        """Register a liveness watchdog: /healthz answers 503 while any
+        registered check fails, so the kubelet restarts a wedged pod."""
+        with self._lock:
+            self._live[name] = check
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,

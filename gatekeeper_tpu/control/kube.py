@@ -16,6 +16,7 @@ from __future__ import annotations
 import copy
 import json
 import os
+import random
 import ssl
 import threading
 import time
@@ -511,6 +512,19 @@ class RestKubeClient:
     # streams get cut mid-wait
     WATCH_TIMEOUT_S = 300
 
+    # backoff-relist loop bounds: full jitter on every sleep so an API
+    # server blip does not re-synchronize every watcher in the cluster
+    # into a thundering re-list herd at t+0.5, t+1, t+2, ...
+    WATCH_BACKOFF_BASE_S = 0.5
+    WATCH_BACKOFF_CAP_S = 30.0
+
+    def _watch_backoff_wait(self, stop: threading.Event,
+                            backoff: float) -> float:
+        """Sleep a jittered backoff (uniform in [backoff/2, backoff]);
+        returns the next, doubled-and-capped backoff."""
+        stop.wait(backoff * (0.5 + random.random() * 0.5))
+        return min(backoff * 2, self.WATCH_BACKOFF_CAP_S)
+
     def watch(self, gvk: GVK, callback, send_initial: bool = True):
         """Streaming watch (?watch=1&resourceVersion=...) with bookmark
         handling and backoff-relist on 410 Gone — client-go informer
@@ -601,7 +615,7 @@ class RestKubeClient:
             first = True
             rv = ""
             need_relist = True
-            backoff = 0.5
+            backoff = self.WATCH_BACKOFF_BASE_S
             bad_frames = 0
             while not stop.is_set():
                 try:
@@ -610,7 +624,7 @@ class RestKubeClient:
                         first = False
                         need_relist = False
                     known, rv, gone = stream(known, rv)
-                    backoff = 0.5
+                    backoff = self.WATCH_BACKOFF_BASE_S
                     bad_frames = 0
                     if gone:
                         need_relist = True  # RV expired: resync
@@ -620,8 +634,7 @@ class RestKubeClient:
                         poll_loop(known, first)
                         return
                     need_relist = True
-                    stop.wait(backoff)
-                    backoff = min(backoff * 2, 30)
+                    backoff = self._watch_backoff_wait(stop, backoff)
                 except (KubeError, OSError, ValueError) as e:
                     if isinstance(e, KubeError) and \
                             "unexpected frame" in str(e):
@@ -633,8 +646,7 @@ class RestKubeClient:
                             poll_loop(known, first)
                             return
                     need_relist = True
-                    stop.wait(backoff)
-                    backoff = min(backoff * 2, 30)
+                    backoff = self._watch_backoff_wait(stop, backoff)
 
         t = threading.Thread(target=loop, daemon=True)
         t.start()

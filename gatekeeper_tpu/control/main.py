@@ -28,9 +28,11 @@ from .audit import (
     DEFAULT_FULL_RESYNC_EVERY,
     AuditManager,
 )
+from ..utils.faults import FAULTS
 from .certs import CertRotator
 from .controllers import ControllerManager
 from .kube import FakeKube, RestKubeClient
+from .resilience import CircuitBreaker, GuardedKube, RetryBudget
 from .upgrade import UpgradeManager
 from .webhook import (
     MicroBatcher,
@@ -119,6 +121,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mutation-batch-max-wait", type=float, default=0.005,
                    help="mutating webhook micro-batch collection window "
                         "(seconds)")
+    p.add_argument("--admission-max-queue", type=int, default=4096,
+                   help="micro-batch queue depth beyond which admission "
+                        "requests are SHED immediately with the failure-"
+                        "stance verdict (status=shed) instead of "
+                        "queueing into certain timeout; 0 = unbounded")
+    p.add_argument("--admission-default-timeout", type=float, default=10.0,
+                   help="deadline (seconds) assumed for AdmissionReviews "
+                        "that carry no request.timeoutSeconds; the "
+                        "verdict ships before this minus a safety "
+                        "margin, matching the API server's 10s webhook "
+                        "default")
+    p.add_argument("--kube-breaker-threshold", type=int, default=5,
+                   help="consecutive kube WRITE failures that open the "
+                        "shared circuit breaker (status writes defer, "
+                        "readiness reports the open breaker)")
+    p.add_argument("--kube-breaker-reset", type=float, default=30.0,
+                   help="seconds an open kube-write breaker waits "
+                        "before half-opening for a probe write")
+    p.add_argument("--kube-retry-budget", type=float, default=10.0,
+                   help="shared token budget for kube write RETRIES "
+                        "(first attempts are free); refills at 1/s — "
+                        "bounds retry amplification during API-server "
+                        "outages")
+    p.add_argument("--fault-injection", default="",
+                   help="arm chaos faults, e.g. "
+                        "'kube.write:error:503@0.5,webhook.flush:sleep:2'"
+                        " (see gatekeeper_tpu/utils/faults.py; also via "
+                        "GATEKEEPER_TPU_FAULTS)")
     p.add_argument("--disable-cert-rotation", action="store_true")
     p.add_argument("--disable-enforcementaction-validation",
                    action="store_true")
@@ -144,6 +174,20 @@ class Runtime:
             FakeKube() if args.fake_kube else RestKubeClient())
         if isinstance(self.kube, FakeKube):
             self._register_builtin_kinds()
+        if getattr(args, "fault_injection", ""):
+            FAULTS.configure(args.fault_injection)
+            log.warning("fault injection armed",
+                        details={"points": FAULTS.armed()})
+        # shared write-resilience: one breaker + retry budget for every
+        # control-loop writer (audit status PATCHes, cert secret/CA
+        # injection); readiness surfaces the open breaker
+        self.write_breaker = CircuitBreaker(
+            "kube-writes",
+            failure_threshold=getattr(args, "kube_breaker_threshold", 5),
+            reset_timeout=getattr(args, "kube_breaker_reset", 30.0))
+        self.kube_guard = GuardedKube(
+            self.kube, self.write_breaker,
+            RetryBudget(getattr(args, "kube_retry_budget", 10.0)))
         driver = TpuDriver()
         self.opa = Backend(driver).new_client([K8sValidationTarget()])
         self.mutation_system = None
@@ -151,30 +195,43 @@ class Runtime:
             from ..mutation import MutationSystem
             self.mutation_system = MutationSystem(
                 max_iterations=getattr(args, "mutation_max_iterations", 10))
+        # controllers ride the guarded client too: byPod status writes
+        # and CRD applies share the one breaker/retry discipline (reads
+        # and watches pass straight through the proxy)
         self.manager = ControllerManager(
-            self.kube, self.opa,
+            self.kube_guard, self.opa,
             validate_actions=not args.disable_enforcementaction_validation,
             mutation_system=self.mutation_system)
+        # the driver's device-eval quarantine surfaces on the owning
+        # template's byPod status through the template controller
+        if hasattr(driver, "on_quarantine"):
+            driver.on_quarantine = self.manager.template_ctrl.note_quarantine
         self.audit = None
         if "audit" in operations:
+            # the guarded client: status writes ride the shared breaker/
+            # retry budget; reads and the tracker's watches pass through
             self.audit = AuditManager(
-                self.kube, self.opa, interval=args.audit_interval,
+                self.kube_guard, self.opa, interval=args.audit_interval,
                 constraint_violations_limit=args.constraint_violations_limit,
                 audit_from_cache=str(args.audit_from_cache).lower() == "true",
                 incremental=str(getattr(args, "audit_incremental",
                                         "false")).lower() == "true",
                 full_resync_every=getattr(args, "audit_full_resync_every",
-                                          DEFAULT_FULL_RESYNC_EVERY))
+                                          DEFAULT_FULL_RESYNC_EVERY),
+                write_breaker=self.write_breaker)
         self.webhook = None
         self.cert_rotator = None
         if "webhook" in operations or "mutation-webhook" in operations:
             fail_closed = getattr(args, "fail_closed", False)
             validation = ns_label = None
+            max_queue = getattr(args, "admission_max_queue", 4096)
+            default_timeout = getattr(args, "admission_default_timeout",
+                                      10.0)
             if "webhook" in operations:
                 # a mutation-only process must NOT serve /v1/admit — a
                 # leftover VWC would get decisions from an operation the
                 # operator turned off (unserved endpoints 404)
-                batcher = MicroBatcher(self.opa)
+                batcher = MicroBatcher(self.opa, max_queue=max_queue)
                 validation = ValidationHandler(
                     self.opa, kube=self.kube, batcher=batcher,
                     log_denies=args.log_denies,
@@ -182,7 +239,8 @@ class Runtime:
                     args.disable_enforcementaction_validation,
                     traces_provider=lambda:
                     self.manager.config_ctrl.traces,
-                    fail_closed=fail_closed)
+                    fail_closed=fail_closed,
+                    default_timeout=default_timeout)
                 ns_label = NamespaceLabelHandler(
                     tuple(args.exempt_namespace))
             mutation = None
@@ -194,10 +252,15 @@ class Runtime:
                     fail_closed=fail_closed if mut_fail_closed is None
                     else mut_fail_closed,
                     batch_max_wait=getattr(args, "mutation_batch_max_wait",
-                                           0.005))
+                                           0.005),
+                    max_queue=max_queue,
+                    default_timeout=default_timeout)
             certfile = keyfile = None
             if not args.disable_cert_rotation:
-                self.cert_rotator = CertRotator(self.kube, args.cert_dir)
+                # guarded: secret persistence and CA-bundle injection
+                # retry under the shared breaker/budget
+                self.cert_rotator = CertRotator(self.kube_guard,
+                                                args.cert_dir)
                 try:
                     self.cert_rotator.refresh_certs()
                     certfile = f"{args.cert_dir}/tls.crt"
@@ -251,10 +314,37 @@ class Runtime:
             try:
                 self.health = health.HealthServer(*addr)
                 self.health.add_readiness("runtime", lambda: self._ready)
+                if self.webhook is None:
+                    # audit/controller-only pods surface the open
+                    # kube-write breaker through readiness. Webhook
+                    # pods must NOT: every replica shares one API
+                    # server, so a cluster-wide write brownout would
+                    # open every replica's breaker at once and pull
+                    # ALL admission endpoints — turning a partial
+                    # degradation (serving works, writes don't) into a
+                    # full admission outage. There the breaker stays
+                    # observable via metrics and logs.
+                    self.health.add_readiness(
+                        "kube-writes",
+                        lambda: not self.write_breaker.is_open)
                 if self.webhook:
                     self.health.add_readiness(
                         "webhook",
                         lambda: self.webhook._thread.is_alive())
+                    # liveness watchdogs: a wedged micro-batch pipeline
+                    # (dead flusher, hung evaluation with a growing
+                    # queue) fails /healthz so k8s restarts the pod
+                    if self.webhook.validation is not None:
+                        self.health.add_liveness(
+                            "admission-batcher",
+                            self.webhook.validation.batcher.healthy)
+                    if self.webhook.mutation is not None:
+                        self.health.add_liveness(
+                            "mutation-batcher",
+                            self.webhook.mutation.batcher.healthy)
+                if self.audit:
+                    self.health.add_liveness("audit-loop",
+                                             self.audit.healthy)
                 self.health.start()
             except OSError as e:
                 log.warning("health port unavailable", details=str(e))

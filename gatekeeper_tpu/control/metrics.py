@@ -194,6 +194,52 @@ def report_batch_timeout(n: int = 1) -> None:
                          "their micro-batch to flush", n)
 
 
+def report_admission_shed(n: int = 1) -> None:
+    """A submit() was refused at enqueue time: the micro-batch queue was
+    at --admission-max-queue depth, so the request was answered per the
+    failure stance immediately instead of queueing into certain
+    timeout."""
+    REGISTRY.counter_add("admission_requests_shed_total",
+                         "Admission requests shed by the bounded "
+                         "micro-batch queue", n)
+
+
+_BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def report_breaker(name: str, state: str) -> None:
+    """Circuit breaker state gauge (0=closed, 1=half-open, 2=open) plus
+    a transition counter so open->half-open->close cycles stay visible
+    after the fact."""
+    REGISTRY.gauge_set("gatekeeper_tpu_circuit_breaker_state",
+                       "Circuit breaker state (0=closed, 1=half-open, "
+                       "2=open)", _BREAKER_STATES.get(state, 2),
+                       breaker=name)
+    REGISTRY.counter_add("gatekeeper_tpu_circuit_breaker_transitions_total",
+                         "Circuit breaker transitions by target state",
+                         breaker=name, to=state)
+
+
+def report_kube_write(outcome: str) -> None:
+    """One guarded kube write by outcome: ok, retried_ok, failed,
+    breaker_open (refused locally), budget_exhausted (retry budget
+    empty)."""
+    REGISTRY.counter_add("gatekeeper_tpu_kube_writes_total",
+                         "Guarded kube API writes by outcome",
+                         outcome=outcome)
+
+
+def report_template_quarantine(kind: str, quarantined: bool) -> None:
+    """Device-path quarantine flag per template kind: 1 while the
+    compiled program is benched (reviews serve from the interpreter), 0
+    once the probe sweep succeeds and the device path is restored."""
+    REGISTRY.gauge_set("gatekeeper_tpu_template_quarantined",
+                       "1 while the template's device program is "
+                       "quarantined after eval failures (interpreter "
+                       "fallback serves its reviews)",
+                       1 if quarantined else 0, kind=kind)
+
+
 def report_mutation_request(admission_status: str, seconds: float) -> None:
     """One /v1/mutate decision (reference mutation stats reporter
     metric names)."""

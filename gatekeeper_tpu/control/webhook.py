@@ -36,6 +36,7 @@ from typing import Any, Callable, Optional
 
 from ..client import Client
 from ..target.handler import AugmentedReview
+from ..utils import faults
 from . import metrics
 from .config_types import trace_enabled
 from .kube import NotFound
@@ -49,15 +50,64 @@ CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
 IGNORE_LABEL = "admission.gatekeeper.sh/ignore"
 SERVICE_ACCOUNT = "system:serviceaccount:gatekeeper-system:gatekeeper-admin"
 
+# the API server defaults webhook timeoutSeconds to 10 and caps it at 30
+DEFAULT_WEBHOOK_TIMEOUT_S = 10.0
+MAX_WEBHOOK_TIMEOUT_S = 30.0
+
+
+class AdmissionDeadline(TimeoutError):
+    """The request's propagated deadline expired before a verdict."""
+
+
+class AdmissionShed(Exception):
+    """The request was refused at enqueue time (queue full / draining)."""
+
+
+def go_duration_s(text: Optional[str]) -> Optional[float]:
+    """Parse the API server's Go-duration webhook timeout ('5s', '30s',
+    '500ms', '1m10s') or a bare float; None when absent/unparseable."""
+    import re
+
+    if not text:
+        return None
+    m = re.fullmatch(
+        r"(?:(\d+)h)?(?:(\d+)m)?(?:(\d+(?:\.\d+)?)s)?(?:(\d+)ms)?", text)
+    if m and any(m.groups()):
+        h, mins, secs, ms = m.groups()
+        return (int(h or 0) * 3600 + int(mins or 0) * 60
+                + float(secs or 0) + int(ms or 0) / 1000.0)
+    try:
+        return float(text)
+    except ValueError:
+        return None
+
+
+def request_deadline(request: dict, default_s: float =
+                     DEFAULT_WEBHOOK_TIMEOUT_S) -> float:
+    """Absolute monotonic deadline for one AdmissionReview: the request's
+    timeoutSeconds (defaulting like the API server does) minus a safety
+    margin, so the verdict ships BEFORE the API server gives up and
+    applies the deployed failurePolicy to a connection we already paid
+    for."""
+    t = request.get("timeoutSeconds")
+    try:
+        t = float(t) if t is not None else float(default_s)
+    except (TypeError, ValueError):
+        t = float(default_s)
+    t = min(max(t, 0.5), MAX_WEBHOOK_TIMEOUT_S)
+    margin = min(1.0, 0.2 * t)
+    return time.monotonic() + t - margin
+
 
 class _Pending:
-    __slots__ = ("review", "done", "results", "error")
+    __slots__ = ("review", "done", "results", "error", "deadline")
 
-    def __init__(self, review: dict):
+    def __init__(self, review: dict, deadline: float):
         self.review = review
         self.done = threading.Event()
         self.results: list = []
         self.error: Optional[Exception] = None
+        self.deadline = deadline
 
 
 class MicroBatcher:
@@ -73,10 +123,15 @@ class MicroBatcher:
     def __init__(self, opa: Optional[Client], max_wait: float = 0.005,
                  max_batch: int = 256,
                  target: str = "admission.k8s.gatekeeper.sh",
-                 evaluate: Optional[Callable[[list], list]] = None):
+                 evaluate: Optional[Callable[[list], list]] = None,
+                 max_queue: int = 0):
         self.opa = opa
         self.max_wait = max_wait
         self.max_batch = max_batch
+        # load-shed depth: beyond this many queued (unsealed) requests,
+        # submit() refuses immediately with AdmissionShed instead of
+        # queueing into certain deadline expiry. 0 = unbounded.
+        self.max_queue = max_queue
         self.target = target
         self._evaluate = evaluate or self._evaluate_violations
         self._queue: list[_Pending] = []
@@ -87,6 +142,13 @@ class MicroBatcher:
         # ~one flush instead of up to two
         self._sealed: list[list[_Pending]] = []
         self._scv = threading.Condition()
+        # liveness heartbeats, one per loop (a live collector must not
+        # mask a wedged flusher): healthy() flags a dead thread or work
+        # pending with a stale beat so the k8s liveness probe restarts
+        # the pod
+        self.heartbeat = time.monotonic()    # collector
+        self.fheartbeat = time.monotonic()   # flusher
+        self._flushing = False
         self._thread = threading.Thread(target=self._loop, name="batcher",
                                         daemon=True)
         self._thread.start()
@@ -96,17 +158,40 @@ class MicroBatcher:
         self.batches = 0
         self.batched_requests = 0
         self.timeouts = 0
+        self.shed = 0
+        # total admitted-but-unanswered requests (queued + sealed +
+        # flushing): the shed bound applies to THIS, not just the
+        # unsealed queue — the collector seals regardless of flusher
+        # backlog, so bounding the queue alone would let overload pile
+        # up in _sealed instead
+        self._pending = 0
 
-    def submit(self, review: dict, timeout: float = 60.0) -> list:
-        p = _Pending(review)
+    def submit(self, review: dict, timeout: float = 60.0,
+               deadline: Optional[float] = None) -> list:
+        """Enqueue and wait for the batched verdict. `deadline` is an
+        absolute time.monotonic() instant (propagated from the request's
+        timeoutSeconds); without one, `timeout` seconds from now. On
+        expiry raises AdmissionDeadline; a full queue or a draining
+        batcher raises AdmissionShed without queueing."""
+        now = time.monotonic()
+        p = _Pending(review, deadline if deadline is not None
+                     else now + timeout)
         with self._cv:
+            if self._stop.is_set():
+                raise AdmissionShed("admission batcher is shutting down")
+            if self.max_queue and self._pending >= self.max_queue:
+                self.shed += 1
+                metrics.report_admission_shed()
+                raise AdmissionShed(
+                    f"admission queue full ({self.max_queue} pending)")
+            self._pending += 1
             self._queue.append(p)
             if len(self._queue) == 1 or len(self._queue) >= self.max_batch:
                 # wake the collector only on the first enqueue (it sleeps
                 # to the batch deadline anyway) or on a full batch — a
                 # notify per submit makes it spin once per caller thread
                 self._cv.notify()
-        if not p.done.wait(timeout):
+        if not p.done.wait(max(0.0, p.deadline - time.monotonic())):
             # nobody will consume the result: drop the entry so a later
             # flush doesn't evaluate (and set results on) an abandoned
             # request; if it already sealed into a batch the flush's
@@ -114,11 +199,13 @@ class MicroBatcher:
             with self._cv:
                 try:
                     self._queue.remove(p)
+                    self._pending -= 1  # sealed entries decrement at flush
                 except ValueError:
                     pass  # already sealed / mid-flush
             self.timeouts += 1
             metrics.report_batch_timeout()
-            raise TimeoutError("admission batch timed out")
+            raise AdmissionDeadline("admission deadline expired before "
+                                    "the micro-batch verdict")
         if p.error is not None:
             raise p.error
         return p.results
@@ -130,19 +217,71 @@ class MicroBatcher:
         with self._scv:
             self._scv.notify()
 
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Flush everything queued/sealed and wait for the in-flight
+        evaluation (graceful shutdown: pending reviews get real verdicts
+        instead of dropped sockets). True when fully drained.
+
+        Drained == _pending hit zero: that counter only decrements
+        AFTER a verdict is set (or a waiter gave up), so it covers the
+        collector's queue->sealed handoff window that probing the two
+        queues under their separate locks would race."""
+        end = time.monotonic() + timeout
+        with self._cv:
+            self._cv.notify()
+        while time.monotonic() < end:
+            with self._cv:
+                if self._pending == 0:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def healthy(self, max_stall: float = 30.0) -> bool:
+        """Liveness: both pipeline threads alive, and — when a loop has
+        work pending — that loop's heartbeat within `max_stall` (a
+        flusher wedged in a hung evaluation stops beating while its
+        backlog grows, even though the collector keeps running)."""
+        if self._stop.is_set():
+            return True  # stopped on purpose is not a liveness failure
+        if not self._thread.is_alive() or not self._fthread.is_alive():
+            return False
+        now = time.monotonic()
+        with self._cv:
+            queued = bool(self._queue)
+        with self._scv:
+            fbusy = bool(self._sealed) or self._flushing
+        if queued and now - self.heartbeat > max_stall:
+            return False
+        if fbusy and now - self.fheartbeat > max_stall:
+            return False
+        return True
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             with self._cv:
                 while not self._queue and not self._stop.is_set():
+                    self.heartbeat = time.monotonic()
                     self._cv.wait(0.1)
                 if self._stop.is_set():
                     batch = self._queue[:]
                     self._queue.clear()
                 else:
-                    deadline = time.time() + self.max_wait
+                    self.heartbeat = time.monotonic()
+                    # collection window bounded by BOTH the batch wait
+                    # and the tightest member deadline: a batch carrying
+                    # a 1s-timeout review must seal in time to evaluate
+                    # and answer before that review expires
+                    deadline = time.monotonic() + self.max_wait
+                    tight = min(p.deadline for p in self._queue)
+                    deadline = min(deadline, tight - self.max_wait)
                     while (len(self._queue) < self.max_batch
-                           and time.time() < deadline):
-                        self._cv.wait(max(0.0, deadline - time.time()))
+                           and time.monotonic() < deadline):
+                        self._cv.wait(
+                            max(0.0, deadline - time.monotonic()))
+                    # tightest deadlines seal (and therefore flush)
+                    # first; sort is stable, so arrival order holds
+                    # within equal deadlines
+                    self._queue.sort(key=lambda p: p.deadline)
                     batch = self._queue[: self.max_batch]
                     del self._queue[: len(batch)]
             if not batch:
@@ -155,18 +294,29 @@ class MicroBatcher:
         while True:
             with self._scv:
                 while not self._sealed and not self._stop.is_set():
+                    self.fheartbeat = time.monotonic()
                     self._scv.wait(0.1)
                 if not self._sealed:
                     if self._stop.is_set():
                         return
                     continue
                 batch = self._sealed.pop(0)
-            self._flush(batch)
+                self._flushing = True
+            try:
+                self._flush(batch)
+            finally:
+                with self._scv:
+                    self._flushing = False
+            self.fheartbeat = time.monotonic()
 
     def _flush(self, batch: list[_Pending]) -> None:
         self.batches += 1
         self.batched_requests += len(batch)
         try:
+            # inside the try: a raise-mode flush fault must error THIS
+            # batch (and release its _pending slots), not kill the
+            # flusher thread and leak the count toward permanent shed
+            faults.fire("webhook.flush")
             outs = self._evaluate([p.review for p in batch])
             for p, results in zip(batch, outs):
                 if isinstance(results, Exception):
@@ -178,21 +328,41 @@ class MicroBatcher:
             for p in batch:
                 p.error = e
                 p.done.set()
+        finally:
+            with self._cv:
+                self._pending -= len(batch)
 
     def _evaluate_violations(self, reviews: list[dict]) -> list:
         driver = self.opa.driver
         handler = self.opa.targets[self.target]
         if hasattr(driver, "review_batch"):
-            outs = driver.review_batch(self.target, reviews)
+            try:
+                outs = driver.review_batch(self.target, reviews)
+            except Exception as e:
+                # one bad review (or one bad template's eval) must not
+                # take down every co-batched admission: isolate by
+                # re-evaluating per review, failing only the culprits
+                log.warning("batched evaluation failed; isolating per "
+                            "review", details=str(e))
+                outs = self._evaluate_per_review(driver, reviews)
         else:
-            outs = []
-            for review in reviews:
+            outs = self._evaluate_per_review(driver, reviews)
+        for results in outs:
+            if isinstance(results, Exception):
+                continue
+            for r in results:
+                handler.handle_violation(r)
+        return outs
+
+    def _evaluate_per_review(self, driver, reviews: list[dict]) -> list:
+        outs: list = []
+        for review in reviews:
+            try:
                 resp = driver.query(("hooks", self.target, "violation"),
                                     {"review": review})
                 outs.append(resp.results)
-        for results in outs:
-            for r in results:
-                handler.handle_violation(r)
+            except Exception as e:
+                outs.append(e)
         return outs
 
 
@@ -223,7 +393,8 @@ class ValidationHandler:
                  log_denies: bool = False,
                  validate_enforcement: bool = True,
                  traces_provider=None,
-                 fail_closed: bool = False):
+                 fail_closed: bool = False,
+                 default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S):
         self.opa = opa
         self.kube = kube
         self.batcher = batcher or MicroBatcher(opa)
@@ -231,14 +402,27 @@ class ValidationHandler:
         self.validate_enforcement = validate_enforcement
         self.traces_provider = traces_provider or (lambda: [])
         self.fail_closed = fail_closed
+        self.default_timeout = default_timeout
 
     def handle(self, admission_review: dict) -> dict:
         t0 = time.time()
         request = admission_review.get("request") or {}
         uid = request.get("uid") or ""
+        deadline = request_deadline(request, self.default_timeout)
         status = None
         try:
-            response = self._decide(request)
+            response = self._decide(request, deadline)
+        except AdmissionShed as e:
+            status = "shed"
+            response = {"allowed": not self.fail_closed,
+                        "status": {"code": 429, "message": str(e)}}
+        except AdmissionDeadline as e:
+            # answer per the failure stance BEFORE the API server's own
+            # timeout fires — the caller gets our verdict, not a
+            # connection error it has to map through failurePolicy
+            status = "timeout"
+            response = {"allowed": not self.fail_closed,
+                        "status": {"code": 504, "message": str(e)}}
         except Exception as e:
             log.error("admission error", details=str(e))
             status = "error"
@@ -250,7 +434,8 @@ class ValidationHandler:
         response["uid"] = uid
         return _envelope(admission_review, response)
 
-    def _decide(self, request: dict) -> dict:
+    def _decide(self, request: dict,
+                deadline: Optional[float] = None) -> dict:
         username = (request.get("userInfo") or {}).get("username")
         if username == SERVICE_ACCOUNT:
             return {"allowed": True}
@@ -291,7 +476,7 @@ class ValidationHandler:
                 log.info("state dump", dump=self.opa.dump())
             results = resps.results()
         else:
-            results = self.batcher.submit(gk_review)
+            results = self.batcher.submit(gk_review, deadline=deadline)
         denies = []
         for r in results:
             if self.log_denies:
@@ -376,12 +561,16 @@ class MutationHandler:
     def __init__(self, system, kube=None,
                  batcher: Optional[MicroBatcher] = None,
                  fail_closed: bool = False,
-                 batch_max_wait: float = 0.005):
+                 batch_max_wait: float = 0.005,
+                 max_queue: int = 0,
+                 default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S):
         self.system = system
         self.kube = kube
         self.batcher = batcher or MicroBatcher(
-            None, max_wait=batch_max_wait, evaluate=self._evaluate_batch)
+            None, max_wait=batch_max_wait, evaluate=self._evaluate_batch,
+            max_queue=max_queue)
         self.fail_closed = fail_closed
+        self.default_timeout = default_timeout
 
     def _lookup_namespace(self, name: str):
         if self.kube is None:
@@ -398,9 +587,18 @@ class MutationHandler:
         t0 = time.time()
         request = admission_review.get("request") or {}
         uid = request.get("uid") or ""
+        deadline = request_deadline(request, self.default_timeout)
         status = "allow"
         try:
-            response = self._decide(request)
+            response = self._decide(request, deadline)
+        except AdmissionShed as e:
+            status = "shed"
+            response = {"allowed": not self.fail_closed,
+                        "status": {"code": 429, "message": str(e)}}
+        except AdmissionDeadline as e:
+            status = "timeout"
+            response = {"allowed": not self.fail_closed,
+                        "status": {"code": 504, "message": str(e)}}
         except Exception as e:
             log.error("mutation error", details=str(e))
             status = "error"
@@ -410,7 +608,8 @@ class MutationHandler:
         response["uid"] = uid
         return _envelope(admission_review, response)
 
-    def _decide(self, request: dict) -> dict:
+    def _decide(self, request: dict,
+                deadline: Optional[float] = None) -> dict:
         username = (request.get("userInfo") or {}).get("username")
         if username == SERVICE_ACCOUNT:
             return {"allowed": True}
@@ -431,7 +630,7 @@ class MutationHandler:
         # namespaces through _lookup_namespace only for mutators whose
         # match actually needs them (once per projection group, not per
         # request)
-        mutated = self.batcher.submit(dict(request))
+        mutated = self.batcher.submit(dict(request), deadline=deadline)
         if mutated is None:
             return {"allowed": True}
         from ..mutation.patch import json_patch
@@ -471,6 +670,18 @@ class WebhookServer:
             timeout = 60
 
             def do_POST(self):
+                # in-flight accounting for the graceful-shutdown drain:
+                # idle keep-alive connections do NOT count (the thread
+                # parks between requests outside do_POST)
+                with outer._inflight_lock:
+                    outer._inflight += 1
+                try:
+                    self._do_POST()
+                finally:
+                    with outer._inflight_lock:
+                        outer._inflight -= 1
+
+            def _do_POST(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length)
                 try:
@@ -482,6 +693,22 @@ class WebhookServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
+                # admission.k8s.io/v1 carries NO timeoutSeconds in the
+                # request body — a real API server conveys its webhook
+                # timeout only as the ?timeout=5s URL query. Fold it
+                # into the request so deadline propagation sees the
+                # REAL budget (a body field, e.g. from tests or direct
+                # callers, wins)
+                request = (review or {}).get("request") \
+                    if isinstance(review, dict) else None
+                if isinstance(request, dict) and \
+                        "timeoutSeconds" not in request:
+                    query = self.path.partition("?")[2]
+                    params = dict(p.split("=", 1)
+                                  for p in query.split("&") if "=" in p)
+                    t = go_duration_s(params.get("timeout"))
+                    if t is not None and t > 0:
+                        request["timeoutSeconds"] = t
                 # un-served endpoints 404 (an operation not requested
                 # must not answer admission decisions for it)
                 if self.path.startswith("/v1/admitlabel") \
@@ -512,6 +739,8 @@ class WebhookServer:
         self.validation = validation
         self.ns_label = ns_label
         self.mutation = mutation
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
 
         class _Server(http.server.ThreadingHTTPServer):
             def handle_error(self, request, client_address):
@@ -551,9 +780,21 @@ class WebhookServer:
     def start(self) -> None:
         self._thread.start()
 
-    def stop(self) -> None:
-        self.server.shutdown()
-        if self.validation is not None:
-            self.validation.batcher.stop()
-        if self.mutation is not None:
-            self.mutation.batcher.stop()
+    def stop(self, drain_timeout: float = 10.0) -> None:
+        """Graceful shutdown: stop accepting, let in-flight reviews
+        finish (their batcher flushes answer them per the failure
+        stance), then tear the pipeline down — SIGTERM must not drop
+        sockets mid-review."""
+        self.server.shutdown()  # stop the accept loop; handlers continue
+        end = time.monotonic() + drain_timeout
+        while time.monotonic() < end:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.02)
+        for handler in (self.validation, self.mutation):
+            if handler is not None:
+                handler.batcher.drain(
+                    max(0.5, end - time.monotonic()))
+                handler.batcher.stop()
+        self.server.server_close()

@@ -38,6 +38,7 @@ from ..ops.derived import (
 )
 from ..ops.strtab import MatchTables, StringTable
 from ..rego import ast as A
+from ..utils import faults
 from ..target.batch import match_masks
 from .compile import Uncompilable, compile_template
 from .evaljax import CompiledTemplate, EvalError, _param_c
@@ -269,6 +270,27 @@ class TpuDriver(RegoDriver):
         # which path the last audit's compiled kinds took, for
         # observability (bench.py reports it): "mesh(data=N)" | "single"
         self.last_audit_path: Optional[str] = None
+        # degraded-mode quarantine: an eval/compile failure benches the
+        # kind's device program behind its own breaker (exponential
+        # backoff, capped) instead of demoting it forever — affected
+        # reviews serve from the interpreter, and a half-open probe
+        # sweep restores the device path once it succeeds. kind ->
+        # {"until", "fails", "reason", "probe_at"}; guarded by its own
+        # lock (webhook flusher threads and the audit loop race here)
+        self._quarantine: dict[str, dict] = {}
+        self._quarantine_lock = threading.Lock()
+        # failure-history memory: kind -> (fails, cleared_at). A kind
+        # that re-quarantines shortly after clearing resumes its
+        # exponential backoff instead of restarting at base — a
+        # data-dependent failure mixed with successes must converge to
+        # long benchings, not flap at base_s forever
+        self._quarantine_hist: dict[str, tuple] = {}
+        self.quarantine_base_s = float(_os.environ.get(
+            "GATEKEEPER_TPU_QUARANTINE_BASE_S", "30"))
+        self.quarantine_max_s = float(_os.environ.get(
+            "GATEKEEPER_TPU_QUARANTINE_MAX_S", "600"))
+        # optional observer wired by the control plane (template status)
+        self.on_quarantine: Optional[Any] = None
 
     def _build_mesh(self, mesh):
         import os
@@ -372,7 +394,10 @@ class TpuDriver(RegoDriver):
     def compiled_for(self, kind: str) -> Optional[CompiledTemplate]:
         """Lazily wrap the Program in a device evaluator, registering its
         derived columns (host-interpreted unary fns) and interpreted
-        predicate ops with the shared tables."""
+        predicate ops with the shared tables. A quarantined kind answers
+        None (interpreter fallback) until its breaker half-opens."""
+        if self._quarantine and self._quarantined(kind):
+            return None
         if kind in self._compiled:
             return self._compiled[kind]
         prog = self._programs.get(kind)
@@ -421,11 +446,143 @@ class TpuDriver(RegoDriver):
             kind, reason, type(exc).__name__, exc)
         report_device_demotion(kind, reason)
 
+    # -------------------------------------------------- eval quarantine
+
+    def _quarantine_kind(self, kind: str, reason: str,
+                         exc: Exception) -> None:
+        """Bench one kind's device program after an eval failure: the
+        quarantine (NOT a permanent demotion) has exponential backoff
+        with a cap, so one bad template degrades that template's latency
+        — never the process's availability — and the device path heals
+        itself when the failure was transient."""
+        import time as _time
+
+        why = f"{reason}: {type(exc).__name__}: {exc}"
+        with self._quarantine_lock:
+            ent = self._quarantine.get(kind)
+            if ent is None:
+                hist = self._quarantine_hist.pop(kind, None)
+                base_fails = 0
+                if hist is not None and \
+                        _time.monotonic() - hist[1] < self.quarantine_max_s:
+                    base_fails = hist[0]  # resume the backoff ladder
+                ent = {"fails": base_fails}
+            ent["fails"] += 1
+            backoff = min(self.quarantine_base_s
+                          * (2 ** (ent["fails"] - 1)),
+                          self.quarantine_max_s)
+            ent["until"] = _time.monotonic() + backoff
+            ent["reason"] = why
+            ent["probe_at"] = None
+            self._quarantine[kind] = ent
+            fails = ent["fails"]
+        # forget the wrapper (compiled_for re-wraps from the kept
+        # Program after the quarantine lifts) and its warm state
+        self._compiled.pop(kind, None)
+        self._drop_warm(kind)
+        self._demote(kind, reason, exc)
+        from ..control.metrics import report_template_quarantine
+
+        report_template_quarantine(kind, True)
+        log.warning("template %s quarantined %.0fs (failure #%d); its "
+                    "reviews serve from the interpreter until a probe "
+                    "sweep succeeds", kind, backoff, fails)
+        self._notify_quarantine(kind, why)
+
+    # a half-open probe that never resolves (e.g. the cost model routed
+    # it to the host without touching the device) releases its lease
+    # after this long, letting another caller probe
+    QUARANTINE_PROBE_LEASE_S = 30.0
+
+    def _quarantined(self, kind: str) -> bool:
+        """True while the kind's device program is benched. After the
+        backoff expires the state is HALF-OPEN: ONE caller at a time
+        takes the probe lease and attempts the device path — success
+        clears, failure re-quarantines with a doubled backoff — while
+        every other caller stays on the interpreter (a thundering herd
+        of doomed probes must not pay the failure latency N times on
+        the admission path)."""
+        import time as _time
+
+        with self._quarantine_lock:
+            ent = self._quarantine.get(kind)
+            if ent is None:
+                return False
+            now = _time.monotonic()
+            if now < ent["until"]:
+                return True
+            probe_at = ent.get("probe_at")
+            if probe_at is not None and \
+                    now - probe_at < self.QUARANTINE_PROBE_LEASE_S:
+                # a probe is in flight; stay on the interpreter
+                return True
+            ent["probe_at"] = now
+            return False
+
+    def _quarantine_clear(self, kind: str) -> None:
+        """A device eval of this kind succeeded: close the breaker —
+        but ONLY for a sanctioned half-open probe (probe_at set). An
+        eval that was already in flight when another thread quarantined
+        the kind must not wipe the fresh entry milliseconds later."""
+        import time as _time
+
+        with self._quarantine_lock:
+            ent = self._quarantine.get(kind)
+            if ent is None or ent.get("probe_at") is None:
+                return
+            del self._quarantine[kind]
+            self._quarantine_hist[kind] = (ent["fails"],
+                                           _time.monotonic())
+        from ..control.metrics import report_template_quarantine
+
+        report_template_quarantine(kind, False)
+        log.info("template %s recovered: device path restored after "
+                 "quarantine (%d failures)", kind, ent["fails"])
+        self._notify_quarantine(kind, None)
+
+    def _notify_quarantine(self, kind: str, reason) -> None:
+        """Run the control-plane observer OFF the serving thread: the
+        callback writes template status through the kube API, and a
+        quarantine raised mid-flush must never make co-batched
+        admission verdicts wait on (possibly degraded) API I/O."""
+        cb = self.on_quarantine
+        if cb is None:
+            return
+
+        def run():
+            try:
+                cb(kind, reason)
+            except Exception as e:
+                # observability loss, not correctness: say so instead
+                # of silently dropping the status update
+                log.warning("quarantine status notification for %s "
+                            "failed: %s: %s", kind, type(e).__name__, e)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"quarantine-note-{kind}").start()
+
+    def quarantine_status(self) -> dict:
+        """Observability: currently-benched kinds with reason, failure
+        count, and remaining backoff (surfaced in audit logs, metrics,
+        and template byPod status)."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._quarantine_lock:
+            return {k: {"reason": e.get("reason"),
+                        "fails": e.get("fails", 0),
+                        "remaining_s": max(0.0, e.get("until", now) - now)}
+                    for k, e in self._quarantine.items()}
+
     def compiled_kinds(self) -> list[str]:
         return sorted(set(self._programs) | set(self._join_progs))
 
     def join_for(self, kind: str):
-        """Lazily wrap a JoinProgram in its runtime evaluator."""
+        """Lazily wrap a JoinProgram in its runtime evaluator. A
+        quarantined kind answers None (interpreter fallback) until its
+        breaker half-opens — same self-healing as compiled_for."""
+        if self._quarantine and self._quarantined(kind):
+            return None
         if kind in self._join_compiled:
             return self._join_compiled[kind]
         prog = self._join_progs.get(kind)
@@ -768,6 +925,7 @@ class TpuDriver(RegoDriver):
         warms the device program (XLA compile must not stall the
         audit). Returns consume state, or None for the host path."""
         try:
+            faults.fire("eval.device", kind=kind)
             mask = self._match_mask(target, kind, cons, reviews, lookup_ns,
                                     sig_cache)
             cand = np.flatnonzero(mask.any(axis=1))
@@ -808,8 +966,7 @@ class TpuDriver(RegoDriver):
         except DriverError:
             raise
         except Exception as e:
-            self._demote(kind, "audit-eval", e)
-            self._compiled[kind] = None
+            self._quarantine_kind(kind, "audit-eval", e)
             return None
 
     def _audit_consume(self, target, kind, st, cons, reviews, lookup_ns,
@@ -845,10 +1002,11 @@ class TpuDriver(RegoDriver):
         except DriverError:
             raise
         except Exception as e:
-            self._demote(kind, "audit-eval", e)
-            self._compiled[kind] = None
+            self._quarantine_kind(kind, "audit-eval", e)
             return self._audit_interp(target, kind, cons, reviews,
                                       lookup_ns, inventory, None, sig_cache)
+        if self._quarantine:
+            self._quarantine_clear(kind)
         return out
 
     def _audit_join(self, target, kind, jc, cons, reviews, lookup_ns,
@@ -879,11 +1037,15 @@ class TpuDriver(RegoDriver):
             fires = jc.fires(frz, self._inventory_tree(target),
                              self._data_gen, key_cache=key_cache)
         except Exception as e:
-            self._demote(kind, "join-eval", e)
-            self._join_compiled[kind] = None
+            # transient-capable quarantine, not a permanent demotion —
+            # join templates heal the same way compiled ones do
+            self._join_compiled.pop(kind, None)
+            self._quarantine_kind(kind, "join-eval", e)
             return self._audit_interp(target, kind, cons, reviews,
                                       lookup_ns, inventory, trace,
                                       sig_cache)
+        if self._quarantine:
+            self._quarantine_clear(kind)
         hit = np.flatnonzero(fires)
         if hit.size == 0:
             return []
@@ -1079,11 +1241,12 @@ class TpuDriver(RegoDriver):
                                                   cand=cand, target=target)
         except Exception as e:
             # eval-time failures (shapes/ops outside the evaluator's
-            # envelope) demote the template to the interpreter path
-            self._demote(kind, "audit-eval", e)
-            self._compiled[kind] = None
+            # envelope) quarantine the template's device program
+            self._quarantine_kind(kind, "audit-eval", e)
             return self._audit_interp(target, kind, cons, reviews,
                                       lookup_ns, inventory, trace, sig_cache)
+        if self._quarantine:
+            self._quarantine_clear(kind)
         keep = mask[cand[rows], cols]
         out = []
         for ri, ci in zip(rows[keep], cols[keep]):
@@ -1103,6 +1266,7 @@ class TpuDriver(RegoDriver):
                       feat_key=None) -> np.ndarray:
         """fires[len(reviews), len(cons)] via the device program.
         feat_key, when given, caches extraction until inventory changes."""
+        faults.fire("eval.device", kind=kind)
         feats, enc, table, derived = self._prepare_eval(ct, kind, reviews,
                                                         cons, feat_key)
         # chunked: keeps [N, axes..., C] intermediates bounded on large
@@ -1413,6 +1577,7 @@ class TpuDriver(RegoDriver):
         rule)."""
         import time as _time
 
+        faults.fire("eval.device", kind=kind)
         use_mesh = self._mesh_shardable(len(cand_reviews))
         feats, enc, table, derived = self._prepare_eval(
             ct, kind, cand_reviews, cons, feat_key=None, mesh=use_mesh)
@@ -1522,9 +1687,11 @@ class TpuDriver(RegoDriver):
                              for k in np.flatnonzero(fires)
                              for c in range(len(cons))
                              if mask[int(jcand[k]), c]]
+                    if self._quarantine:
+                        self._quarantine_clear(kind)
                 except Exception as e:
-                    self._demote(kind, "join-eval", e)
-                    self._join_compiled[kind] = None
+                    self._join_compiled.pop(kind, None)
+                    self._quarantine_kind(kind, "join-eval", e)
                     pairs = None
             if ct is not None and n_masked and \
                     len(reviews) >= self.MIN_DEVICE_BATCH and \
@@ -1549,11 +1716,12 @@ class TpuDriver(RegoDriver):
                         hits = np.logical_and(fires, mask[cand])
                         pairs = [(int(cand[ri]), int(ci))
                                  for ri, ci in zip(*np.nonzero(hits))]
+                    if self._quarantine:
+                        self._quarantine_clear(kind)
                 except _ServeHostThisRound:
                     pass  # host path below; the warm continues
                 except Exception as e:
-                    self._demote(kind, "review-eval", e)
-                    self._compiled[kind] = None
+                    self._quarantine_kind(kind, "review-eval", e)
             if pairs is None:
                 pairs = [(r, c) for r in range(len(reviews))
                          for c in range(len(cons)) if mask[r, c]]

@@ -316,6 +316,54 @@ violation[{"msg": "no owner"}] {
     assert batcher.batches < 8 * 40  # batching actually happened
 
 
+def test_microbatcher_deadline_skew_orders_batches():
+    """Satellite: mixed 1s/5s/30s timeoutSeconds in one burst — tight-
+    deadline requests seal into earlier batches (answered first) and
+    NO request is answered after its propagated deadline."""
+    import threading as th
+
+    def evaluate(reviews):
+        time.sleep(0.1)  # each flush costs a fixed slice of the budget
+        return [[] for _ in reviews]
+
+    batcher = MicroBatcher(None, max_wait=0.05, max_batch=4,
+                           evaluate=evaluate)
+    finished: dict[int, tuple] = {}
+    barrier = th.Barrier(13)
+
+    def submit(i, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        barrier.wait()
+        try:
+            batcher.submit({"i": i}, deadline=deadline)
+            finished[i] = (time.monotonic(), deadline, True)
+        except Exception:
+            finished[i] = (time.monotonic(), deadline, False)
+
+    # 4 of each class, all submitted in one burst
+    budgets = [1.0] * 4 + [5.0] * 4 + [30.0] * 4
+    threads = [th.Thread(target=submit, args=(i, t))
+               for i, t in enumerate(budgets)]
+    try:
+        for t in threads:
+            t.start()
+        barrier.wait()
+        for t in threads:
+            t.join(20)
+    finally:
+        batcher.stop()
+    assert len(finished) == 12
+    # every request answered, and never after its deadline
+    for i, (at, deadline, ok) in finished.items():
+        assert ok, f"request {i} failed"
+        assert at <= deadline + 0.05, f"request {i} answered after expiry"
+    # deadline-ordered sealing: every 1s request finished before every
+    # 30s request (the flusher worked the tight batch first)
+    tight_done = max(finished[i][0] for i in range(4))
+    loose_done = min(finished[i][0] for i in range(8, 12))
+    assert tight_done <= loose_done, "tight deadlines were not served first"
+
+
 # ----------------------------------------------- watch manager races
 
 

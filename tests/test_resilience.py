@@ -1,0 +1,855 @@
+"""Fault-injection chaos suite (resilience tentpole).
+
+Storms injected through gatekeeper_tpu.utils.faults drive the resilience
+layer end to end: deadline propagation answers every AdmissionReview
+before its propagated deadline, the bounded queue sheds instead of
+queueing into certain timeout, the shared kube-write breaker opens /
+half-opens / closes observably (and audit defers status writes while it
+is open), device-eval failures quarantine one template behind its own
+breaker while the interpreter keeps serving, watch drops degrade to
+polling, SIGTERM-style shutdown drains in-flight reviews, and the
+liveness watchdog flags a wedged pipeline.
+
+Every test runs under a HARD SIGALRM timeout: an injected hang must fail
+that test fast instead of eating the CI job budget.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import signal
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu.client import Backend, RegoDriver
+from gatekeeper_tpu.control.audit import AuditManager
+from gatekeeper_tpu.control.health import HealthServer
+from gatekeeper_tpu.control.kube import FakeKube, KubeError
+from gatekeeper_tpu.control.resilience import (
+    BreakerOpen,
+    CircuitBreaker,
+    GuardedKube,
+    RetryBudget,
+)
+from gatekeeper_tpu.control.webhook import (
+    AdmissionDeadline,
+    AdmissionShed,
+    MicroBatcher,
+    ValidationHandler,
+    WebhookServer,
+    request_deadline,
+)
+from gatekeeper_tpu.target import K8sValidationTarget
+from gatekeeper_tpu.utils.faults import FAULTS, FaultError
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+PER_TEST_TIMEOUT_S = 120
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout_and_clean_faults():
+    """Hard per-test timeout + fault isolation: no armed fault (or hang)
+    leaks into the next test."""
+
+    def boom(signum, frame):  # pragma: no cover - only on a real hang
+        raise TimeoutError(
+            f"test exceeded the {PER_TEST_TIMEOUT_S}s hard timeout")
+
+    old = signal.signal(signal.SIGALRM, boom)
+    signal.alarm(PER_TEST_TIMEOUT_S)
+    FAULTS.reset()
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+        FAULTS.reset()
+
+
+def _policy_client(driver=None):
+    driver = driver if driver is not None else RegoDriver()
+    client = Backend(driver).new_client([K8sValidationTarget()])
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sneedowner"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sNeedOwner"}}},
+            "targets": [{"target": TARGET, "rego": """
+package k8sneedowner
+violation[{"msg": "no owner label"}] {
+  not input.review.object.metadata.labels.owner
+}
+"""}]},
+    })
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sNeedOwner", "metadata": {"name": "need-owner"},
+        "spec": {}})
+    return driver, client
+
+
+def _review(name, labels=None, timeout_s=None, ns="d"):
+    obj = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": name, "namespace": ns}}
+    if labels:
+        obj["metadata"]["labels"] = labels
+    request = {"uid": f"uid-{name}", "operation": "CREATE",
+               "kind": {"group": "", "version": "v1", "kind": "Pod"},
+               "name": name, "namespace": ns,
+               "userInfo": {"username": "chaos"}, "object": obj}
+    if timeout_s is not None:
+        request["timeoutSeconds"] = timeout_s
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": request}
+
+
+# ------------------------------------------------- deadline propagation
+
+
+def test_request_deadline_parsing():
+    now = time.monotonic()
+    # default 10s minus the 1s margin
+    d = request_deadline({})
+    assert 8.5 <= d - now <= 9.5
+    # explicit 5s minus 20% margin
+    d = request_deadline({"timeoutSeconds": 5})
+    assert 3.5 <= d - now <= 4.5
+    # clamped into [0.5, 30]; junk falls back to the default
+    assert request_deadline({"timeoutSeconds": 9999}) - now <= 30
+    assert request_deadline({"timeoutSeconds": "bogus"}) - now <= 10
+
+
+def test_deadline_expiry_answers_failure_stance_before_api_server():
+    """A hung flusher must not make the API server time us out: the
+    verdict (per the fail-open/fail-closed stance, status=timeout)
+    ships before request.timeoutSeconds elapses."""
+    _, client = _policy_client()
+    release = threading.Event()
+
+    def hang(reviews):
+        release.wait(20)
+        return [[] for _ in reviews]
+
+    for fail_closed, want_allowed in ((False, True), (True, False)):
+        batcher = MicroBatcher(client, evaluate=hang)
+        handler = ValidationHandler(client, batcher=batcher,
+                                    fail_closed=fail_closed)
+        t0 = time.monotonic()
+        out = handler.handle(_review("p1", timeout_s=1))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0, "answered after the API server gave up"
+        assert out["response"]["allowed"] is want_allowed
+        assert out["response"]["status"]["code"] == 504
+        assert out["response"]["uid"] == "uid-p1"
+        release.set()
+        batcher.stop()
+        release.clear()
+
+
+def test_url_timeout_query_param_propagates_deadline():
+    """admission.k8s.io/v1 carries NO timeoutSeconds in the body — the
+    API server conveys its budget as ?timeout=5s on the webhook URL.
+    The HTTP layer must fold it into the request so a hung evaluation
+    is answered within the REAL budget, not the configured default."""
+    from gatekeeper_tpu.control.webhook import go_duration_s
+
+    assert go_duration_s("5s") == 5.0
+    assert go_duration_s("500ms") == 0.5
+    assert go_duration_s("1m10s") == 70.0
+    assert go_duration_s("junk") is None and go_duration_s(None) is None
+
+    _, client = _policy_client()
+    release = threading.Event()
+
+    def hang(reviews):
+        release.wait(20)
+        return [[] for _ in reviews]
+
+    batcher = MicroBatcher(client, evaluate=hang)
+    handler = ValidationHandler(client, batcher=batcher)
+    server = WebhookServer(handler, None, port=0)
+    server.start()
+    try:
+        review = _review("qp")          # NO timeoutSeconds in the body
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        t0 = time.monotonic()
+        conn.request("POST", "/v1/admit?timeout=1s", json.dumps(review),
+                     {"Content-Type": "application/json"})
+        out = json.loads(conn.getresponse().read())
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0, "ignored the API server's ?timeout budget"
+        assert out["response"]["status"]["code"] == 504
+    finally:
+        release.set()
+        server.stop(drain_timeout=1.0)
+
+
+def test_retry_call_releases_probe_slot_on_unexpected_error():
+    """A non-KubeError escaping fn() (LB returning HTML, json garbage)
+    must release a claimed half-open probe slot — a wedged breaker
+    would block every future write until restart."""
+    from gatekeeper_tpu.control.resilience import retry_call
+
+    br = CircuitBreaker("g5", failure_threshold=1, reset_timeout=0.1)
+    br.record_failure()  # open
+    time.sleep(0.15)     # half-open
+
+    def garbage():
+        raise ValueError("not json")
+
+    with pytest.raises(ValueError):
+        retry_call(garbage, breaker=br)
+    assert br.state == CircuitBreaker.OPEN  # probe failed, re-opened
+    time.sleep(0.15)
+    assert retry_call(lambda: "ok", breaker=br) == "ok"  # not wedged
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_batch_seals_for_tightest_member_deadline():
+    """A request with a deadline tighter than the collection window
+    must not wait out the full window."""
+    done = []
+
+    def evaluate(reviews):
+        done.append(time.monotonic())
+        return [[] for _ in reviews]
+
+    b = MicroBatcher(None, max_wait=5.0, evaluate=evaluate)
+    try:
+        t0 = time.monotonic()
+        b.submit({"r": 1}, deadline=time.monotonic() + 0.5)
+        assert time.monotonic() - t0 < 0.5
+    finally:
+        b.stop()
+
+
+# ----------------------------------------------------- load shedding
+
+
+def test_bounded_queue_sheds_beyond_depth():
+    """Beyond --admission-max-queue in-flight requests, submits shed
+    immediately (status=shed through the handler) instead of queueing
+    into certain timeout — and every shed request IS answered."""
+    release = threading.Event()
+
+    def hang(reviews):
+        release.wait(20)
+        return [[] for _ in reviews]
+
+    b = MicroBatcher(None, max_wait=0.001, max_batch=2, evaluate=hang,
+                     max_queue=4)
+    outcomes: list = []
+
+    def submit(i):
+        try:
+            b.submit({"i": i}, timeout=5.0)
+            outcomes.append("ok")
+        except AdmissionShed:
+            outcomes.append("shed")
+        except AdmissionDeadline:
+            outcomes.append("deadline")
+
+    threads = [threading.Thread(target=submit, args=(i,))
+               for i in range(12)]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.time() + 5
+        while len(outcomes) < 8 and time.time() < deadline:
+            time.sleep(0.01)  # the 8 beyond-depth submits shed instantly
+        assert outcomes.count("shed") == 8, outcomes
+        assert b.shed == 8
+        release.set()
+        for t in threads:
+            t.join(10)
+        # zero unanswered: every submit resolved one way or another
+        assert len(outcomes) == 12
+        assert outcomes.count("ok") == 4
+    finally:
+        release.set()
+        b.stop()
+
+
+def test_shed_reported_as_failure_stance_verdict():
+    _, client = _policy_client()
+    release = threading.Event()
+
+    def hang(reviews):
+        release.wait(20)
+        return [[] for _ in reviews]
+
+    batcher = MicroBatcher(client, max_wait=0.001, max_batch=1,
+                           evaluate=hang, max_queue=1)
+    handler = ValidationHandler(client, batcher=batcher)
+    try:
+        filler = threading.Thread(
+            target=lambda: handler.handle(_review("fill", timeout_s=5)),
+            daemon=True)
+        filler.start()
+        deadline = time.time() + 5
+        while batcher._pending < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        out = handler.handle(_review("shed-me", timeout_s=5))
+        assert out["response"]["allowed"] is True  # fail-open stance
+        assert out["response"]["status"]["code"] == 429
+        release.set()
+        filler.join(10)
+    finally:
+        release.set()
+        batcher.stop()
+
+
+def test_raise_mode_flush_fault_errors_batch_not_flusher():
+    """A raise-mode flush fault must fail THAT batch (entries get the
+    error, _pending slots release) — not kill the flusher thread and
+    leak the shed accounting toward permanent 100% shedding."""
+    b = MicroBatcher(None, max_wait=0.001,
+                     evaluate=lambda rs: [[] for _ in rs], max_queue=4)
+    try:
+        FAULTS.inject("webhook.flush", mode="raise", count=1)
+        with pytest.raises(FaultError):
+            b.submit({"x": 1}, timeout=5.0)
+        assert b.healthy()  # flusher survived the injected raise
+        with b._cv:
+            assert b._pending == 0  # no leaked slots
+        assert b.submit({"x": 2}, timeout=5.0) == []  # still serving
+    finally:
+        b.stop()
+
+
+# ------------------------------------------------- kube write breaker
+
+
+def test_circuit_breaker_transitions():
+    br = CircuitBreaker("t", failure_threshold=3, reset_timeout=0.2)
+    assert br.state == CircuitBreaker.CLOSED
+    for _ in range(3):
+        assert br.allow()
+        br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    time.sleep(0.25)
+    assert br.state == CircuitBreaker.HALF_OPEN
+    # exactly one probe slot
+    assert br.allow()
+    assert not br.allow()
+    br.record_failure()  # probe failed: re-open
+    assert br.state == CircuitBreaker.OPEN
+    time.sleep(0.25)
+    assert br.allow()
+    br.record_success()  # probe succeeded: close
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_guarded_kube_retries_transient_then_succeeds():
+    kube = FakeKube()
+    kube.register_kind(("", "v1", "Namespace"), namespaced=False)
+    guard = GuardedKube(kube, CircuitBreaker("g1", failure_threshold=10),
+                        RetryBudget(10))
+    FAULTS.inject("kube.write", mode="error", param="503", count=2)
+    out = guard.create({"apiVersion": "v1", "kind": "Namespace",
+                        "metadata": {"name": "ns1"}})
+    assert out["metadata"]["name"] == "ns1"
+    assert FAULTS.fired("kube.write") == 2  # two injected 503s retried
+
+
+def test_guarded_kube_breaker_opens_and_fails_fast_under_storm():
+    kube = FakeKube()
+    kube.register_kind(("", "v1", "Namespace"), namespaced=False)
+    br = CircuitBreaker("g2", failure_threshold=4, reset_timeout=0.3)
+    guard = GuardedKube(kube, br, RetryBudget(3, refill_per_s=0.0),
+                        attempts=3)
+    FAULTS.inject("kube.write", mode="error", param="503")
+
+    def ns(i):
+        return {"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": f"s{i}"}}
+
+    with pytest.raises(KubeError):
+        guard.create(ns(0))
+    # storm continues until the breaker opens, then writes are refused
+    # locally without touching the API
+    for i in range(1, 6):
+        with pytest.raises(KubeError):
+            guard.create(ns(i))
+    assert br.state == CircuitBreaker.OPEN
+    calls_before = len(kube.calls)
+    with pytest.raises(BreakerOpen):
+        guard.create(ns(99))
+    assert len(kube.calls) == calls_before  # fast fail: no API call
+    # storm ends; breaker half-opens and the probe write closes it
+    FAULTS.clear("kube.write")
+    time.sleep(0.35)
+    out = guard.create(ns(7))
+    assert out["metadata"]["name"] == "s7"
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_audit_defers_status_writes_while_breaker_open():
+    """Under a kube 5xx storm the audit keeps sweeping but defers
+    constraint-status PATCHes (no hot-loop); the pending delta is
+    written on the first healthy sweep."""
+    kube = FakeKube()
+    kube.register_kind(("", "v1", "Namespace"), namespaced=False)
+    kube.register_kind(("constraints.gatekeeper.sh", "v1beta1",
+                        "K8sNeedOwner"), namespaced=False)
+    _, client = _policy_client()
+    kube.create({"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                 "kind": "K8sNeedOwner",
+                 "metadata": {"name": "need-owner"}, "spec": {}})
+    client.add_data({"apiVersion": "v1", "kind": "Namespace",
+                     "metadata": {"name": "bad-ns"}})
+    # threshold 1: the breaker counts failed WRITES (one per sweep
+    # here), so the first storm-failed status write opens it
+    br = CircuitBreaker("audit-w", failure_threshold=1, reset_timeout=0.3)
+    guard = GuardedKube(kube, br, RetryBudget(2, refill_per_s=0.0),
+                        attempts=2)
+    mgr = AuditManager(guard, client, audit_from_cache=True,
+                       write_breaker=br)
+    FAULTS.inject("kube.write", mode="error", param="503")
+    results = mgr.audit_once()  # storm: writes fail, breaker opens
+    assert len(results) == 1  # the sweep itself still found violations
+    assert br.state == CircuitBreaker.OPEN
+    updates_while_open = len([c for c in kube.calls if c[0] == "update"])
+    results = mgr.audit_once()  # breaker open: writes fully deferred
+    assert mgr.last_sweep_stats is not None
+    assert len([c for c in kube.calls if c[0] == "update"]) == \
+        updates_while_open, "status writes not deferred while open"
+    # storm ends: the next sweep (post reset) writes the pending status
+    FAULTS.clear("kube.write")
+    time.sleep(0.35)
+    mgr.audit_once()
+    status = kube.get(("constraints.gatekeeper.sh", "v1beta1",
+                       "K8sNeedOwner"), "need-owner").get("status") or {}
+    assert status.get("totalViolations") == 1
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_client_errors_do_not_trip_breaker():
+    """A deterministic 4xx (RBAC 403, schema 422) means the server
+    ANSWERED: no retry, and the shared breaker must not open — a config
+    mistake must not escalate into a serving outage."""
+    kube = FakeKube()
+    kube.register_kind(("", "v1", "Namespace"), namespaced=False)
+    br = CircuitBreaker("g4", failure_threshold=2, reset_timeout=30)
+    guard = GuardedKube(kube, br, RetryBudget(10))
+    FAULTS.inject("kube.write", mode="error", param="403")
+    for i in range(6):
+        with pytest.raises(KubeError) as ei:
+            guard.create({"apiVersion": "v1", "kind": "Namespace",
+                          "metadata": {"name": f"x{i}"}})
+        assert not isinstance(ei.value, BreakerOpen)
+    assert br.state == CircuitBreaker.CLOSED
+    assert FAULTS.fired("kube.write") == 6  # exactly one attempt each
+
+
+# ------------------------------------------- device-eval quarantine
+
+
+def test_eval_failure_quarantines_template_and_interp_serves():
+    from gatekeeper_tpu.ir import TpuDriver
+
+    driver, client = _policy_client(TpuDriver())
+    for i in range(6):
+        client.add_data({"apiVersion": "v1", "kind": "Namespace",
+                         "metadata": {"name": f"n{i}"}})
+    driver.quarantine_base_s = 0.3
+    FAULTS.inject("eval.device", mode="raise",
+                  match={"kind": "K8sNeedOwner"})
+    results = client.audit().results()
+    # availability held: the interpreter served every violation
+    assert len(results) == 6
+    q = driver.quarantine_status()
+    assert "K8sNeedOwner" in q and q["K8sNeedOwner"]["fails"] == 1
+    from gatekeeper_tpu.control.metrics import REGISTRY
+    assert 'gatekeeper_tpu_template_quarantined{kind="K8sNeedOwner"} 1' \
+        in REGISTRY.render()
+    # while quarantined, the device path is not even attempted
+    fired = FAULTS.fired("eval.device")
+    assert len(client.audit().results()) == 6
+    assert FAULTS.fired("eval.device") == fired
+    # storm ends; after the backoff the half-open probe restores the
+    # device path and clears the quarantine
+    FAULTS.clear("eval.device")
+    time.sleep(0.35)
+    driver._dev_batch_lat_s = 1e-4   # cost model: prefer the device
+    driver._host_pair_rate = 1.0
+    assert len(client.audit().results()) == 6
+    assert driver.quarantine_status() == {}
+    assert 'gatekeeper_tpu_template_quarantined{kind="K8sNeedOwner"} 0' \
+        in REGISTRY.render()
+
+
+def test_quarantine_half_open_allows_single_probe():
+    """After the backoff expires, exactly ONE caller takes the probe
+    lease; concurrent callers stay on the interpreter instead of a
+    thundering herd of doomed device evals."""
+    from gatekeeper_tpu.ir import TpuDriver
+
+    driver, _client = _policy_client(TpuDriver())
+    driver.quarantine_base_s = 0.01
+    driver._quarantine_kind("K8sNeedOwner", "review-eval",
+                            RuntimeError("injected"))
+    time.sleep(0.05)  # backoff expired: half-open
+    assert driver._quarantined("K8sNeedOwner") is False  # takes the lease
+    assert driver._quarantined("K8sNeedOwner") is True   # probe in flight
+    assert driver.compiled_for("K8sNeedOwner") is None
+    # probe failure re-quarantines (doubled backoff) and resets the lease
+    driver._quarantine_kind("K8sNeedOwner", "review-eval",
+                            RuntimeError("probe failed"))
+    assert driver._quarantined("K8sNeedOwner") is True
+    assert driver.quarantine_status()["K8sNeedOwner"]["fails"] == 2
+
+
+def test_one_bad_template_does_not_take_down_cobatched_reviews():
+    from gatekeeper_tpu.ir import TpuDriver
+
+    driver, client = _policy_client(TpuDriver())
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sneedteam"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sNeedTeam"}}},
+            "targets": [{"target": TARGET, "rego": """
+package k8sneedteam
+violation[{"msg": "no team label"}] {
+  not input.review.object.metadata.labels.team
+}
+"""}]},
+    })
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sNeedTeam", "metadata": {"name": "need-team"},
+        "spec": {}})
+    driver.quarantine_base_s = 30.0
+    driver._dev_batch_lat_s = 1e-4
+    driver._host_pair_rate = 1.0
+    FAULTS.inject("eval.device", match={"kind": "K8sNeedOwner"})
+    reviews = [_review(f"p{i}")["request"] for i in range(8)]
+    outs = driver.review_batch(TARGET, reviews)
+    # every co-batched review got BOTH verdicts: the faulted kind from
+    # the interpreter fallback, the healthy kind wherever it ran
+    assert len(outs) == 8
+    for per_review in outs:
+        kinds = sorted((r.constraint or {}).get("kind")
+                       for r in per_review)
+        assert kinds == ["K8sNeedOwner", "K8sNeedTeam"]
+    assert "K8sNeedOwner" in driver.quarantine_status()
+    assert "K8sNeedTeam" not in driver.quarantine_status()
+
+
+# ----------------------------------------------------- watch drops
+
+
+def test_watch_drop_storm_degrades_to_polling_then_heals():
+    from gatekeeper_tpu.control.audit import InventoryTracker
+
+    kube = FakeKube()
+    kube.register_kind(("", "v1", "Pod"))
+    guard = GuardedKube(kube)
+    _, client = _policy_client()
+    tracker = InventoryTracker(guard, client)
+    FAULTS.inject("kube.watch", mode="error")
+    tracker.set_gvks([("", "v1", "Pod")])
+    assert tracker._poll == {("", "v1", "Pod")}  # degraded to polling
+    kube.create({"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "p1", "namespace": "d"}})
+    stats = tracker.apply_pending()  # re-list diff still syncs state
+    assert stats["total"] == 1
+    # the storm ends: the next sweep quietly re-subscribes the stream
+    FAULTS.clear("kube.watch")
+    tracker.apply_pending()
+    assert tracker._poll == set()
+    kube.create({"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "p2", "namespace": "d"}})
+    assert tracker.apply_pending()["total"] == 2
+    tracker.stop()
+
+
+# ------------------------------------------------- graceful shutdown
+
+
+def test_graceful_shutdown_drains_inflight_reviews():
+    """stop() must answer in-flight reviews (drain) instead of dropping
+    sockets mid-review."""
+    _, client = _policy_client()
+
+    def slowish(reviews):
+        time.sleep(0.3)
+        from gatekeeper_tpu.control.webhook import MicroBatcher as MB
+        return MB._evaluate_violations(batcher, reviews)
+
+    batcher = MicroBatcher(client, evaluate=slowish)
+    handler = ValidationHandler(client, batcher=batcher)
+    server = WebhookServer(handler, None, port=0)
+    server.start()
+    results: list = []
+
+    def post(i):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=10)
+            conn.request("POST", "/v1/admit",
+                         json.dumps(_review(f"g{i}", timeout_s=10)),
+                         {"Content-Type": "application/json"})
+            results.append(json.loads(conn.getresponse().read()))
+        except Exception as e:  # pragma: no cover - the failure mode
+            results.append(e)
+
+    threads = [threading.Thread(target=post, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with server._inflight_lock:
+            if server._inflight >= 4:
+                break
+        time.sleep(0.005)
+    server.stop(drain_timeout=10.0)
+    for t in threads:
+        t.join(10)
+    assert len(results) == 4
+    for r in results:
+        assert isinstance(r, dict) and "response" in r, r
+        # a real verdict (deny: pods lack the owner label), not an
+        # error-stance answer synthesized from a dropped evaluation
+        assert r["response"]["allowed"] is False
+
+
+# ------------------------------------------------- liveness watchdog
+
+
+def test_liveness_watchdog_flags_wedged_flusher():
+    release = threading.Event()
+
+    def hang(reviews):
+        release.wait(30)
+        return [[] for _ in reviews]
+
+    b = MicroBatcher(None, max_wait=0.001, evaluate=hang)
+    try:
+        assert b.healthy()
+        t = threading.Thread(
+            target=lambda: _swallow(lambda: b.submit({"x": 1},
+                                                     timeout=0.4)),
+            daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with b._scv:
+                if b._flushing:
+                    break
+            time.sleep(0.005)
+        time.sleep(0.3)
+        assert not b.healthy(max_stall=0.2)  # wedged: stale heartbeat
+        srv = HealthServer("127.0.0.1", 0)
+        srv.add_liveness("batcher", lambda: b.healthy(max_stall=0.2))
+        srv.start()
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=5)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 503 and b"batcher" in body
+        srv.shutdown()
+    finally:
+        release.set()
+        b.stop()
+
+
+def test_liveness_watchdog_flags_dead_audit_loop():
+    kube = FakeKube()
+    _, client = _policy_client()
+    mgr = AuditManager(kube, client, interval=0.1, audit_from_cache=True)
+    assert mgr.healthy()  # not started: vacuously alive
+    mgr.start()
+    time.sleep(0.05)
+    assert mgr.healthy()
+    mgr.stop()
+    deadline = time.time() + 5
+    while mgr._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.02)
+    assert mgr.healthy()  # stopped on purpose: not a liveness failure
+    mgr._stop.clear()     # simulate a CRASHED (not stopped) loop
+    assert not mgr.healthy()
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+# --------------------------------------------------- end-to-end chaos
+
+
+def test_chaos_storm_every_admission_answered():
+    """The acceptance storm: kube 5xx on every write, slowed flushes,
+    and a per-template device-eval fault — every submitted
+    AdmissionReview receives a verdict before its propagated deadline,
+    the process survives, and breaker/quarantine state is observable."""
+    from gatekeeper_tpu.control.main import Runtime, build_parser
+    from gatekeeper_tpu.control.metrics import REGISTRY
+
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--disable-cert-rotation", "--audit-interval", "0.2",
+        "--health-addr", "127.0.0.1:0",
+        "--kube-breaker-threshold", "3", "--kube-breaker-reset", "0.5",
+    ])
+    rt = Runtime(args)
+    rt.args.metrics_backend = "none"
+    rt.start()
+    try:
+        rt.kube.create({
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "k8sneedowner"},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": "K8sNeedOwner"}}},
+                "targets": [{"target": TARGET, "rego": """
+package k8sneedowner
+violation[{"msg": "no owner label"}] {
+  not input.review.object.metadata.labels.owner
+}
+"""}]},
+        })
+        rt.manager.drain()
+        rt.kube.create({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K8sNeedOwner",
+            "metadata": {"name": "need-owner"}, "spec": {}})
+        rt.manager.drain()
+        # the storm: every kube write 503s, device eval raises
+        FAULTS.inject("kube.write", mode="error", param="503")
+        FAULTS.inject("eval.device", mode="raise")
+        answers: list = []
+
+        def post(i):
+            labels = {"owner": "me"} if i % 2 else None
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", rt.webhook.port, timeout=10)
+                t0 = time.monotonic()
+                conn.request(
+                    "POST", "/v1/admit",
+                    json.dumps(_review(f"c{i}", labels, timeout_s=5)),
+                    {"Content-Type": "application/json"})
+                out = json.loads(conn.getresponse().read())
+                answers.append((i, time.monotonic() - t0, out))
+            except Exception as e:  # pragma: no cover - failure mode
+                answers.append((i, -1.0, e))
+
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(30)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert len(answers) == 30, "unanswered admissions"
+        for i, elapsed, out in answers:
+            assert isinstance(out, dict) and "response" in out, (i, out)
+            assert 0 <= elapsed < 5.0, (i, elapsed)
+            # policy verdicts held through the storm (interpreter path)
+            assert out["response"]["allowed"] is bool(i % 2), (i, out)
+        # two audit sweeps under the storm: loop alive, writes deferred
+        time.sleep(0.5)
+        assert rt.audit.healthy()
+        rendered = REGISTRY.render()
+        assert "gatekeeper_tpu_circuit_breaker_state" in rendered
+        # a WEBHOOK pod must stay ready through a write brownout:
+        # serving is read-only, and pulling every replica's endpoint at
+        # once would turn the partial degradation into a full admission
+        # outage (audit-only pods DO report the breaker — see
+        # test_audit_only_pod_readiness_reports_open_breaker)
+        conn = http.client.HTTPConnection("127.0.0.1", rt.health.port,
+                                          timeout=5)
+        conn.request("GET", "/readyz")
+        assert conn.getresponse().status == 200
+        # storm ends: the breaker closes on the next successful write
+        FAULTS.reset()
+        time.sleep(0.6)
+        deadline = time.time() + 10
+        while rt.write_breaker.is_open and time.time() < deadline:
+            time.sleep(0.1)
+        assert not rt.write_breaker.is_open
+    finally:
+        FAULTS.reset()
+        rt.stop()
+
+
+def test_audit_only_pod_readiness_reports_open_breaker():
+    """An audit-only pod (no admission serving to protect) surfaces the
+    open kube-write breaker through /readyz."""
+    from gatekeeper_tpu.control.main import Runtime, build_parser
+
+    args = build_parser().parse_args([
+        "--fake-kube", "--operation", "audit", "--prometheus-port", "0",
+        "--disable-cert-rotation", "--audit-interval", "0.1",
+        "--health-addr", "127.0.0.1:0",
+        "--kube-breaker-threshold", "2", "--kube-breaker-reset", "30",
+    ])
+    rt = Runtime(args)
+    rt.args.metrics_backend = "none"
+    rt.start()
+    try:
+        rt.kube.create({
+            "apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "k8sneedowner"},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": "K8sNeedOwner"}}},
+                "targets": [{"target": TARGET, "rego": """
+package k8sneedowner
+violation[{"msg": "no owner label"}] {
+  not input.review.object.metadata.labels.owner
+}
+"""}]},
+        })
+        rt.manager.drain()
+        rt.kube.create({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K8sNeedOwner",
+            "metadata": {"name": "need-owner"}, "spec": {}})
+        rt.manager.drain()
+        FAULTS.inject("kube.write", mode="error", param="503")
+        deadline = time.time() + 15
+        while not rt.write_breaker.is_open and time.time() < deadline:
+            time.sleep(0.05)  # audit sweeps' status writes open it
+        assert rt.write_breaker.is_open
+        conn = http.client.HTTPConnection("127.0.0.1", rt.health.port,
+                                          timeout=5)
+        conn.request("GET", "/readyz")
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 503 and b"kube-writes" in body
+    finally:
+        FAULTS.reset()
+        rt.stop()
+
+
+# ----------------------------------------------------- fault plumbing
+
+
+def test_fault_spec_parsing_and_counters():
+    FAULTS.configure("kube.write:error:503@1.0#2,webhook.flush:sleep:0.01")
+    assert FAULTS.armed() == ["kube.write", "webhook.flush"]
+    with pytest.raises(FaultError) as ei:
+        FAULTS.fire("kube.write")
+    assert ei.value.code() == 503
+    FAULTS.fire("unarmed.point")  # no-op
+    with pytest.raises(FaultError):
+        FAULTS.fire("kube.write")
+    FAULTS.fire("kube.write")  # count exhausted: disarmed
+    assert FAULTS.fired("kube.write") == 2
+    t0 = time.monotonic()
+    FAULTS.fire("webhook.flush")
+    assert time.monotonic() - t0 >= 0.01
