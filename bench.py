@@ -203,7 +203,7 @@ def main() -> None:
         proc = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(
                 os.path.abspath(__file__)), "bench_configs.py"),
-             "1", "2", "3", "5", "6", "7"],
+             "1", "2", "3", "5", "6", "7", "9"],
             capture_output=True, text=True, env=env,
             timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 2700)))
         for line in proc.stdout.splitlines():
@@ -253,6 +253,10 @@ def main() -> None:
         # mutating-admission headline (config 7): one micro-batch's
         # batched mutate pass at the largest mutator-library size
         "mutate_s": (configs.get("7") or {}).get("mutate_s"),
+        # warm-restart headline (config 9): restore-snapshots
+        # time-to-ready vs the cold full list/encode boot
+        "warm_boot_s": (configs.get("9") or {}).get("value"),
+        "cold_boot_s": (configs.get("9") or {}).get("cold_boot_s"),
         "objects": N_OBJECTS,
         "constraints": N_CONSTRAINTS,
         "violating_pairs": n_pairs,

@@ -425,6 +425,44 @@ class Client:
                     self.driver.delete_modules(self._module_prefix(target, kind))
             self._templates = {}
 
+    def snapshot_library(self) -> dict:
+        """Raw SOURCES of every ingested template and constraint, for
+        the warm-restart state snapshot (control/statestore.py). Restore
+        replays them through add_template/add_constraint — the normal
+        ingestion path, so compile metadata and validation run exactly
+        as they would from a watch delivery — before the controllers'
+        level-triggered replay arrives and dedupes via semantic-equal."""
+        with self._lock:
+            templates = []
+            constraints = []
+            for kind in sorted(self._templates):
+                entry = self._templates[kind]
+                if entry.template.raw is not None:
+                    templates.append(copy.deepcopy(entry.template.raw))
+                for name in sorted(entry.constraints):
+                    constraints.append(
+                        copy.deepcopy(entry.constraints[name]))
+        return {"templates": templates, "constraints": constraints}
+
+    def restore_library(self, snap: dict) -> dict:
+        """Re-ingest a snapshot_library() payload. Per-item failures are
+        collected, not raised: one stale template must not abort the
+        whole warm boot (its live CR re-ingests via the watch replay)."""
+        ok = errors = 0
+        for t in snap.get("templates") or []:
+            try:
+                self.add_template(t)
+                ok += 1
+            except ClientError:
+                errors += 1
+        for c in snap.get("constraints") or []:
+            try:
+                self.add_constraint(c)
+                ok += 1
+            except ClientError:
+                errors += 1
+        return {"restored": ok, "errors": errors}
+
     def dump(self) -> str:
         return self.driver.dump()
 

@@ -237,6 +237,75 @@ class RegoDriver:
         self._audit_frz = (None, {})
         self._frz_inv = (None, None)
 
+    # spine depth below each scope node at which object leaves sit:
+    # cluster/<gv>/<kind>/<name>, namespace/<ns>/<gv>/<kind>/<name> —
+    # the same layout knowledge _try_patch_reviews encodes below
+    _INV_SCOPE_DEPTH = {"cluster": 3, "namespace": 4}
+
+    def inventory_snapshot(self) -> Optional[dict]:
+        """PLAIN copy of the synced-inventory subtree ("external") for
+        the warm-restart blob snapshot. Plain on purpose: unpickling
+        plain dicts is C-native, while reconstructing FrozenDict leaves
+        costs a Python call per node — and every consumer of the tree
+        (review building, the frozen _inventory_tree cache, the
+        interpreter's _freeze_review memo) freezes on demand anyway,
+        exactly as it does for never-frozen admission reviews. The one
+        deep pass here runs on the snapshot thread, off the serving
+        path; a concurrent mutation mid-copy fails the save (caught by
+        the manager — previous snapshot kept), never corrupts it. None
+        when empty."""
+        tree = self._interp.get_data(("external",))
+        if tree is UNDEF or not isinstance(tree, dict):
+            return None
+        return _deep_plain(tree) or None
+
+    def inventory_restore(self, tree: dict) -> int:
+        """Attach a snapshotted synced-inventory subtree, bypassing the
+        per-object add_data path (target-handler processing, freezing,
+        journal notes, and cache invalidation per object) that makes a
+        cold boot O(cluster) — the warm-restart fast path. Leaves stay
+        plain; eval paths freeze them on demand (see
+        inventory_snapshot), and any later per-object put_data
+        re-freezes its own leaf. Returns the number of objects
+        installed; unknown scopes are skipped (the tracker's resync
+        cold-path heals them)."""
+        if not isinstance(tree, dict):
+            raise DriverError("inventory snapshot must be a mapping")
+        n = 0
+        root = self._interp.data
+        ext = root.get("external")
+        if not isinstance(ext, dict):
+            ext = {}
+            root["external"] = ext
+
+        def count(node, left: int) -> int:
+            if left == 0:
+                return 1
+            if not isinstance(node, dict):
+                return 0
+            return sum(count(v, left - 1) for v in node.values())
+
+        for target, scopes in tree.items():
+            if not isinstance(scopes, dict):
+                continue
+            tnode = ext.get(target)
+            if not isinstance(tnode, dict):
+                tnode = {}
+                ext[target] = tnode
+            for scope, sub in scopes.items():
+                depth = self._INV_SCOPE_DEPTH.get(scope)
+                if depth is None or not isinstance(sub, dict):
+                    continue
+                tnode[scope] = dict(sub)
+                n += count(sub, depth)
+        # one journal break + cache drop for the whole install: the next
+        # audit rebuilds reviews from the restored tree exactly as it
+        # would after a full resync
+        self.drop_inventory_caches()
+        self._frz_params.clear()
+        self._plain_constraint.clear()
+        return n
+
     def _note_inventory_write(self, path: tuple, deleted: bool) -> None:
         notes = self._patch_notes
         if len(notes) >= 1024:

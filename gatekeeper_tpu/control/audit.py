@@ -32,6 +32,7 @@ from ..client import Client
 from . import metrics
 from .kube import GVK, KubeError, NotFound, WatchEvent
 from .logging import logger
+from .util import prune_stale_by_pod
 
 log = logger("audit")
 
@@ -104,6 +105,16 @@ class InventoryTracker:
         self._cancels: dict[GVK, Callable[[], None]] = {}
         self._poll: set[GVK] = set()   # watchless GVKs: re-list per sweep
         self._gaps: set[GVK] = set()   # one-shot resync requests
+        # last event resourceVersion per GVK: persisted in the state
+        # snapshot so a restarted pod's watches RESUME from where the
+        # old process stopped instead of re-listing the cluster
+        self._rvs: dict[GVK, str] = {}
+        # warm-restart validation gate: set once restored state has been
+        # re-validated against a live list (readyz consults this); a
+        # cold tracker is trivially validated
+        self.validated = threading.Event()
+        self.validated.set()
+        self._restoring = False
         # consecutive full-resyncs a tracked GVK was absent from
         # discovery: dropping (and purging its inventory) on the FIRST
         # absence would let one flaky discovery response evict whole
@@ -143,8 +154,16 @@ class InventoryTracker:
         def deliver(event: WatchEvent, _gvk=gvk):
             self._note_event(_gvk, event)
 
+        with self._lock:
+            resume_rv = self._rvs.get(tuple(gvk), "")
         try:
-            cancel = self.kube.watch(gvk, deliver, send_initial=False)
+            # resume from the last-seen RV when we have one; if the
+            # server rejects it (compacted while down), on_gap schedules
+            # the list-diff reconcile for anything missed
+            cancel = self.kube.watch(
+                gvk, deliver, send_initial=False,
+                resource_version=resume_rv,
+                on_gap=lambda _gvk=tuple(gvk): self.note_gap(_gvk))
         except Exception as e:
             # no stream for this GVK: degrade to per-sweep re-list diff
             # (the reference's ListerWatcher would relist on 410 Gone);
@@ -164,14 +183,72 @@ class InventoryTracker:
     def _note_event(self, gvk: GVK, event: WatchEvent) -> None:
         obj = event.object or {}
         key = _obj_key(gvk, obj)
+        rv = (obj.get("metadata") or {}).get("resourceVersion")
+
+        def as_int(v):
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                return None
+
+        rv_i = as_int(rv)
         with self._lock:
+            cur = self._dirty.get(key)
+            if cur is not None and rv_i is not None:
+                # never let a replayed/stale event clobber a NEWER
+                # pending one for the same object (a resumed watch's
+                # snapshot replay can interleave behind a live event);
+                # at EQUAL rv a pending DELETED wins — a deletion
+                # carries the object's last rv, so an equal-rv MODIFIED
+                # is the replay of the state that deletion removed.
+                # Non-numeric RVs keep last-write-wins.
+                cur_i = as_int((cur[1].get("metadata") or {})
+                               .get("resourceVersion"))
+                if cur_i is not None and \
+                        (rv_i < cur_i
+                         or (rv_i == cur_i and cur[0] == "DELETED"
+                             and event.type != "DELETED")):
+                    return
             self._dirty[key] = (event.type, obj)
+            if rv_i is not None:
+                # stream position for watch resume: advance-only, so a
+                # stale replay cannot move the snapshot point backwards
+                cur_rv = as_int(self._rvs.get(tuple(gvk)))
+                if cur_rv is None or rv_i > cur_rv:
+                    self._rvs[tuple(gvk)] = str(rv_i)
+            elif rv:
+                self._rvs[tuple(gvk)] = rv
 
     def note_gap(self, gvk: GVK) -> None:
         """External gap signal (watch stream lost beyond the client's
         own recovery): the next sweep re-list-diffs this GVK."""
         with self._lock:
             self._gaps.add(tuple(gvk))
+
+    def _note_list_rv(self, gvk: GVK, objs: list) -> None:
+        """Advance the per-GVK resume RV from a list's object RVs (max,
+        numeric servers only — FakeKube and etcd both). Lists don't
+        surface deletions, but that's safe: a deletion after the newest
+        listed object has a HIGHER rv, so a watch resumed from this
+        point still replays it. Watch events may already have moved the
+        RV further; never move it backwards."""
+        best = None
+        for o in objs:
+            try:
+                v = int((o.get("metadata") or {}).get("resourceVersion"))
+            except (TypeError, ValueError):
+                continue
+            if best is None or v > best:
+                best = v
+        if best is None:
+            return
+        with self._lock:
+            try:
+                cur = int(self._rvs.get(tuple(gvk), ""))
+            except ValueError:
+                cur = None
+            if cur is None or best > cur:
+                self._rvs[tuple(gvk)] = str(best)
 
     def _forget_gvk(self, gvk: GVK) -> None:
         """Remove a no-longer-audited GVK's objects from the inventory."""
@@ -210,11 +287,13 @@ class InventoryTracker:
 
     # -------------------------------------------------------------- deltas
 
-    def resync(self, gvk: GVK) -> None:
+    def resync(self, gvk: GVK) -> bool:
         """resourceVersion-diff against a fresh (paged, when the client
         pages) re-list: objects whose (uid, resourceVersion) differ from
         the tracked state become dirty, tracked objects missing from the
-        list become deletes. The watch-gap / 410 Gone fallback.
+        list become deletes. The watch-gap / 410 Gone fallback, and the
+        live-list re-validation a warm restart runs before readyz opens.
+        Returns False when the list failed (the gap stays pending).
 
         Relist semantics: pending dirty events that PREdate the list are
         superseded by it (a stale MODIFIED for an object the list shows
@@ -229,7 +308,10 @@ class InventoryTracker:
         except KubeError as e:
             log.error("resync list failed; keeping stale state this "
                       "sweep", details={"gvk": list(gvk), "error": str(e)})
-            return
+            with self._lock:
+                self._gaps.add(gvk)  # retry next sweep, don't lose it
+            return False
+        self._note_list_rv(gvk, objs)
         seen = set()
         with self._lock:
             for k, v in pre.items():
@@ -248,6 +330,86 @@ class InventoryTracker:
                     gone = {"metadata": {"namespace": key[1] or None,
                                          "name": key[2]}}
                     self._dirty[key] = ("DELETED", gone)
+        return True
+
+    # --------------------------------------------------- warm restart
+
+    def snapshot(self) -> dict:
+        """Persistable tracker state: the tracked GVK set, per-GVK
+        watch-resume resourceVersions, and the per-object (uid, rv)
+        state map (the encoded-inventory index; the object BODIES live
+        in the driver's data tree, snapshotted separately). The GVK set
+        is derived from every source — live watches, poll fallbacks,
+        resume RVs, AND the state map — so the SIGTERM drain snapshot
+        (taken after stop() cancelled the watches) still records what
+        to resume."""
+        with self._lock:
+            gvks = (set(self._cancels) | self._poll | set(self._rvs)
+                    | {k[0] for k in self._state})
+            return {
+                "gvks": [list(g) for g in sorted(gvks)],
+                "rvs": {"|".join(g): rv for g, rv in self._rvs.items()},
+                "state": [[list(k[0]), k[1], k[2], v[0], v[1]]
+                          for k, v in sorted(self._state.items())],
+                # buffered-but-unapplied events MUST ride along: the
+                # resume RVs above were already advanced by them, so a
+                # resumed watch will never re-deliver them — dropping
+                # them here would silently lose the delta
+                "dirty": [[list(k[0]), k[1], k[2], etype, obj]
+                          for k, (etype, obj)
+                          in sorted(self._dirty.items())],
+            }
+
+    def restore(self, snap: dict) -> int:
+        """Seed the tracker from a snapshot: state map and resume RVs
+        installed, watches subscribed AT the persisted RVs — no initial
+        re-list, no duplicate ADDED storm; a successfully resumed
+        stream replays everything missed while down, deletes included.
+        A GVK whose RV was rejected (compacted — the 410 case) or whose
+        watch could not be established lands in the gap set via on_gap
+        / the poll fallback, and the first sweep list-diffs exactly
+        those against the live cluster. readyz stays closed until that
+        first sweep validates (validated Event). Returns tracked-object
+        count."""
+        state: dict[tuple, tuple] = {}
+        for entry in snap.get("state") or []:
+            gvk, ns, name, uid, rv = entry
+            state[(tuple(gvk), ns, name)] = (uid, rv)
+        rvs: dict[GVK, str] = {}
+        for key, rv in (snap.get("rvs") or {}).items():
+            parts = tuple(key.split("|"))
+            if len(parts) == 3 and rv:
+                rvs[parts] = str(rv)
+        gvks = [tuple(g) for g in snap.get("gvks") or []]
+        # a synchronous-resume client (FakeKube) settles gap detection
+        # before watch() returns, so a clean resume needs no list; an
+        # ASYNC client (REST streamer: a 410 arrives a round-trip after
+        # subscribe) could otherwise open readyz before the gap signal
+        # lands — for those, EVERY restored GVK re-validates against a
+        # live (uid, rv) list-diff on the first sweep (cheap metadata
+        # compare; only changed objects re-encode)
+        sync_resume = getattr(self.kube, "watch_resume_synchronous",
+                              False)
+        dirty: dict[tuple, tuple] = {}
+        for entry in snap.get("dirty") or []:
+            gvk, ns, name, etype, obj = entry
+            dirty[(tuple(gvk), ns, name)] = (etype, obj)
+        with self._lock:
+            self._state = state
+            self._rvs = rvs
+            self._dirty = dirty  # un-applied events from the old process
+            self._restoring = True
+            for g in gvks:
+                # no resume point means the watch starts blind: the
+                # list-diff must reconcile missed deletes either way
+                if not sync_resume or g not in rvs:
+                    self._gaps.add(g)
+        self.validated.clear()
+        for g in gvks:
+            self._watch_gvk(g, quiet=True)
+        log.info("inventory tracker restored",
+                 details={"objects": len(state), "gvks": len(gvks)})
+        return len(state)
 
     def apply_pending(self) -> dict:
         """Drain the dirty map into the client's synced inventory.
@@ -262,8 +424,14 @@ class InventoryTracker:
             # re-lists forever; the resync below bridges the gap up to
             # the moment the new watch attached
             self._watch_gvk(g, quiet=True)
+        resyncs_ok = True
         for g in gaps:
-            self.resync(g)
+            resyncs_ok = self.resync(g) and resyncs_ok
+        if self._restoring and resyncs_ok:
+            # restored state is now re-validated against live lists:
+            # open the readyz gate
+            self._restoring = False
+            self.validated.set()
         with self._lock:
             drained = self._dirty
             self._dirty = {}
@@ -351,6 +519,7 @@ class InventoryTracker:
                 state.update({k: v for k, v in old_state.items()
                               if k[0] == gvk})
                 continue
+            self._note_list_rv(gvk, objs)
             with self._lock:
                 # the list supersedes this GVK's pre-list event backlog
                 # (same relist semantics as resync); mid-list arrivals
@@ -400,13 +569,22 @@ class AuditManager:
                  audit_from_cache: bool = False,
                  incremental: bool = False,
                  full_resync_every: int = DEFAULT_FULL_RESYNC_EVERY,
-                 write_breaker=None):
+                 write_breaker=None, leader_check=None,
+                 gc_stale_statuses: bool = True):
         self.kube = kube
         self.opa = opa
         self.interval = interval
         self.limit = constraint_violations_limit
         self.audit_from_cache = audit_from_cache
         self.incremental = incremental
+        # HA: with leader election enabled, only the lease holder runs
+        # sweeps — two replicas must not race each other's
+        # constraint-status PATCHes. None = single-replica, always on
+        self.leader_check = leader_check
+        # prune byPod status entries whose pod no longer exists (a
+        # replaced pod's statuses must be garbage-collected, not
+        # accumulate across restarts)
+        self.gc_stale_statuses = gc_stale_statuses
         # N <= 0 disables the PERIODIC re-encode (k8s resync-period
         # convention); the first sweep always encodes from scratch
         self.full_resync_every = full_resync_every
@@ -440,6 +618,23 @@ class AuditManager:
     def _loop(self) -> None:
         while not self._stop.is_set():
             self.heartbeat = time.monotonic()
+            if self.leader_check is not None and not self.leader_check():
+                # follower replica: keep the heartbeat fresh (the pod is
+                # healthy, just not leading) and poll for promotion at a
+                # sub-lease cadence so failover costs one lease duration.
+                # The tracker still DRAINS: a warm-restored follower must
+                # re-validate (readyz's state-restore gate), its dirty
+                # map must not grow unboundedly while following, and a
+                # promoted survivor should sweep over a current
+                # inventory, not a stale one.
+                if self.incremental and self.tracker is not None:
+                    try:
+                        self.tracker.apply_pending()
+                    except Exception as e:
+                        log.error("follower inventory sync failed",
+                                  details=str(e))
+                self._stop.wait(min(self.interval, 1.0))
+                continue
             try:
                 self.audit_once()
             except Exception as e:
@@ -461,6 +656,38 @@ class AuditManager:
         if max_stall is None:
             max_stall = max(10 * self.interval, 300.0)
         return time.monotonic() - self.heartbeat <= max_stall
+
+    # --------------------------------------------------------- warm restart
+
+    def restore_state(self, snap: dict) -> int:
+        """Seed the incremental tracker from a state snapshot (see
+        statestore.py; the driver's data tree is restored separately,
+        before this). The first sweep then runs INCREMENTAL — a live-
+        list (uid, rv) re-validation plus whatever delta accumulated
+        while down — instead of the forced from-scratch re-encode a
+        cold boot pays."""
+        if not self.incremental:
+            return 0
+        self.tracker = InventoryTracker(self.kube, self.opa)
+        n = self.tracker.restore(snap)
+        # sweep 0 forces a full re-encode (cold bootstrap); a restored
+        # tracker starts at sweep 1 so the backstop cadence is kept but
+        # the boot sweep stays incremental
+        self._sweeps = 1
+        return n
+
+    def restore_ready(self) -> bool:
+        """readyz gate: restored state must be re-validated against a
+        live list before the pod reports Ready (trivially true when
+        nothing was restored)."""
+        return self.tracker is None or self.tracker.validated.is_set()
+
+    def snapshot_state(self) -> Optional[dict]:
+        """Tracker section of the state snapshot; None before the first
+        incremental sweep built a tracker."""
+        if self.tracker is None:
+            return None
+        return self.tracker.snapshot()
 
     # ----------------------------------------------------------------- audit
 
@@ -704,7 +931,8 @@ class AuditManager:
         target_kinds = set()
         for kind in self.opa.template_kinds():
             target_kinds.add(kind)
-        written = skipped = 0
+        live_pods = self._live_pod_ids() if self.gc_stale_statuses else None
+        written = skipped = pruned = 0
         for kind in sorted(target_kinds):
             gvk = (CONSTRAINT_GROUP, "v1beta1", kind)
             try:
@@ -716,8 +944,11 @@ class AuditManager:
                 name = (obj.get("metadata") or {}).get("name") or ""
                 violations = by_constraint.get((kind, name), [])
                 entries = self._status_entries(violations)
+                gced = live_pods is not None and \
+                    prune_stale_by_pod(obj, live_pods)
+                pruned += 1 if gced else 0
                 cur = obj.get("status") or {}
-                if not force and \
+                if not force and not gced and \
                         cur.get("totalViolations") == len(violations) \
                         and (cur.get("violations") or []) == entries:
                     skipped += 1
@@ -725,8 +956,66 @@ class AuditManager:
                 if self._update_constraint_status(obj, entries,
                                                   len(violations)):
                     written += 1
+        pruned += self._gc_template_statuses(live_pods)
         metrics.report_audit_status_writes(written, skipped)
-        return {"status_writes": written, "status_skipped": skipped}
+        out = {"status_writes": written, "status_skipped": skipped}
+        if pruned:
+            out["status_gc"] = pruned
+        return out
+
+    def _live_pod_ids(self) -> Optional[set]:
+        """Pod names of the live gatekeeper replicas in our namespace,
+        for byPod status GC. None (= skip GC) when the listing fails or
+        shows no labeled pods at all — partial visibility must never
+        garbage-collect a living replica's status."""
+        from .util import pod_name, pod_namespace
+
+        try:
+            pods = self.kube.list(("", "v1", "Pod"), pod_namespace())
+        except KubeError:
+            return None
+        live = set()
+        for p in pods:
+            meta = p.get("metadata") or {}
+            if "gatekeeper.sh/system" in (meta.get("labels") or {}):
+                live.add(meta.get("name"))
+        if not live:
+            return None  # can't see replica pods (RBAC/dev): don't GC
+        live.add(pod_name())
+        return live
+
+    def _gc_template_statuses(self, live_pods: Optional[set]) -> int:
+        """Prune replaced pods' byPod entries from ConstraintTemplate
+        statuses (the leader sweeps these once per audit)."""
+        if live_pods is None:
+            return 0
+        template_gvk = ("templates.gatekeeper.sh", "v1beta1",
+                        "ConstraintTemplate")
+        pruned = 0
+        try:
+            templates = self.kube.list(template_gvk)
+        except KubeError:
+            return 0
+        from .resilience import guarded_status_update
+
+        for obj in templates:
+            if not prune_stale_by_pod(obj, live_pods):
+                continue
+
+            def refresh(cur_obj, _gvk=template_gvk):
+                try:
+                    cur = self.kube.get(
+                        _gvk, (cur_obj.get("metadata") or {})
+                        .get("name") or "")
+                except KubeError:
+                    return None
+                if not prune_stale_by_pod(cur, live_pods):
+                    return None
+                return cur
+
+            if guarded_status_update(self.kube, obj, refresh):
+                pruned += 1
+        return pruned
 
     def _status_entries(self, violations: list) -> list:
         """The capped, truncated violation entries a status write

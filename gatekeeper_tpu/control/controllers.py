@@ -32,7 +32,7 @@ from ..target.handler import WipeData
 from . import metrics
 from .kube import GVK, FakeKube, KubeError, NotFound, WatchEvent, gvk_of
 from .logging import logger
-from .resilience import guarded_status_update
+from .resilience import NotLeader, guarded_status_update
 from .util import (
     DEFAULT_ENFORCEMENT_ACTION,
     VALID_ENFORCEMENT_ACTIONS,
@@ -164,6 +164,11 @@ class TemplateController:
         # create/update the generated constraint CRD in-cluster
         try:
             self.kube.apply(crd)
+        except NotLeader:
+            # defensive: controllers normally ride the UNGATED guard
+            # (byPod slots are pod-owned, CRD applies idempotent), but
+            # tolerate an operator wiring a fenced client
+            pass
         except KubeError as e:
             log.warning("constraint CRD apply failed", template_name=name,
                         details=str(e))

@@ -79,6 +79,13 @@ class FakeKube:
         self._lock = threading.RLock()
         self._store: dict[GVK, dict[tuple, dict]] = {}
         self._rv = 0
+        self._compacted_rv = 0  # resume floor: older RVs must relist
+        # deletion tombstones (rv, gvk, obj): a watch resuming from a
+        # valid RV must replay deletes that happened while the client
+        # was down, exactly as a real apiserver's event history does.
+        # Bounded; trimming advances the compaction floor so resumes
+        # older than the retained history take the relist path.
+        self._deleted: list[tuple] = []
         self._watchers: dict[GVK, list[Callable[[WatchEvent], None]]] = {}
         # discovery: gvk -> {"namespaced": bool, "verbs": [...]}
         self._discovery: dict[GVK, dict] = {}
@@ -89,6 +96,14 @@ class FakeKube:
         self.calls: list[tuple] = []
 
     _CALL_LOG_CAP = 100_000
+
+    # watch(resource_version=...) settles SYNCHRONOUSLY: by the time it
+    # returns, the replay was delivered and on_gap (if due) has fired.
+    # The tracker's warm-restart validation trusts such resumes without
+    # a list-diff; asynchronous clients (the REST streamer, whose 410
+    # arrives a round-trip later) must leave this False so restored
+    # state is re-validated against a live list instead.
+    watch_resume_synchronous = True
 
     def _record(self, call: tuple) -> None:
         if len(self.calls) >= self._CALL_LOG_CAP:
@@ -180,6 +195,8 @@ class FakeKube:
             meta["resourceVersion"] = cur["metadata"]["resourceVersion"]
             return self.update(obj)
 
+    _DELETE_LOG_CAP = 10_000
+
     def delete(self, gvk: GVK, name: str, namespace: str = "") -> None:
         with self._lock:
             self._record(("delete", tuple(gvk), (namespace, name)))
@@ -187,6 +204,16 @@ class FakeKube:
             obj = bucket.pop((namespace, name), None)
             if obj is None:
                 raise NotFound(f"{gvk} {namespace}/{name}")
+            # tombstone at its own RV (apiserver semantics): resumed
+            # watches replay it; trimming moves the compaction floor so
+            # resumes predating retained history must relist instead
+            self._deleted.append((int(self._bump()), tuple(gvk),
+                                  copy.deepcopy(obj)))
+            if len(self._deleted) > self._DELETE_LOG_CAP:
+                cut = len(self._deleted) // 2
+                self._compacted_rv = max(self._compacted_rv,
+                                         self._deleted[cut - 1][0])
+                del self._deleted[:cut]
         self._notify(tuple(gvk), WatchEvent("DELETED", copy.deepcopy(obj)))
 
     def list(self, gvk: GVK, namespace: Optional[str] = None) -> list[dict]:
@@ -200,15 +227,77 @@ class FakeKube:
 
     # --------------------------------------------------------------- watch
 
-    def watch(self, gvk: GVK, callback: Callable[[WatchEvent], None],
-              send_initial: bool = True) -> Callable[[], None]:
-        """Subscribe; returns an unsubscribe fn. With send_initial, current
-        objects are delivered as ADDED first (informer list+watch)."""
-        initial = self.list(gvk) if send_initial else []
+    def compact(self) -> None:
+        """Chaos/test helper mirroring etcd compaction: resuming a watch
+        from any RV issued before this call behaves like a 410 Gone —
+        the full relist-style replay instead of a delta resume."""
         with self._lock:
-            self._watchers.setdefault(tuple(gvk), []).append(callback)
-        for obj in initial:
-            callback(WatchEvent("ADDED", obj))
+            self._compacted_rv = self._rv
+
+    def watch(self, gvk: GVK, callback: Callable[[WatchEvent], None],
+              send_initial: bool = True, resource_version: str = "",
+              on_gap: Optional[Callable[[], None]] = None) -> Callable[[], None]:
+        """Subscribe; returns an unsubscribe fn. With send_initial, current
+        objects are delivered as ADDED first (informer list+watch).
+
+        With resource_version, delivery RESUMES from that point: the
+        deletion tombstones and current objects newer than the RV replay
+        (DELETED first, then MODIFIED — so a delete-then-recreate lands
+        in the right final state) and nothing else — a restart that
+        persisted its RV sees no duplicate ADDED storm and misses no
+        delete. An RV older than the compaction floor (compact(), or
+        tombstone trimming) takes the 410-gap path instead: on_gap fires
+        (the subscriber schedules its list-diff reconcile) and every
+        live object replays as ADDED for the state map to dedupe."""
+        resume: Optional[int] = None
+        deletes: list[dict] = []
+        changed: list[dict] = []
+        if resource_version:
+            try:
+                resume = int(resource_version)
+            except ValueError:
+                resume = None
+        gap = False
+        with self._lock:
+            if resume is not None and resume < self._compacted_rv:
+                resume = None  # too old: full relist-style replay
+                send_initial = True
+                gap = True
+            elif resume is not None:
+                # replay snapshot AND registration under ONE lock hold:
+                # store commits happen under this lock, so no event can
+                # land between the snapshot and the subscription (a
+                # commit before the snapshot is in the replay AND may
+                # notify us too — duplicates are (uid, rv) no-ops for
+                # the subscriber's state map). Only objects NEWER than
+                # the resume point are copied out — the warm-boot fast
+                # path must not deep-copy the whole unchanged bucket.
+                deletes = [copy.deepcopy(d[2]) for d in self._deleted
+                           if d[0] > resume and d[1] == tuple(gvk)]
+                for obj in self._store.get(tuple(gvk), {}).values():
+                    try:
+                        orv = int((obj.get("metadata") or {})
+                                  .get("resourceVersion") or 0)
+                    except ValueError:
+                        orv = resume + 1  # deliver; state map decides
+                    if orv > resume:
+                        changed.append(copy.deepcopy(obj))
+            if resume is not None:
+                self._watchers.setdefault(tuple(gvk), []).append(callback)
+        if gap and on_gap is not None:
+            on_gap()
+        if resume is None:
+            initial = self.list(gvk) if send_initial else []
+            with self._lock:
+                self._watchers.setdefault(tuple(gvk),
+                                          []).append(callback)
+            for obj in initial:
+                callback(WatchEvent("ADDED", obj))
+        else:
+            for obj in deletes:
+                callback(WatchEvent("DELETED", obj))
+            for obj in changed:
+                callback(WatchEvent("MODIFIED", obj))
 
         def cancel():
             with self._lock:
@@ -525,12 +614,24 @@ class RestKubeClient:
         stop.wait(backoff * (0.5 + random.random() * 0.5))
         return min(backoff * 2, self.WATCH_BACKOFF_CAP_S)
 
-    def watch(self, gvk: GVK, callback, send_initial: bool = True):
+    def watch(self, gvk: GVK, callback, send_initial: bool = True,
+              resource_version: str = "", on_gap=None):
         """Streaming watch (?watch=1&resourceVersion=...) with bookmark
         handling and backoff-relist on 410 Gone — client-go informer
         semantics (the dynamiccache fork's underlying ListerWatcher).
         Falls back to poll-and-diff when the server cannot stream
-        (e.g. a stub without watch support)."""
+        (e.g. a stub without watch support).
+
+        With resource_version (warm restart: the persisted per-GVK RV),
+        the initial paged re-list is SKIPPED and the stream opens at
+        that RV — no duplicate ADDED storm for a cluster the caller
+        already knows; a successful stream replays everything missed
+        while down, deletes included. If the server instead answers 410
+        Gone (RV compacted), `on_gap` fires ONCE — the caller schedules
+        its own list-diff reconcile for objects deleted in the gap —
+        and the standard backoff-relist heals the rest: the diff against
+        the empty known-map re-emits every live object and the caller's
+        state map dedupes."""
         stop = threading.Event()
 
         def relist(known: dict, first: bool) -> tuple[dict, str]:
@@ -612,25 +713,50 @@ class RestKubeClient:
 
         def loop():
             known: dict = {}
-            first = True
-            rv = ""
-            need_relist = True
+            # resume mode: stream straight from the persisted RV (no
+            # initial list); first=False so any later gap-heal relist
+            # EMITS its diff instead of suppressing it
+            first = not resource_version
+            rv = resource_version or ""
+            need_relist = not resource_version
+            # until the resumed stream is confirmed good, any fall into
+            # the relist path means events (deletes especially) may have
+            # been missed: signal the gap exactly once
+            resume_pending = bool(resource_version)
             backoff = self.WATCH_BACKOFF_BASE_S
             bad_frames = 0
             while not stop.is_set():
                 try:
                     if need_relist:
+                        if resume_pending:
+                            resume_pending = False
+                            if on_gap is not None:
+                                try:
+                                    on_gap()
+                                except Exception:
+                                    pass
                         known, rv = relist(known, first)
                         first = False
                         need_relist = False
                     known, rv, gone = stream(known, rv)
                     backoff = self.WATCH_BACKOFF_BASE_S
                     bad_frames = 0
+                    if not gone:
+                        resume_pending = False  # server accepted our RV
                     if gone:
                         need_relist = True  # RV expired: resync
                 except urllib.error.HTTPError as e:
                     if e.code in (400, 405, 501):
-                        # server cannot stream: degrade to polling
+                        # server cannot stream: degrade to polling. A
+                        # pending resume dies here — the poll diff
+                        # against an empty known-map cannot surface
+                        # downtime deletions, so the gap must be
+                        # signaled before degrading
+                        if resume_pending and on_gap is not None:
+                            try:
+                                on_gap()
+                            except Exception:
+                                pass
                         poll_loop(known, first)
                         return
                     need_relist = True
@@ -651,3 +777,314 @@ class RestKubeClient:
         t = threading.Thread(target=loop, daemon=True)
         t.start()
         return stop.set
+
+
+# ------------------------------------------------------------ leader election
+
+
+LEASE_GVK = ("coordination.k8s.io", "v1", "Lease")
+
+_LEASE_TIME_FMT = "%Y-%m-%dT%H:%M:%S.%fZ"
+
+
+def _lease_now() -> str:
+    import datetime
+
+    return datetime.datetime.utcnow().strftime(_LEASE_TIME_FMT)
+
+
+def _lease_parse(ts) -> Optional[float]:
+    import calendar
+    import datetime
+
+    if not ts:
+        return None
+    for fmt in (_LEASE_TIME_FMT, "%Y-%m-%dT%H:%M:%SZ"):
+        try:
+            dt = datetime.datetime.strptime(str(ts), fmt)
+            return calendar.timegm(dt.timetuple()) + dt.microsecond / 1e6
+        except ValueError:
+            continue
+    return None
+
+
+class LeaseElector:
+    """`coordination.k8s.io/v1` Lease-based leader election.
+
+    Counterpart of controller-runtime's leaderelection (the reference
+    runs its audit and status writers under it, main.go's
+    --enable-leader-election): acquire-or-takeover with conflict-safe
+    updates, periodic renewal at a fraction of the lease duration,
+    jittered retry while another holder is live, and a graceful release
+    on stop() so failover costs milliseconds instead of a full lease
+    timeout. Leadership transitions are logged, exported via the
+    gatekeeper_tpu_leader metric, and delivered to the optional
+    callbacks; `is_leader` is the gate the audit loop and the
+    GuardedKube write fence consult.
+
+    The `kube.lease` fault point (utils/faults.py) simulates theft
+    ("steal": a rival identity takes the lease), lapse ("expire": our
+    renews stop landing), and renew API failures ("error")."""
+
+    def __init__(self, kube, lease_name: str = "gatekeeper-tpu-leader",
+                 namespace: str = "gatekeeper-system",
+                 identity: Optional[str] = None,
+                 lease_duration: float = 15.0,
+                 retry_period: Optional[float] = None,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None):
+        from .util import pod_name
+
+        self.kube = kube
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or pod_name()
+        self.lease_duration = max(0.1, lease_duration)
+        self.retry_period = retry_period if retry_period is not None \
+            else max(0.05, self.lease_duration / 3.0)
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._leader = threading.Event()
+        self._last_renew = 0.0
+        # locally-observed renew tracking (client-go's technique): a
+        # rival's lease is "expired" only when (holder, renewTime) has
+        # not CHANGED for a lease duration on OUR monotonic clock —
+        # comparing the holder's wall-clock renewTime directly would
+        # turn inter-node clock skew into premature takeover (dual
+        # leaders) or delayed failover
+        self._observed: Optional[tuple] = None  # (holder, renew_raw, t)
+        self.transitions = 0  # local became/lost count, for tests
+
+    # --------------------------------------------------------------- state
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader.is_set()
+
+    def wait_leader(self, timeout: Optional[float] = None) -> bool:
+        return self._leader.wait(timeout)
+
+    def healthy(self) -> bool:
+        """The elector loop is running (readiness surfaces a dead
+        elector; NOT being leader is a normal state, not a failure)."""
+        return self._thread is None or self._thread.is_alive() or \
+            self._stop.is_set()
+
+    def _become(self, leading: bool, why: str) -> None:
+        from . import metrics
+
+        was = self._leader.is_set()
+        if was == leading:
+            return
+        if leading:
+            self._leader.set()
+        else:
+            self._leader.clear()
+        self.transitions += 1
+        metrics.report_leader(leading)
+        _lease_log().info(
+            "leadership %s" % ("acquired" if leading else "lost"),
+            details={"lease": f"{self.namespace}/{self.lease_name}",
+                     "identity": self.identity, "reason": why})
+        cb = self.on_started_leading if leading else self.on_stopped_leading
+        if cb is not None:
+            try:
+                cb()
+            except Exception as e:
+                _lease_log().error("leadership callback failed",
+                                   details=str(e))
+
+    # --------------------------------------------------------------- lease
+
+    def _lease_stub(self) -> dict:
+        return {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                "metadata": {"name": self.lease_name,
+                             "namespace": self.namespace}}
+
+    def _tick(self) -> None:
+        from ..utils import faults
+
+        fault = faults.consume("kube.lease", identity=self.identity)
+        if fault is not None:
+            self._apply_fault(fault)
+            if fault[0] in ("error", "raise"):
+                raise KubeError("injected fault at kube.lease", code=500)
+            if fault[0] == "expire":
+                # our renews stopped landing: no renew THIS tick — the
+                # lapsed lease sits takeable until the next tick, when
+                # we re-contend like any other candidate
+                return
+        try:
+            lease = self.kube.get(LEASE_GVK, self.lease_name,
+                                  self.namespace)
+        except NotFound:
+            self._try_create()
+            return
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity") or ""
+        renew_raw = spec.get("renewTime")
+        duration = float(spec.get("leaseDurationSeconds")
+                         or self.lease_duration)
+        now = time.monotonic()
+        if self._observed is None or \
+                self._observed[:2] != (holder, renew_raw):
+            self._observed = (holder, renew_raw, now)
+        expired = renew_raw is None or \
+            now - self._observed[2] > duration
+        if holder == self.identity:
+            self._renew(lease)
+        elif not holder or expired:
+            self._takeover(lease)
+        else:
+            # another holder is live: we are (or just became) a follower
+            self._become(False, f"lease held by {holder}")
+
+    def _try_create(self) -> None:
+        lease = self._lease_stub()
+        lease["spec"] = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(max(1, self.lease_duration)),
+            "acquireTime": _lease_now(),
+            "renewTime": _lease_now(),
+            "leaseTransitions": 0,
+        }
+        try:
+            self.kube.create(lease)
+        except Conflict:
+            return  # raced another candidate; next tick re-evaluates
+        self._last_renew = time.monotonic()
+        self._become(True, "lease created")
+
+    def _renew(self, lease: dict) -> None:
+        lease["spec"]["renewTime"] = _lease_now()
+        lease["spec"]["holderIdentity"] = self.identity
+        try:
+            self.kube.update(lease)
+        except Conflict:
+            # someone else wrote the lease: re-read next tick; if we
+            # were deposed, the holder check will demote us then
+            self._check_renew_deadline()
+            return
+        except KubeError:
+            self._check_renew_deadline()
+            return
+        self._last_renew = time.monotonic()
+        self._become(True, "lease renewed")
+
+    def _takeover(self, lease: dict) -> None:
+        spec = lease.setdefault("spec", {})
+        spec["holderIdentity"] = self.identity
+        spec["leaseDurationSeconds"] = int(max(1, self.lease_duration))
+        spec["acquireTime"] = _lease_now()
+        spec["renewTime"] = _lease_now()
+        spec["leaseTransitions"] = int(spec.get("leaseTransitions") or 0) + 1
+        try:
+            self.kube.update(lease)  # RV-checked: losers Conflict
+        except (Conflict, KubeError):
+            return
+        self._last_renew = time.monotonic()
+        self._become(True, "expired lease taken over")
+
+    # a failing leader steps down at this fraction of the lease
+    # duration — STRICTLY before the horizon at which rivals may take
+    # over (client-go's renewDeadline < leaseDuration), so fenced
+    # writes stop before a new leader's writes can start
+    RENEW_DEADLINE_FRACTION = 2.0 / 3.0
+
+    def _check_renew_deadline(self) -> None:
+        """A leader whose renews keep failing must step down BEFORE the
+        lease it last wrote can lapse for everyone else — stepping down
+        only at the full duration would leave a window where a rival
+        has legitimately taken over while we still pass the write
+        fence."""
+        deadline = self.lease_duration * self.RENEW_DEADLINE_FRACTION
+        if self.is_leader and \
+                time.monotonic() - self._last_renew > deadline:
+            self._become(False, "renew deadline exceeded")
+
+    def _apply_fault(self, fault: tuple) -> None:
+        mode, param = fault
+        if mode == "steal":
+            # a rival identity takes the lease out from under us
+            thief = param or "chaos-rival"
+            try:
+                lease = self.kube.get(LEASE_GVK, self.lease_name,
+                                      self.namespace)
+                lease.setdefault("spec", {}).update({
+                    "holderIdentity": thief, "renewTime": _lease_now(),
+                    "leaseDurationSeconds":
+                        int(max(1, self.lease_duration))})
+                self.kube.update(lease)
+            except KubeError:
+                pass
+        elif mode == "expire":
+            # our renews stopped landing: the lease lapses and we must
+            # step down before anyone else can claim it
+            try:
+                lease = self.kube.get(LEASE_GVK, self.lease_name,
+                                      self.namespace)
+                if (lease.get("spec") or {}).get("holderIdentity") == \
+                        self.identity:
+                    lease["spec"]["renewTime"] = \
+                        "1970-01-01T00:00:00.000000Z"
+                    self.kube.update(lease)
+            except KubeError:
+                pass
+            self._become(False, "lease expired (injected)")
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        from . import metrics
+
+        # export the initial follower state: a replica that never wins
+        # must still emit the gauge, or the sum(is_leader="true") != 1
+        # alert cannot tell "no leader" from "no metrics"
+        metrics.report_leader(self.is_leader)
+        self._thread = threading.Thread(target=self._loop, name="elector",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, release: bool = True) -> None:
+        """Graceful shutdown: release the lease when we hold it so the
+        survivor fails over immediately instead of waiting out the
+        lease duration (release=False simulates a crash in tests)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if release and self.is_leader:
+            try:
+                lease = self.kube.get(LEASE_GVK, self.lease_name,
+                                      self.namespace)
+                if (lease.get("spec") or {}).get("holderIdentity") == \
+                        self.identity:
+                    lease["spec"]["holderIdentity"] = ""
+                    self.kube.update(lease)
+            except KubeError as e:
+                _lease_log().warning("lease release failed",
+                                     details=str(e))
+        self._become(False, "shutdown")
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except Exception as e:
+                _lease_log().warning("leader election tick failed",
+                                     details=str(e))
+                self._check_renew_deadline()
+            # renew fast while leading; retry jittered while following
+            # (full jitter: candidates must not stampede the apiserver
+            # in lockstep when a leader dies)
+            period = self.retry_period
+            if not self.is_leader:
+                period *= 0.5 + random.random()
+            self._stop.wait(period)
+
+
+def _lease_log():
+    from .logging import logger
+
+    return logger("elector")

@@ -58,6 +58,17 @@ def _parse_fail_closed(value: str) -> bool:
         f"cannot parse {value!r}; use true/false or Fail/Ignore")
 
 
+def _parse_bool(value: str) -> bool:
+    """Plain boolean flag values (chart templating renders YAML bools
+    as True/False; accept every common spelling)."""
+    v = str(value).strip().lower()
+    if v in ("true", "1", "yes", "on"):
+        return True
+    if v in ("false", "0", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"cannot parse {value!r} as a bool")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="gatekeeper-tpu",
@@ -149,6 +160,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "'kube.write:error:503@0.5,webhook.flush:sleep:2'"
                         " (see gatekeeper_tpu/utils/faults.py; also via "
                         "GATEKEEPER_TPU_FAULTS)")
+    p.add_argument("--state-dir", default="",
+                   help="directory for durable state snapshots (the "
+                        "warm-restart path: encoded inventory + watch-"
+                        "resume resourceVersions, template/constraint/"
+                        "mutator library, strtab vocab). Empty disables "
+                        "snapshotting; a corrupt or stale snapshot "
+                        "falls back to the cold start path, never a "
+                        "crash loop")
+    p.add_argument("--snapshot-interval", type=float, default=60.0,
+                   help="seconds between periodic state snapshots "
+                        "(also taken on SIGTERM drain; SIGHUP forces "
+                        "one immediately); <= 0 disables the periodic "
+                        "loop")
+    p.add_argument("--leader-elect", nargs="?", const=True, default=False,
+                   type=_parse_bool,
+                   help="coordination.k8s.io/v1 Lease-based leader "
+                        "election: only the lease holder runs the audit "
+                        "sweep and controller/cert status writers, so "
+                        "the deployment scales to replicas > 1 (every "
+                        "replica still serves admission)")
+    p.add_argument("--leader-lease-duration", type=float, default=15.0,
+                   help="leader lease duration (seconds); failover after "
+                        "a leader crash completes within one duration "
+                        "(graceful shutdown releases the lease "
+                        "immediately)")
+    p.add_argument("--pod-name", default="",
+                   help="stable pod identity for byPod statuses and the "
+                        "leader lease (wire the downward-API "
+                        "metadata.name here; falls back to $POD_NAME / "
+                        "$HOSTNAME)")
+    p.add_argument("--pod-namespace", default="",
+                   help="namespace for the leader lease and status "
+                        "bookkeeping (downward-API metadata.namespace; "
+                        "falls back to $POD_NAMESPACE)")
     p.add_argument("--disable-cert-rotation", action="store_true")
     p.add_argument("--disable-enforcementaction-validation",
                    action="store_true")
@@ -170,6 +215,12 @@ class Runtime:
         self.args = args
         operations = set(args.operation or ["webhook", "audit"])
         self.operations = operations
+        # stable pod identity (downward API via flags): byPod statuses
+        # and the leader lease must survive pod replacement under the
+        # SAME id, so a restarted pod overwrites its own slot
+        from .util import pod_namespace, set_pod_identity
+        set_pod_identity(getattr(args, "pod_name", ""),
+                         getattr(args, "pod_namespace", ""))
         self.kube = kube if kube is not None else (
             FakeKube() if args.fake_kube else RestKubeClient())
         if isinstance(self.kube, FakeKube):
@@ -178,6 +229,25 @@ class Runtime:
             FAULTS.configure(args.fault_injection)
             log.warning("fault injection armed",
                         details={"points": FAULTS.armed()})
+        # HA: Lease-based leader election — only the lease holder runs
+        # the audit sweep and the in-cluster status/CRD/cert writers;
+        # every replica serves admission. The elector itself talks to
+        # the RAW client (its lease writes must not be fenced by the
+        # leadership gate they implement)
+        self.elector = None
+        if getattr(args, "leader_elect", False):
+            from .kube import LeaseElector
+            # one lease PER DEPLOYMENT (operation set), not one global:
+            # the audit and webhook deployments both elect, and a
+            # webhook pod holding a shared lease would starve the audit
+            # sweep forever (its own audit loop does not exist)
+            lease_name = ("gatekeeper-tpu-leader-"
+                          + "-".join(sorted(operations)))
+            self.elector = LeaseElector(
+                self.kube, lease_name=lease_name,
+                namespace=pod_namespace(),
+                lease_duration=getattr(args, "leader_lease_duration",
+                                       15.0))
         # shared write-resilience: one breaker + retry budget for every
         # control-loop writer (audit status PATCHes, cert secret/CA
         # injection); readiness surfaces the open breaker
@@ -185,9 +255,18 @@ class Runtime:
             "kube-writes",
             failure_threshold=getattr(args, "kube_breaker_threshold", 5),
             reset_timeout=getattr(args, "kube_breaker_reset", 30.0))
-        self.kube_guard = GuardedKube(
-            self.kube, self.write_breaker,
-            RetryBudget(getattr(args, "kube_retry_budget", 10.0)))
+        budget = RetryBudget(getattr(args, "kube_retry_budget", 10.0))
+        self.kube_guard = GuardedKube(self.kube, self.write_breaker,
+                                      budget)
+        # leadership-fenced guard for the audit + controller writers: a
+        # deposed leader's in-flight status writes abort at the proxy
+        # (resilience.NotLeader) instead of racing the new leader. With
+        # election off it IS the plain guard.
+        self.kube_gated = self.kube_guard
+        if self.elector is not None:
+            self.kube_gated = GuardedKube(
+                self.kube, self.write_breaker, budget,
+                write_gate=lambda: self.elector.is_leader)
         driver = TpuDriver()
         self.opa = Backend(driver).new_client([K8sValidationTarget()])
         self.mutation_system = None
@@ -197,7 +276,12 @@ class Runtime:
                 max_iterations=getattr(args, "mutation_max_iterations", 10))
         # controllers ride the guarded client too: byPod status writes
         # and CRD applies share the one breaker/retry discipline (reads
-        # and watches pass straight through the proxy)
+        # and watches pass straight through the proxy). Deliberately
+        # UNGATED even under leader election: byPod slots are keyed by
+        # pod id (only the owning pod can write its slot — e.g. a
+        # follower surfacing its own device-eval quarantine), and CRD/
+        # finalizer applies are idempotent with conflict retries, so
+        # fencing them would suppress per-pod state for no safety gain.
         self.manager = ControllerManager(
             self.kube_guard, self.opa,
             validate_actions=not args.disable_enforcementaction_validation,
@@ -209,16 +293,19 @@ class Runtime:
         self.audit = None
         if "audit" in operations:
             # the guarded client: status writes ride the shared breaker/
-            # retry budget; reads and the tracker's watches pass through
+            # retry budget; reads and the tracker's watches pass through.
+            # Under leader election only the lease holder sweeps.
             self.audit = AuditManager(
-                self.kube_guard, self.opa, interval=args.audit_interval,
+                self.kube_gated, self.opa, interval=args.audit_interval,
                 constraint_violations_limit=args.constraint_violations_limit,
                 audit_from_cache=str(args.audit_from_cache).lower() == "true",
                 incremental=str(getattr(args, "audit_incremental",
                                         "false")).lower() == "true",
                 full_resync_every=getattr(args, "audit_full_resync_every",
                                           DEFAULT_FULL_RESYNC_EVERY),
-                write_breaker=self.write_breaker)
+                write_breaker=self.write_breaker,
+                leader_check=(None if self.elector is None
+                              else lambda: self.elector.is_leader))
         self.webhook = None
         self.cert_rotator = None
         if "webhook" in operations or "mutation-webhook" in operations:
@@ -277,6 +364,125 @@ class Runtime:
         self.metrics_server = None
         self.health = None
         self._ready = False
+        # durable state snapshots (--state-dir): restore on boot (cold
+        # fallback on any corruption), snapshot periodically / on
+        # SIGTERM drain / on SIGHUP
+        self.statestore = None
+        self.snapshots = None
+        self._build_statestore()
+        self._restore_state()
+
+    # ---------------------------------------------------- durable state
+
+    def _build_statestore(self) -> None:
+        state_dir = getattr(self.args, "state_dir", "") or ""
+        if not state_dir:
+            return
+        from . import statestore as ss
+        try:
+            self.statestore = ss.StateStore(state_dir)
+        except OSError as e:
+            log.warning("state dir unusable; snapshots disabled",
+                        details={"dir": state_dir, "error": str(e)})
+            return
+        providers, blobs = self._snapshot_providers()
+        self.snapshots = ss.SnapshotManager(
+            self.statestore, providers, blob_providers=blobs,
+            interval_s=getattr(self.args, "snapshot_interval", 60.0),
+            # the inventory payload is plain containers by construction
+            # (_deep_plain); marshal loads ~2x faster than pickle and
+            # restore latency is the warm boot
+            blob_codecs={"inventory": "marshal"})
+
+    def _snapshot_providers(self) -> tuple:
+        driver = getattr(self.opa, "driver", None)
+        providers = {}
+        if hasattr(driver, "vocab_snapshot"):
+            providers["vocab"] = driver.vocab_snapshot
+
+        def library():
+            snap = self.opa.snapshot_library()
+            if self.mutation_system is not None:
+                snap["mutators"] = self.mutation_system.sources()
+            return snap
+
+        providers["library"] = library
+        blobs = {}
+        if self.audit is not None and self.audit.incremental:
+            # the inventory rides the BLOB (pickle) path: the frozen
+            # in-memory tree round-trips without the O(cluster)
+            # re-freeze a JSON restore would pay
+            def inventory():
+                tracker = self.audit.snapshot_state()
+                if tracker is None:
+                    return None  # no sweep yet: nothing worth saving
+                tree = None
+                if hasattr(driver, "inventory_snapshot"):
+                    tree = driver.inventory_snapshot()
+                return {"tree": tree or {}, "tracker": tracker}
+
+            blobs["inventory"] = inventory
+            if hasattr(driver, "encoded_rows_snapshot"):
+                blobs["rows"] = driver.encoded_rows_snapshot
+        return providers, blobs
+
+    def _restore_state(self) -> None:
+        if self.statestore is None:
+            return
+        from .statestore import restore_section
+        driver = getattr(self.opa, "driver", None)
+        vocab_ok = False
+        if hasattr(driver, "vocab_restore"):
+            # vocab FIRST: restored encoded rows hold interned ids, and
+            # library re-ingestion interns — the append-only table must
+            # replay before anything else touches it
+            vocab_ok = restore_section(self.statestore, "vocab",
+                                       driver.vocab_restore)
+
+        def apply_library(snap):
+            out = self.opa.restore_library(snap)
+            if self.mutation_system is not None:
+                for m in snap.get("mutators") or []:
+                    try:
+                        self.mutation_system.upsert(m)
+                    except Exception:
+                        out["errors"] = out.get("errors", 0) + 1
+            log.info("library restored", details=out)
+
+        restore_section(self.statestore, "library", apply_library)
+        if self.audit is not None and self.audit.incremental:
+            def apply_inventory(snap):
+                n = 0
+                if hasattr(driver, "inventory_restore"):
+                    n = driver.inventory_restore(snap.get("tree") or {})
+                self.audit.restore_state(snap.get("tracker") or {})
+                log.info("inventory restored; watches resume from "
+                         "persisted resourceVersions",
+                         details={"objects": n})
+
+            if restore_section(self.statestore, "inventory",
+                               apply_inventory, blob=True) and vocab_ok \
+                    and hasattr(driver, "encoded_rows_restore"):
+                # encoded rows are a first-audit optimization, not a
+                # readiness dependency: load them OFF the boot path.
+                # The staleness-guard generation is pinned HERE (before
+                # the thread starts) so a delta applied while the blob
+                # loads invalidates the stash; adoption also requires a
+                # cand match, so a racing sweep just re-extracts.
+                driver.mark_rows_restore_base()
+                threading.Thread(
+                    target=lambda: restore_section(
+                        self.statestore, "rows",
+                        driver.encoded_rows_restore, blob=True),
+                    name="rows-restore", daemon=True).start()
+
+    def snapshot_now(self) -> None:
+        """Force an immediate snapshot (SIGHUP): runs off-thread, safe
+        from a signal context; save_now serializes concurrent passes."""
+        if self.snapshots is None:
+            return
+        threading.Thread(target=self.snapshots.save_now,
+                         name="snapshot-now", daemon=True).start()
 
     def _register_builtin_kinds(self) -> None:
         for gvk, namespaced in [
@@ -298,6 +504,7 @@ class Runtime:
             (("mutations.gatekeeper.sh", "v1alpha1", "AssignMetadata"),
              False),
             (("mutations.gatekeeper.sh", "v1alpha1", "ModifySet"), False),
+            (("coordination.k8s.io", "v1", "Lease"), True),
         ]:
             self.kube.register_kind(gvk, namespaced=namespaced)
 
@@ -345,6 +552,19 @@ class Runtime:
                 if self.audit:
                     self.health.add_liveness("audit-loop",
                                              self.audit.healthy)
+                if self.audit and self.statestore is not None:
+                    # warm restart: hold readiness until restored state
+                    # has been re-validated against a live list (a cold
+                    # or non-restored boot passes trivially)
+                    self.health.add_readiness("state-restore",
+                                              self.audit.restore_ready)
+                if self.elector is not None:
+                    # a dead elector loop means leadership can silently
+                    # never arrive (or never lapse); surface it. NOT
+                    # being leader is a healthy state — followers stay
+                    # Ready and serve admission.
+                    self.health.add_readiness("leader-elector",
+                                              self.elector.healthy)
                 self.health.start()
             except OSError as e:
                 log.warning("health port unavailable", details=str(e))
@@ -353,6 +573,8 @@ class Runtime:
             # crash-loop the deployment with no hint in the logs
             log.warning("--health-addr not understood; health endpoints "
                         "disabled", details={"health_addr": health_addr})
+        if self.elector is not None:
+            self.elector.start()
         self.upgrade.upgrade()
         self.manager.start()
         if self.audit:
@@ -361,6 +583,8 @@ class Runtime:
             self.cert_rotator.start(watch_manager=self.manager.wm)
         if self.webhook:
             self.webhook.start()
+        if self.snapshots is not None:
+            self.snapshots.start()
         self._ready = True
         # long-lived-server GC tuning: everything built so far (engine,
         # policy caches, codegen closures) is effectively permanent;
@@ -374,10 +598,22 @@ class Runtime:
 
     def stop(self) -> None:
         self._ready = False
+        if self.elector is not None:
+            # graceful lease release FIRST: the surviving replica takes
+            # over immediately instead of waiting out the lease duration
+            self.elector.stop()
         if self.webhook:
             self.webhook.stop()
         if self.audit:
             self.audit.stop()
+        if self.snapshots is not None:
+            # SIGTERM drain snapshot: the replacement pod warm-boots
+            # from state at most seconds old
+            self.snapshots.stop()
+            try:
+                self.snapshots.save_now()
+            except Exception as e:
+                log.error("drain snapshot failed", details=str(e))
         if self.cert_rotator:
             self.cert_rotator.stop()
         self.manager.stop()
@@ -399,6 +635,11 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, handle_signal)
     signal.signal(signal.SIGINT, handle_signal)
+    if hasattr(signal, "SIGHUP"):
+        # operator escape hatch: force an immediate state snapshot
+        # (e.g. right before a node drain) without restarting
+        signal.signal(signal.SIGHUP,
+                      lambda *_: runtime.snapshot_now())
     runtime.start()
     stop.wait()
     runtime.stop()

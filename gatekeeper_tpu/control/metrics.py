@@ -362,6 +362,39 @@ def report_audit_status_writes(written: int, skipped: int) -> None:
                          skipped, result="skipped")
 
 
+def report_snapshot(op: str, outcome: str) -> None:
+    """One state-snapshot save or restore by outcome. Save outcomes:
+    ok | error (previous snapshot kept). Restore outcomes: ok | missing
+    (no snapshot; plain cold start) | fallback (snapshot present but
+    corrupt/stale/unusable — the pod proceeds down the cold path, never
+    crash-loops)."""
+    name = ("gatekeeper_tpu_snapshot_save_total" if op == "save"
+            else "gatekeeper_tpu_snapshot_restore_total")
+    REGISTRY.counter_add(name, f"State snapshot {op}s by outcome",
+                         outcome=outcome)
+
+
+def report_snapshot_age(seconds: float) -> None:
+    REGISTRY.gauge_set("gatekeeper_tpu_snapshot_age_seconds",
+                       "Age of the newest durable state snapshot (0 "
+                       "right after a save; restart data-loss window is "
+                       "bounded by this)", seconds)
+
+
+def report_leader(is_leader: bool) -> None:
+    """Leader-election state: both label series are kept so a flip is a
+    visible edge on each (alerting on sum(gatekeeper_tpu_leader{
+    is_leader=\"true\"}) != 1 catches split/no leader)."""
+    REGISTRY.gauge_set("gatekeeper_tpu_leader",
+                       "1 when this replica holds the leader lease "
+                       "(audit sweep + status writers run here)",
+                       1 if is_leader else 0, is_leader="true")
+    REGISTRY.gauge_set("gatekeeper_tpu_leader",
+                       "1 when this replica holds the leader lease "
+                       "(audit sweep + status writers run here)",
+                       0 if is_leader else 1, is_leader="false")
+
+
 def report_watch_manager(gvk_count: int, intended: int) -> None:
     REGISTRY.gauge_set("watch_manager_watched_gvk",
                        "Total number of watched GroupVersionKinds",

@@ -51,6 +51,18 @@ class BreakerOpen(KubeError):
         super().__init__(message, code=503)
 
 
+class NotLeader(KubeError):
+    """Write refused locally: this replica does not hold the leader
+    lease (no API call made). Raised by a GuardedKube whose write_gate
+    says no — a deposed leader's in-flight status writes abort here
+    instead of racing the new leader's writes. Status writers treat it
+    like a breaker refusal: return immediately, the next sweep/reconcile
+    on the actual leader re-issues the write."""
+
+    def __init__(self, message: str):
+        super().__init__(message, code=409)
+
+
 class CircuitBreaker:
     """Consecutive-failure breaker with half-open probing."""
 
@@ -247,7 +259,7 @@ def guarded_status_update(kube, obj: dict, refresh: Callable,
             return True
         except NotFound:
             return False
-        except BreakerOpen:
+        except (BreakerOpen, NotLeader):
             return False
         except Conflict:
             pass  # resourceVersion raced another writer: refresh below
@@ -267,16 +279,26 @@ class GuardedKube:
     FakeKube extras like register_kind/calls) delegates untouched."""
 
     def __init__(self, inner, breaker: Optional[CircuitBreaker] = None,
-                 budget: Optional[RetryBudget] = None, attempts: int = 4):
+                 budget: Optional[RetryBudget] = None, attempts: int = 4,
+                 write_gate: Optional[Callable[[], bool]] = None):
         self.inner = inner
         self.breaker = breaker
         self.budget = budget
         self.attempts = attempts
+        # leadership fence: when set and False, mutating verbs raise
+        # NotLeader BEFORE any API call — a deposed leader's in-flight
+        # status writes abort at the proxy instead of racing the new
+        # leader (wired to LeaseElector.is_leader by Runtime)
+        self.write_gate = write_gate
 
     def __getattr__(self, name):
         return getattr(self.inner, name)
 
     def _guard(self, verb: str, fn: Callable):
+        if self.write_gate is not None and not self.write_gate():
+            metrics.report_kube_write("not_leader")
+            raise NotLeader(f"kube {verb} refused: not the leader")
+
         def call():
             try:
                 faults.fire("kube.write", verb=verb)
@@ -301,9 +323,12 @@ class GuardedKube:
         return self._guard("delete",
                            lambda: self.inner.delete(gvk, name, namespace))
 
-    def watch(self, gvk, callback, send_initial: bool = True):
+    def watch(self, gvk, callback, send_initial: bool = True,
+              resource_version: str = "", on_gap=None):
         try:
             faults.fire("kube.watch", gvk=tuple(gvk))
         except faults.FaultError as e:
             raise KubeError(str(e), code=e.code(500)) from None
-        return self.inner.watch(gvk, callback, send_initial=send_initial)
+        return self.inner.watch(gvk, callback, send_initial=send_initial,
+                                resource_version=resource_version,
+                                on_gap=on_gap)

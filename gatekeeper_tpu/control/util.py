@@ -49,12 +49,32 @@ def unpack_request(token: str) -> tuple[tuple, str, str]:
 
 # ---------------------------------------------------------------- pod info
 
+# explicit identity overrides (--pod-name/--pod-namespace flags): byPod
+# statuses must carry the STABLE downward-API pod identity, not whatever
+# hostname the process happens to see — a replaced pod then overwrites
+# its own status slot instead of accumulating one per restart
+_POD_NAME_OVERRIDE: Optional[str] = None
+_POD_NAMESPACE_OVERRIDE: Optional[str] = None
+
+
+def set_pod_identity(name: Optional[str] = None,
+                     namespace: Optional[str] = None) -> None:
+    global _POD_NAME_OVERRIDE, _POD_NAMESPACE_OVERRIDE
+    if name:
+        _POD_NAME_OVERRIDE = name
+    if namespace:
+        _POD_NAMESPACE_OVERRIDE = namespace
+
 
 def pod_name() -> str:
+    if _POD_NAME_OVERRIDE:
+        return _POD_NAME_OVERRIDE
     return os.environ.get("POD_NAME", os.environ.get("HOSTNAME", "gatekeeper"))
 
 
 def pod_namespace() -> str:
+    if _POD_NAMESPACE_OVERRIDE:
+        return _POD_NAMESPACE_OVERRIDE
     return os.environ.get("POD_NAMESPACE", "gatekeeper-system")
 
 
@@ -87,6 +107,21 @@ def delete_by_pod_status(obj: dict) -> None:
     by_pod = [e for e in status.get("byPod") or []
               if not (isinstance(e, dict) and e.get("id") == pod_name())]
     status["byPod"] = by_pod
+
+
+def prune_stale_by_pod(obj: dict, live_ids: set) -> bool:
+    """Drop byPod entries whose pod id is not in `live_ids` (pods that
+    no longer exist — their statuses must be garbage-collected, not
+    accumulate forever as replicas churn). Returns True when any entry
+    was pruned (the caller must write the status back)."""
+    status = obj.get("status") or {}
+    by_pod = status.get("byPod") or []
+    kept = [e for e in by_pod
+            if not isinstance(e, dict) or e.get("id") in live_ids]
+    if len(kept) == len(by_pod):
+        return False
+    obj.setdefault("status", {})["byPod"] = kept
+    return True
 
 
 def by_pod_status_unchanged(obj: dict, entry: dict) -> bool:

@@ -621,6 +621,91 @@ class TpuDriver(RegoDriver):
         self._audit_results_cache.clear()
         self._review_idx_cache = (None, None, None)
 
+    # --------------------------------------------- warm-restart snapshots
+
+    def vocab_snapshot(self) -> dict:
+        """The intern table, for the durable state snapshot. Restoring
+        it on boot keeps string ids — and the vocab-capacity buckets
+        XLA program shapes are specialized on — identical across
+        restarts, so both the persisted encoded rows and the persistent
+        compilation cache stay valid."""
+        return {"strings": self.strtab.dump()}
+
+    def vocab_restore(self, snap: dict) -> None:
+        """Replay a vocab snapshot onto this driver's FRESH strtab
+        (boot-time only; StringTable.restore refuses otherwise)."""
+        self.strtab.restore(snap.get("strings") or [])
+
+    def encoded_rows_snapshot(self) -> Optional[dict]:
+        """Per-kind encoded feature tensors whose cache provably matches
+        the current data tree (meta rev == data rev: no unapplied
+        journal entries). Restored rows let the first warm audit skip
+        re-extraction entirely. None when nothing is current."""
+        out = {}
+        for kind, fcache in self._feat_cache.items():
+            meta = fcache.get("__meta__")
+            if meta is None or meta.get("cand") is None:
+                continue
+            if meta.get("rev") != self._data_rev:
+                continue  # stale vs the tree; next audit refreshes it
+            out[kind] = {"feats": meta["feats"], "cand": meta["cand"],
+                         "buckets": meta["buckets"],
+                         "n_pad": meta["n_pad"]}
+        return out or None
+
+    def mark_rows_restore_base(self) -> None:
+        """Pin the no-writes-since-restore generation NOW (called
+        synchronously right after inventory_restore, BEFORE the rows
+        blob loads on a background thread): a delta applied while the
+        blob is still loading must invalidate the stashed rows, so the
+        guard generation cannot be captured at load-completion time."""
+        self._restored_rows_base = self._data_gen
+
+    def encoded_rows_restore(self, rows: dict) -> None:
+        """Stash snapshotted feature tensors for lazy adoption: the
+        first audit adopts a kind's rows iff its freshly-computed
+        candidate set matches the snapshot AND no inventory write
+        happened since the restore BASE (mark_rows_restore_base, or
+        now for synchronous callers — any delta means the rows may be
+        stale; extraction rebuilds them, the safe cold path). Requires
+        the vocab snapshot to have been restored first: the tensors
+        hold interned string ids."""
+        self._restored_rows = dict(rows or {})
+        base = getattr(self, "_restored_rows_base", None)
+        self._restored_rows_gen = \
+            base if base is not None else self._data_gen
+        self.restored_rows_adopted = 0
+
+    def _adopt_restored_rows(self, kind: str, cand, feat_key,
+                             fcache: dict):
+        stash_all = getattr(self, "_restored_rows", None)
+        if not stash_all or cand is None:
+            return None
+        if self._data_gen != getattr(self, "_restored_rows_gen", -1):
+            # inventory changed since restore: every stashed kind is
+            # suspect — drop the lot and let extraction rebuild
+            self._restored_rows = {}
+            return None
+        stash = stash_all.pop(kind, None)
+        if stash is None:
+            return None
+        try:
+            if not np.array_equal(np.asarray(stash["cand"]),
+                                  np.asarray(cand)):
+                return None  # constraints/inventory drifted: re-extract
+            feats = stash["feats"]
+        except Exception:
+            return None
+        fcache.clear()
+        fcache["__meta__"] = {
+            "key": feat_key, "feats": feats,
+            "cand": np.asarray(cand), "buckets": stash["buckets"],
+            "n_pad": stash["n_pad"], "rev": self._data_rev,
+        }
+        self.restored_rows_adopted = \
+            getattr(self, "restored_rows_adopted", 0) + 1
+        return feats
+
     def _bump(self, path: tuple) -> None:
         if path and path[0] == "constraints":
             self._constraint_gen += 1
@@ -1345,6 +1430,11 @@ class TpuDriver(RegoDriver):
                 if feats is not None:
                     meta["key"] = feat_key
                     meta["rev"] = self._data_rev
+            elif meta is None:
+                # warm restart: adopt snapshotted rows when the
+                # candidate set still matches (statestore restore path)
+                feats = self._adopt_restored_rows(kind, cand, feat_key,
+                                                  fcache)
         if feats is None:
             feats, buckets, n_pad = extract_batch(ct.program, self.strtab,
                                                   reviews)

@@ -131,6 +131,14 @@ class MutationSystem:
         with self._lock:
             return [self._mutators[k] for k in sorted(self._mutators)]
 
+    def sources(self) -> list[dict]:
+        """Raw CRs of every cached mutator in id order, for the
+        warm-restart library snapshot (restore replays them through
+        upsert, re-running validation and conflict detection)."""
+        with self._lock:
+            return [copy.deepcopy(self._mutators[k].obj)
+                    for k in sorted(self._mutators)]
+
     def active(self) -> list[Mutator]:
         """Appliable mutators in deterministic id order (quarantined
         ones excluded). O(1): returns the cached snapshot — do not

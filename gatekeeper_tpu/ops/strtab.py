@@ -103,6 +103,26 @@ class StringTable:
     def string(self, i: int) -> str:
         return self._strs[i]
 
+    def dump(self) -> list[str]:
+        """All interned strings in id order (excluding the pad entry) —
+        the warm-restart vocab snapshot. Restoring this list on a fresh
+        table reproduces the exact id assignment, so persisted encoded
+        rows (which hold int32 ids) and vocab-capacity-bucketed program
+        shapes stay valid across process restarts."""
+        return list(self._strs[1:])
+
+    def restore(self, strings: Iterable[str]) -> None:
+        """Re-intern a dump() onto a FRESH table. Refuses on a table
+        that already interned anything: ids are append-only and already
+        handed out, so replaying an old vocab underneath them would
+        silently remap every existing id."""
+        if len(self._strs) != 1:
+            raise ValueError("vocab restore requires a fresh StringTable")
+        for s in strings:
+            if not isinstance(s, str):
+                raise ValueError("vocab snapshot entries must be strings")
+            self.intern(s)
+
     def bytes_tensor(self, max_len: int = 128) -> np.ndarray:
         """[V, max_len] uint8, zero-padded — the device-side vocab for
         NFA scans (ops/regex_nfa.py)."""

@@ -17,12 +17,24 @@ Spec syntax (env/flag), comma-separated:
     kube.watch:error                watch subscriptions fail (poll path)
     eval.device:raise               device eval raises (quarantine path)
     webhook.flush:sleep:2           each micro-batch flush stalls 2s
+    state.snapshot:corrupt          snapshot files corrupt on disk
+    state.snapshot:truncate#1       one snapshot file torn mid-write
+    kube.lease:steal                leader lease stolen by a rival
+    kube.lease:expire               leader misses renews; lease lapses
 
-Injection points in the tree (grep for faults.fire):
+Injection points in the tree (grep for faults.fire / faults.consume):
     kube.write     control/resilience.py  GuardedKube mutating verbs
     kube.watch     control/resilience.py  GuardedKube.watch subscribe
     eval.device    ir/driver.py           compiled-template device eval
     webhook.flush  control/webhook.py     MicroBatcher._flush entry
+    state.snapshot control/statestore.py  snapshot save/load (modes:
+                   io-error -> the I/O call raises; truncate/corrupt ->
+                   the on-disk file is torn / bit-flipped so the next
+                   restore must fall back to the cold path)
+    kube.lease     control/kube.py        LeaseElector tick (modes:
+                   steal -> a rival identity takes the lease; expire ->
+                   our renews stop landing and the lease lapses;
+                   error -> the renew API call fails)
 """
 
 from __future__ import annotations
@@ -168,6 +180,32 @@ class FaultInjector:
         if exc is not None:
             raise exc
 
+    def consume(self, point: str, **ctx: Any) -> Optional[tuple]:
+        """Site-interpreted firing: instead of raising, return the armed
+        `(mode, param)` for the caller to act on (file corruption, lease
+        theft — behaviors only the call site can simulate), or None when
+        nothing is armed. Respects rate/count/match and increments the
+        fire counter exactly like fire()."""
+        if not self._specs:
+            return None
+        with self._lock:
+            spec = self._specs.get(point)
+            if spec is None:
+                return None
+            if spec.match and any(ctx.get(k) != v
+                                  for k, v in spec.match.items()):
+                return None
+            if spec.rate < 1.0 and random.random() >= spec.rate:
+                return None
+            if spec.count is not None:
+                if spec.count <= 0:
+                    return None
+                spec.count -= 1
+                if spec.count == 0:
+                    self._specs.pop(point, None)
+            self._fired[point] = self._fired.get(point, 0) + 1
+            return (spec.mode, spec.param)
+
 
 FAULTS = FaultInjector()
 
@@ -178,3 +216,7 @@ if _env_spec:
 
 def fire(point: str, **ctx: Any) -> None:
     FAULTS.fire(point, **ctx)
+
+
+def consume(point: str, **ctx: Any) -> Optional[tuple]:
+    return FAULTS.consume(point, **ctx)

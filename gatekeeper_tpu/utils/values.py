@@ -49,6 +49,13 @@ class FrozenDict(dict):
     setdefault = _immutable
     update = _immutable
 
+    def __reduce__(self):
+        # dict subclasses normally pickle by reconstruct-then-setitem,
+        # which the immutability guard rejects; rebuild through the
+        # constructor instead (the state-snapshot blob path pickles
+        # whole frozen inventory trees)
+        return (FrozenDict, (dict(self),))
+
 
 def freeze(v: Any) -> Any:
     """Deep-freeze a JSON-ish Python value into the Rego value model."""
