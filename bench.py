@@ -253,6 +253,10 @@ def main() -> None:
         # mutating-admission headline (config 7): one micro-batch's
         # batched mutate pass at the largest mutator-library size
         "mutate_s": (configs.get("7") or {}).get("mutate_s"),
+        # serving-plane headline (config 5): best open-loop HTTP rate
+        # meeting the p99<100ms SLO across the pre-forked frontend
+        # worker counts (the --admission-workers topology)
+        "admission_rps": (configs.get("5") or {}).get("admission_rps"),
         # warm-restart headline (config 9): restore-snapshots
         # time-to-ready vs the cold full list/encode boot
         "warm_boot_s": (configs.get("9") or {}).get("value"),

@@ -76,6 +76,23 @@ class Client:
         self.allowed_data_fields = allowed_data_fields
         self._lock = threading.RLock()
         self._templates: dict[str, _TemplateEntry] = {}  # by Kind
+        # library generation: bumped whenever anything a review's verdict
+        # can depend on changes (templates, constraints, synced data).
+        # The admission decision cache keys on it, so a template or
+        # constraint update invalidates every cached decision at once
+        # without an explicit flush. Semantic-equal dedupes do NOT bump —
+        # a level-triggered controller replaying identical CRs must not
+        # cold the cache.
+        self._generation = 0
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def _bump_generation(self) -> None:
+        with self._lock:
+            self._generation += 1
 
     def init(self) -> None:
         self.driver.init()
@@ -147,6 +164,7 @@ class Client:
                 entry.constraints = cached.constraints
             self._templates[ct.kind] = entry
             resp.handled[handler.get_name()] = True
+            self._generation += 1
         return resp
 
     def remove_template(self, templ: Union[dict, ConstraintTemplate]) -> Responses:
@@ -163,6 +181,7 @@ class Client:
                     ("constraints", target, "cluster", CONSTRAINT_GROUP, ct.kind)
                 )
                 resp.handled[target] = True
+            self._generation += 1
         return resp
 
     def get_template(self, kind_or_templ: Union[str, dict, ConstraintTemplate]
@@ -227,6 +246,7 @@ class Client:
                     errs[target] = e
             if not errs:
                 entry.constraints[name] = copy.deepcopy(constraint)
+                self._generation += 1
         if errs:
             raise ClientError(str(errs))
         return resp
@@ -240,6 +260,7 @@ class Client:
                 self.driver.delete_data(self._constraint_path(target, constraint))
                 resp.handled[target] = True
             entry.constraints.pop(name, None)
+            self._generation += 1
         return resp
 
     def get_constraint(self, kind: str, name: str) -> dict:
@@ -285,6 +306,11 @@ class Client:
                 resp.handled[name] = True
             except Exception as e:
                 errs[name] = e
+        if resp.handled:
+            # synced inventory feeds referential policies: a data change
+            # can flip a cached verdict, so it invalidates like a
+            # constraint change (clusters without sync never pay this)
+            self._bump_generation()
         if errs:
             raise ClientError(str(errs))
         return resp
@@ -305,6 +331,8 @@ class Client:
                 resp.handled[name] = True
             except Exception as e:
                 errs[name] = e
+        if resp.handled:
+            self._bump_generation()
         if errs:
             raise ClientError(str(errs))
         return resp
@@ -424,6 +452,7 @@ class Client:
                 for target in entry.targets:
                     self.driver.delete_modules(self._module_prefix(target, kind))
             self._templates = {}
+            self._generation += 1
 
     def snapshot_library(self) -> dict:
         """Raw SOURCES of every ingested template and constraint, for

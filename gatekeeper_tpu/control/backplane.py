@@ -1,0 +1,1005 @@
+"""Admission serving plane: pre-fork HTTP frontends over a shared
+batching backplane.
+
+The single-process webhook frontend is GIL-bound: BENCH_r05 config 5
+showed the engine sustaining ~6,000 batched reviews/s while one Python
+HTTP frontend delivered ~500 req/s. The reference line scales its Go
+webhook by replicating pods behind a Service; the TPU-native analogue
+must keep ONE device-owning engine so micro-batches stay full. So the
+plane splits:
+
+    API server ──TLS──► frontend 0 ─┐
+    API server ──TLS──► frontend 1 ─┼─UDS─► engine (JAX + Client +
+    API server ──TLS──► frontend N ─┘        MicroBatcher + handlers)
+
+N pre-forked frontend processes (one GIL each) bind the webhook port
+with SO_REUSEPORT and do ONLY accept / TLS / header parse; the request
+body rides the backplane as opaque bytes — frontends never JSON-decode
+a review. The engine decodes once, submits into the SHARED MicroBatcher
+(requests from all workers coalesce into the same device micro-batch:
+cross-worker batching is the point — N trickles become one full batch),
+and answers with preserialized envelope bytes the frontend writes
+straight to its HTTP socket.
+
+Wire protocol, length-prefixed frames over a Unix domain socket
+(multiplexed: many in-flight requests per frontend connection):
+
+    frame    := u32be payload_len, payload
+    payload  := type(1 byte) + body
+    'Q'      := id u32be, timeout_s f64be (0 = absent), path_len u16be,
+                path bytes, review bytes            (frontend -> engine)
+    'R'      := id u32be, http_status u16be, body   (engine -> frontend)
+    'H'      := hello JSON {"worker": id}           (frontend -> engine)
+    'S'      := stats JSON (aggregated forward-latency histogram delta
+                + failure-stance answer count)      (frontend -> engine)
+
+Resilience contract across the split:
+  * deadlines propagate — the frame carries the request's timeout and
+    the engine pins the absolute deadline AT FRAME RECEIPT, so executor
+    queueing spends the request's budget, not a fresh one;
+  * frontends answer per the fail-open/closed stance when the engine is
+    unreachable or a verdict never lands (fault point
+    `backplane.engine` arms that path for chaos runs);
+  * shed accounting stays ENGINE-side (`--admission-max-queue` bounds
+    the one shared batcher), so the bound is global, not per-worker;
+  * SIGTERM drains frontends BEFORE the engine: the supervisor TERMs
+    its children (each stops accepting, finishes in-flight HTTP
+    requests), then the engine drains the shared batcher.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils import faults
+from . import jsonio
+from .logging import logger
+from .webhook import (
+    DEFAULT_WEBHOOK_TIMEOUT_S,
+    MAX_WEBHOOK_TIMEOUT_S,
+    encode_envelope,
+    parse_timeout_query,
+    request_deadline,
+    route_path,
+)
+
+log = logger("backplane")
+
+_Q_HEADER = struct.Struct("!Id")   # request id, timeout seconds
+_Q_PATHLEN = struct.Struct("!H")
+_R_HEADER = struct.Struct("!IH")   # request id, http status
+
+# frontends bucket forward latencies with the same bounds the engine
+# registry renders — one constant, no drift into mislabeled buckets
+from .metrics import FORWARD_BUCKETS as STATS_BUCKETS  # noqa: E402
+
+STATS_INTERVAL_S = 2.0
+# per-operation socket timeout on backplane I/O: a WEDGED (not dead)
+# peer must unblock senders so frontends can answer per the failure
+# stance instead of hanging HTTP threads past their deadlines
+IO_TIMEOUT_S = 2.0
+
+
+class BackplaneError(Exception):
+    """The engine could not be reached / the verdict never arrived —
+    the frontend answers per the failure stance."""
+
+
+def default_socket_path() -> str:
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(),
+                        f"gatekeeper-tpu-backplane-{os.getpid()}.sock")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly n bytes. A socket TIMEOUT retries without losing
+    the partial buffer (sockets carry a per-operation timeout so a
+    wedged peer unblocks SENDERS; an idle reader just waits on)."""
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except TimeoutError:
+            continue
+        if not chunk:
+            raise ConnectionError("backplane peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, lock: threading.Lock,
+                *parts: bytes) -> None:
+    payload = b"".join(parts)
+    msg = struct.pack("!I", len(payload)) + payload
+    with lock:
+        sock.sendall(msg)
+
+
+# ----------------------------------------------------------------- engine
+
+
+class BackplaneEngine:
+    """The engine-side listener: owns the handlers (and through them the
+    one shared MicroBatcher), decodes each forwarded review once, and
+    answers with preserialized envelope bytes."""
+
+    def __init__(self, socket_path: str, validation=None, ns_label=None,
+                 mutation=None, max_workers: int = 128,
+                 default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S):
+        self.socket_path = socket_path
+        self.validation = validation
+        self.ns_label = ns_label
+        self.mutation = mutation
+        self.default_timeout = default_timeout
+        self._max_workers = max_workers
+        self._listener: Optional[socket.socket] = None
+        self._pool = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._conns: dict[int, tuple] = {}  # fd -> (sock, wlock, worker)
+        self._conns_lock = threading.Lock()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self.configured_workers = 0  # set by the Runtime for the gauge
+
+    # lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        self._pool = ThreadPoolExecutor(
+            max_workers=self._max_workers,
+            thread_name_prefix="backplane-serve")
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(64)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="backplane-accept", daemon=True)
+        self._accept_thread.start()
+        log.info("backplane engine listening",
+                 details={"socket": self.socket_path})
+
+    def alive(self) -> bool:
+        t = self._accept_thread
+        return bool(t and t.is_alive()) and not self._stop.is_set()
+
+    @property
+    def connected(self) -> int:
+        with self._conns_lock:
+            return len(self._conns)
+
+    def abort(self) -> None:
+        """Drop dead NOW — no drain, no batcher teardown. The chaos
+        suite uses this to emulate an engine crash (kill -9) under a
+        live burst: every frontend's in-flight forward fails over to
+        the failure-stance answer."""
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for sock, _wlock, _worker in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def stop(self, drain_timeout: float = 10.0) -> None:
+        """Called AFTER the frontends drained: no new frames arrive, so
+        finish the in-flight verdicts, drain the shared batcher, and
+        tear the listener down."""
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        end = time.monotonic() + drain_timeout
+        while time.monotonic() < end:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.02)
+        for handler in (self.validation, self.mutation):
+            if handler is not None:
+                handler.batcher.drain(max(0.5, end - time.monotonic()))
+                handler.batcher.stop()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+        with self._conns_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for sock, _wlock, _worker in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    # accept / read --------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            # generous per-op timeout: a stuck FRONTEND must not pin an
+            # engine worker thread in sendall forever (the supervisor
+            # respawns it and the dead conn errors out)
+            conn.settimeout(30.0)
+            wlock = threading.Lock()
+            with self._conns_lock:
+                self._conns[conn.fileno()] = (conn, wlock, None)
+            threading.Thread(target=self._read_loop, args=(conn, wlock),
+                             name="backplane-read", daemon=True).start()
+
+    def _read_loop(self, conn: socket.socket, wlock: threading.Lock) -> None:
+        fd = conn.fileno()
+        try:
+            while not self._stop.is_set():
+                (length,) = struct.unpack("!I", _recv_exact(conn, 4))
+                payload = _recv_exact(conn, length)
+                kind = payload[:1]
+                if kind == b"Q":
+                    rid, timeout_s = _Q_HEADER.unpack_from(payload, 1)
+                    off = 1 + _Q_HEADER.size
+                    (plen,) = _Q_PATHLEN.unpack_from(payload, off)
+                    off += _Q_PATHLEN.size
+                    path = payload[off:off + plen].decode("ascii", "replace")
+                    body = payload[off + plen:]
+                    # deadline pinned HERE: queueing ahead of the serve
+                    # call spends the request's own budget
+                    deadline = request_deadline(
+                        {"timeoutSeconds": timeout_s} if timeout_s > 0
+                        else {}, self.default_timeout)
+                    # fast path: decision-cache hits, short-circuits,
+                    # and the namespace-label check are answered INLINE
+                    # — no thread handoff on the hot path. Only
+                    # requests that must evaluate take the pool (which
+                    # reuses the already-parsed review).
+                    try:
+                        inline = self._try_inline(timeout_s, deadline,
+                                                  path, body)
+                    except Exception as e:
+                        log.error("backplane inline serve error",
+                                  details=str(e))
+                        inline = (500, b"")
+                    if inline[0] != "eval":
+                        # a failed/partial send desyncs the stream:
+                        # close and let the frontend reconnect
+                        _send_frame(conn, wlock, b"R",
+                                    _R_HEADER.pack(rid, inline[0]),
+                                    inline[1])
+                        continue
+                    with self._inflight_lock:
+                        self._inflight += 1
+                    self._pool.submit(self._serve, conn, wlock, rid,
+                                      timeout_s, deadline, path, body,
+                                      inline[1])
+                elif kind == b"H":
+                    info = jsonio.loads(payload[1:]) or {}
+                    worker = str(info.get("worker", "?"))
+                    with self._conns_lock:
+                        if fd in self._conns:
+                            self._conns[fd] = (conn, wlock, worker)
+                    self._report_workers()
+                    log.info("frontend connected",
+                             details={"worker": worker})
+                elif kind == b"S":
+                    self._merge_stats(jsonio.loads(payload[1:]) or {})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.pop(fd, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if not self._stop.is_set():
+                self._report_workers()
+
+    def _report_workers(self) -> None:
+        from . import metrics
+
+        metrics.report_admission_workers(self.configured_workers,
+                                         self.connected)
+
+    def _merge_stats(self, stats: dict) -> None:
+        from . import metrics
+
+        worker = str(stats.get("worker", "?"))
+        counts = stats.get("buckets") or []
+        n = int(stats.get("count") or 0)
+        if n:
+            metrics.report_backplane_forward(
+                worker, counts, float(stats.get("sum") or 0.0), n)
+        errs = int(stats.get("errors") or 0)
+        if errs:
+            metrics.report_backplane_error(worker, errs)
+
+    # serve ----------------------------------------------------------
+
+    @staticmethod
+    def _fold_timeout(review, timeout_s: float, deadline: float):
+        """Merge the frame's ?timeout= budget into the request and pick
+        the effective deadline: a body-carried timeoutSeconds (tests /
+        direct callers) WINS over the frame's query budget — matching
+        the single-process server — in which case the handler derives
+        the deadline from the body (deadline=None)."""
+        request = (review or {}).get("request") \
+            if isinstance(review, dict) else None
+        if not isinstance(request, dict):
+            return deadline
+        if "timeoutSeconds" in request:
+            return None
+        if timeout_s > 0:
+            request["timeoutSeconds"] = timeout_s
+        return deadline
+
+    def _try_inline(self, timeout_s: float, deadline: float, path: str,
+                    body: bytes) -> tuple:
+        """(status, payload) when the verdict needs no blocking work
+        (cache hit / short-circuit / namespace-label check / 404);
+        ("eval", parsed_review_or_None) hands it to the worker pool."""
+        route = route_path(path)
+        if route == "admitlabel":
+            if self.ns_label is None:
+                return (404, b"")
+            try:
+                review = jsonio.loads(body)
+            except ValueError:
+                return (400, b"")
+            return (200, encode_envelope(self.ns_label.handle(review)))
+        if route == "admit":
+            if self.validation is None:
+                return (404, b"")
+            try:
+                review = jsonio.loads(body)
+            except ValueError:
+                return (400, b"")
+            eff_deadline = self._fold_timeout(review, timeout_s, deadline)
+            out = self.validation.handle(review, deadline=eff_deadline,
+                                         fast=True)
+            if out is None:
+                # cache miss: evaluation needs a thread; hand over the
+                # parsed review AND the folded deadline
+                return ("eval", (review, eff_deadline))
+            return (200, encode_envelope(out))
+        if route == "mutate":
+            return ("eval", None) if self.mutation is not None \
+                else (404, b"")
+        return (404, b"")
+
+    def _serve(self, conn: socket.socket, wlock: threading.Lock,
+               rid: int, timeout_s: float, deadline: float, path: str,
+               body: bytes, handoff=None) -> None:
+        review = None
+        if handoff is not None:
+            review, deadline = handoff
+        try:
+            status, out = self._decide(timeout_s, deadline, path, body,
+                                       review=review)
+            try:
+                _send_frame(conn, wlock, b"R",
+                            _R_HEADER.pack(rid, status), out)
+            except OSError:
+                # frontend died or the send timed out mid-frame — the
+                # stream may be desynced, so close it (the supervisor
+                # respawns the worker, which reconnects clean)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    def _decide(self, timeout_s: float, deadline: float, path: str,
+                body: bytes, review=None) -> tuple[int, bytes]:
+        if review is None:
+            try:
+                review = jsonio.loads(body)
+            except ValueError:
+                return 400, b""
+            deadline = self._fold_timeout(review, timeout_s, deadline)
+        # (a review handed over by _try_inline already has the timeout
+        # folded and the deadline pinned at frame receipt)
+        route = route_path(path)
+        try:
+            if route == "admitlabel" and self.ns_label is not None:
+                out = self.ns_label.handle(review)
+            elif route == "admit" and self.validation is not None:
+                out = self.validation.handle(review, deadline=deadline)
+            elif route == "mutate" and self.mutation is not None:
+                out = self.mutation.handle(review, deadline=deadline)
+            else:
+                return 404, b""
+            return 200, encode_envelope(out)
+        except Exception as e:  # handlers answer their own errors; this
+            # is the backstop for anything outside them
+            log.error("backplane serve error", details=str(e))
+            return 500, b""
+
+
+# ----------------------------------------------------------------- client
+
+
+class _Waiter:
+    __slots__ = ("event", "status", "body")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.status = 0
+        self.body = b""
+
+
+class BackplaneClient:
+    """Frontend-side connection to the engine: one multiplexed UDS
+    socket, a reader thread resolving verdicts by request id. Thread-
+    safe; every HTTP handler thread calls `call()` concurrently."""
+
+    def __init__(self, socket_path: str, worker_id: str = "0",
+                 connect_timeout: float = 1.0):
+        self.socket_path = socket_path
+        self.worker_id = worker_id
+        self.connect_timeout = connect_timeout
+        self._sock: Optional[socket.socket] = None
+        self._wlock = threading.Lock()
+        self._conn_lock = threading.Lock()
+        self._pending: dict[int, _Waiter] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._closed = False
+
+    # connection -----------------------------------------------------
+
+    def _ensure_connected(self) -> socket.socket:
+        sock = self._sock
+        if sock is not None:
+            return sock
+        with self._conn_lock:
+            if self._sock is not None:
+                return self._sock
+            if self._closed:
+                raise BackplaneError("backplane client closed")
+            try:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.settimeout(self.connect_timeout)
+                sock.connect(self.socket_path)
+                # per-op timeout: a wedged engine must unblock sendall
+                # (the reader retries timeouts inside _recv_exact, so
+                # an idle connection never desyncs)
+                sock.settimeout(IO_TIMEOUT_S)
+            except OSError as e:
+                raise BackplaneError(
+                    f"admission engine unreachable: {e}") from e
+            self._sock = sock
+            threading.Thread(target=self._read_loop, args=(sock,),
+                             name="backplane-client-read",
+                             daemon=True).start()
+            try:
+                _send_frame(sock, self._wlock, b"H", jsonio.dumps_bytes(
+                    {"worker": self.worker_id}))
+            except OSError as e:
+                self._drop(sock)
+                raise BackplaneError(
+                    f"admission engine unreachable: {e}") from e
+            return sock
+
+    def _drop(self, sock: socket.socket) -> None:
+        with self._conn_lock:
+            if self._sock is sock:
+                self._sock = None
+        try:
+            sock.close()
+        except OSError:
+            pass
+        # every in-flight request on the dead connection fails NOW —
+        # the frontends answer per the failure stance instead of
+        # letting HTTP callers hang into their own timeouts
+        with self._pending_lock:
+            waiters = list(self._pending.values())
+            self._pending.clear()
+        for w in waiters:
+            w.status = -1
+            w.event.set()
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                (length,) = struct.unpack("!I", _recv_exact(sock, 4))
+                payload = _recv_exact(sock, length)
+                if payload[:1] != b"R":
+                    continue
+                rid, status = _R_HEADER.unpack_from(payload, 1)
+                with self._pending_lock:
+                    waiter = self._pending.pop(rid, None)
+                if waiter is not None:
+                    waiter.status = status
+                    waiter.body = payload[1 + _R_HEADER.size:]
+                    waiter.event.set()
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            self._drop(sock)
+
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def close(self) -> None:
+        self._closed = True
+        sock = self._sock
+        if sock is not None:
+            self._drop(sock)
+
+    # calls ----------------------------------------------------------
+
+    def call(self, path: str, body: bytes, timeout_s: float,
+             deadline: float) -> tuple[int, bytes]:
+        """Forward one review; returns (http_status, response_bytes).
+        Raises BackplaneError when the engine is unreachable, the
+        connection dies mid-flight, or no verdict lands by `deadline`
+        (+ grace) — the caller answers per the failure stance."""
+        try:
+            faults.fire("backplane.engine")
+        except BackplaneError:
+            raise
+        except Exception as e:
+            # an armed raise/error fault means "engine unreachable":
+            # surface it as the typed error so the HTTP handler answers
+            # per the failure stance instead of dropping the socket
+            raise BackplaneError(f"injected engine fault: {e}") from e
+        sock = self._ensure_connected()
+        waiter = _Waiter()
+        with self._pending_lock:
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+            rid = self._next_id
+            self._pending[rid] = waiter
+        try:
+            _send_frame(sock, self._wlock, b"Q",
+                        _Q_HEADER.pack(rid, timeout_s or 0.0),
+                        _Q_PATHLEN.pack(len(path)), path.encode("ascii"),
+                        body)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            self._drop(sock)
+            raise BackplaneError(
+                f"admission engine connection lost: {e}") from e
+        # the engine's own deadline logic answers BEFORE the deadline;
+        # the grace covers frame transit — expiry here means the engine
+        # is gone or wedged
+        if not waiter.event.wait(max(0.0, deadline - time.monotonic())
+                                 + 0.5):
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise BackplaneError("admission engine verdict timed out")
+        if waiter.status < 0:
+            raise BackplaneError("admission engine connection lost")
+        return waiter.status, waiter.body
+
+    def send_stats(self, stats: dict) -> None:
+        sock = self._sock
+        if sock is None:
+            return
+        try:
+            _send_frame(sock, self._wlock, b"S", jsonio.dumps_bytes(stats))
+        except OSError:
+            self._drop(sock)
+
+
+# --------------------------------------------------------------- frontend
+
+
+class _StatsAccumulator:
+    """Forward-latency histogram + failure-stance counter, accumulated
+    locally and shipped to the engine as periodic deltas."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(STATS_BUCKETS) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._errors = 0
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            for i, b in enumerate(STATS_BUCKETS):
+                if seconds <= b:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+            self._sum += seconds
+            self._n += 1
+
+    def error(self) -> None:
+        with self._lock:
+            self._errors += 1
+
+    def drain(self, worker: str) -> Optional[dict]:
+        with self._lock:
+            if not self._n and not self._errors:
+                return None
+            out = {"worker": worker, "buckets": self._counts,
+                   "sum": round(self._sum, 6), "count": self._n,
+                   "errors": self._errors}
+            self._counts = [0] * (len(STATS_BUCKETS) + 1)
+            self._sum = 0.0
+            self._n = 0
+            self._errors = 0
+            return out
+
+
+class FrontendServer:
+    """One pre-forked HTTP frontend: accept + TLS + header parse, then
+    forward the body bytes over the backplane. Never JSON-decodes a
+    review on the hot path (the failure stance parses lazily, only to
+    recover the uid)."""
+
+    def __init__(self, client: BackplaneClient, port: int = 8443,
+                 addr: str = "", certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None, reuse_port: bool = True,
+                 serve: tuple = ("admit", "admitlabel", "mutate"),
+                 fail_closed: bool = False,
+                 mutation_fail_closed: Optional[bool] = None,
+                 default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S,
+                 worker_id: str = "0"):
+        from .webhook import FastHTTPServer
+
+        self.client = client
+        self.serve = frozenset(serve)
+        self.fail_closed = fail_closed
+        self.mutation_fail_closed = (fail_closed if mutation_fail_closed
+                                     is None else mutation_fail_closed)
+        self.default_timeout = default_timeout
+        self.worker_id = worker_id
+        self.stats = _StatsAccumulator()
+        self.http = FastHTTPServer((addr, port), self._dispatch,
+                                   reuse_port=reuse_port,
+                                   certfile=certfile, keyfile=keyfile)
+        self.server = self.http.server
+        self.port = self.http.port
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        name="frontend", daemon=True)
+        self._stats_stop = threading.Event()
+        self._stats_thread = threading.Thread(
+            target=self._stats_loop, name="frontend-stats", daemon=True)
+
+    # request path ---------------------------------------------------
+
+    def _route(self, path: str) -> Optional[str]:
+        route = route_path(path)
+        return route if route in self.serve else None
+
+    def _dispatch(self, path: str, body: bytes) -> tuple:
+        route = self._route(path)
+        if route is None:
+            # un-served endpoints 404 LOCALLY: no backplane hop for an
+            # operation the operator turned off
+            return 404, b""
+        timeout_s = parse_timeout_query(path.partition("?")[2]) or 0.0
+        if timeout_s > 0:
+            deadline = request_deadline({"timeoutSeconds": timeout_s},
+                                        self.default_timeout)
+        else:
+            # no query budget: the frontend cannot see a body-carried
+            # timeoutSeconds without parsing, so its WAIT is only a
+            # backstop at the API server's maximum webhook budget — the
+            # engine enforces the real (possibly longer-than-default)
+            # deadline and answers per stance before it
+            deadline = time.monotonic() + MAX_WEBHOOK_TIMEOUT_S
+        t0 = time.monotonic()
+        try:
+            status, payload = self.client.call(path, body, timeout_s,
+                                               deadline)
+            self.stats.observe(time.monotonic() - t0)
+            return status, payload
+        except BackplaneError as e:
+            self.stats.error()
+            return 200, self._stance_envelope(route, body, str(e))
+
+    def _stance_envelope(self, route: str, body: bytes,
+                         message: str) -> bytes:
+        """The failure-stance verdict a frontend issues on its own when
+        the engine cannot: fail-open allows with a warning status,
+        fail-closed denies. Parses the review ONLY here, to echo uid
+        and envelope apiVersion/kind."""
+        uid = ""
+        api_version = kind = None
+        try:
+            review = jsonio.loads(body)
+            if isinstance(review, dict):
+                uid = (review.get("request") or {}).get("uid") or ""
+                api_version = review.get("apiVersion")
+                kind = review.get("kind")
+        except ValueError:
+            pass
+        fail_closed = (self.mutation_fail_closed if route == "mutate"
+                       else self.fail_closed)
+        return encode_envelope({
+            "apiVersion": api_version or "admission.k8s.io/v1beta1",
+            "kind": kind or "AdmissionReview",
+            "response": {"uid": uid, "allowed": not fail_closed,
+                         "status": {"code": 503, "message": message}},
+        })
+
+    # lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread.start()
+        self._stats_thread.start()
+
+    def _stats_loop(self) -> None:
+        while not self._stats_stop.wait(STATS_INTERVAL_S):
+            stats = self.stats.drain(self.worker_id)
+            if stats is not None:
+                self.client.send_stats(stats)
+
+    def stop(self, drain_timeout: float = 10.0) -> None:
+        """Frontend drain: stop accepting, finish in-flight HTTP
+        requests (their verdicts are already in flight on the
+        backplane), close."""
+        self.server.shutdown()
+        end = time.monotonic() + drain_timeout
+        while time.monotonic() < end:
+            if self.http.inflight() == 0:
+                break
+            time.sleep(0.02)
+        self._stats_stop.set()
+        self.client.close()
+        self.server.server_close()
+
+
+# ------------------------------------------------------------- supervisor
+
+
+class FrontendSupervisor:
+    """Pre-forks N frontend processes (this module's __main__), binds
+    them all to one SO_REUSEPORT port, respawns crashed children, and
+    drains them BEFORE the engine on shutdown."""
+
+    def __init__(self, n: int, socket_path: str, port: int = 8443,
+                 addr: str = "", certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None,
+                 serve: tuple = ("admit", "admitlabel", "mutate"),
+                 fail_closed: bool = False,
+                 mutation_fail_closed: Optional[bool] = None,
+                 default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S,
+                 ready_timeout: float = 30.0):
+        self.n = n
+        self.socket_path = socket_path
+        self.addr = addr
+        self.certfile = certfile
+        self.keyfile = keyfile
+        self.serve = tuple(serve)
+        self.fail_closed = fail_closed
+        self.mutation_fail_closed = mutation_fail_closed
+        self.default_timeout = default_timeout
+        self.ready_timeout = ready_timeout
+        self.port = port
+        self._holder: Optional[socket.socket] = None
+        if port == 0:
+            # ephemeral port: hold a bound (non-listening) SO_REUSEPORT
+            # socket so the chosen port survives until every child has
+            # bound it; the kernel only balances across LISTENING
+            # sockets, so the placeholder never receives connections
+            self._holder = socket.socket()
+            self._holder.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_REUSEPORT, 1)
+            self._holder.bind((addr or "127.0.0.1", 0))
+            self.port = self._holder.getsockname()[1]
+        self._procs: list[Optional[subprocess.Popen]] = [None] * n
+        self._stopping = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    def _spawn(self, k: int) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "gatekeeper_tpu.control.backplane",
+               "--socket", self.socket_path,
+               "--port", str(self.port),
+               "--addr", self.addr,
+               "--worker-id", str(k),
+               "--serve", ",".join(self.serve),
+               "--default-timeout", str(self.default_timeout)]
+        if self.certfile:
+            cmd += ["--certfile", self.certfile]
+            if self.keyfile:
+                cmd += ["--keyfile", self.keyfile]
+        if self.fail_closed:
+            cmd += ["--fail-closed"]
+        if self.mutation_fail_closed is not None:
+            # explicit true/false: collapsing False into "unset" would
+            # make the frontend inherit the VALIDATING stance for
+            # mutations, flipping an operator's fail-open override
+            cmd += ["--mutation-fail-closed",
+                    "true" if self.mutation_fail_closed else "false"]
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+
+    def start(self) -> None:
+        try:
+            for k in range(self.n):
+                self._procs[k] = self._spawn(k)
+            deadline = time.monotonic() + self.ready_timeout
+            for k, proc in enumerate(self._procs):
+                self._await_ready(k, proc, deadline)
+        except Exception:
+            # a worker that never came up must not leak its siblings
+            self._stopping.set()
+            for proc in self._procs:
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+            raise
+        if self._holder is not None:
+            self._holder.close()
+            self._holder = None
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="frontend-supervisor",
+                                         daemon=True)
+        self._monitor.start()
+        log.info("admission frontends serving",
+                 details={"workers": self.n, "port": self.port})
+
+    def _await_ready(self, k: int, proc: subprocess.Popen,
+                     deadline: float) -> None:
+        line: list = []
+
+        def read():
+            line.append(proc.stdout.readline())
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(max(0.1, deadline - time.monotonic()))
+        if not line or "READY" not in (line[0] or ""):
+            raise RuntimeError(
+                f"admission frontend {k} failed to start")
+        # drain any later stdout so the pipe can never fill and block
+        threading.Thread(target=lambda: proc.stdout.read(),
+                         daemon=True).start()
+
+    def _monitor_loop(self) -> None:
+        while not self._stopping.wait(0.5):
+            for k, proc in enumerate(self._procs):
+                if proc is not None and proc.poll() is not None \
+                        and not self._stopping.is_set():
+                    log.warning("admission frontend died; respawning",
+                                details={"worker": k,
+                                         "rc": proc.returncode})
+                    p = None
+                    try:
+                        p = self._spawn(k)
+                        self._await_ready(
+                            k, p, time.monotonic() + self.ready_timeout)
+                        self._procs[k] = p
+                    except Exception as e:
+                        log.error("frontend respawn failed",
+                                  details={"worker": k, "error": str(e)})
+                        # never leak a half-started child: it may hold
+                        # the SO_REUSEPORT bind and receive live
+                        # connections while untracked
+                        if p is not None:
+                            try:
+                                p.kill()
+                            except OSError:
+                                pass
+
+    def alive(self) -> bool:
+        return all(p is not None and p.poll() is None
+                   for p in self._procs)
+
+    def stop(self, timeout: float = 15.0) -> None:
+        """SIGTERM every frontend (each drains its in-flight HTTP
+        requests) and wait — the engine drains only after this
+        returns."""
+        self._stopping.set()
+        for proc in self._procs:
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+        end = time.monotonic() + timeout
+        for proc in self._procs:
+            if proc is None:
+                continue
+            try:
+                proc.wait(max(0.1, end - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if self._holder is not None:
+            self._holder.close()
+            self._holder = None
+
+
+# ------------------------------------------------------- frontend process
+
+
+def frontend_main(argv=None) -> int:
+    """Entry point of one pre-forked frontend process
+    (`python -m gatekeeper_tpu.control.backplane ...`): slim by design —
+    no JAX, no client framework state, just HTTP + the backplane."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="gatekeeper-tpu-frontend")
+    p.add_argument("--socket", required=True)
+    p.add_argument("--port", type=int, default=8443)
+    p.add_argument("--addr", default="")
+    p.add_argument("--certfile", default="")
+    p.add_argument("--keyfile", default="")
+    p.add_argument("--worker-id", default="0")
+    p.add_argument("--serve", default="admit,admitlabel,mutate")
+    p.add_argument("--fail-closed", action="store_true")
+    p.add_argument("--mutation-fail-closed", default="unset",
+                   choices=["true", "false", "unset"],
+                   help="mutation-webhook failure stance; 'unset' "
+                        "inherits --fail-closed")
+    p.add_argument("--default-timeout", type=float,
+                   default=DEFAULT_WEBHOOK_TIMEOUT_S)
+    p.add_argument("--no-reuse-port", action="store_true")
+    args = p.parse_args(argv)
+    client = BackplaneClient(args.socket, worker_id=args.worker_id)
+    server = FrontendServer(
+        client, port=args.port, addr=args.addr,
+        certfile=args.certfile or None, keyfile=args.keyfile or None,
+        reuse_port=not args.no_reuse_port,
+        serve=tuple(s for s in args.serve.split(",") if s),
+        fail_closed=args.fail_closed,
+        mutation_fail_closed=(None if args.mutation_fail_closed == "unset"
+                              else args.mutation_fail_closed == "true"),
+        default_timeout=args.default_timeout,
+        worker_id=args.worker_id)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    server.start()
+    # long-lived-server GC tuning (mirrors the engine's Runtime.start):
+    # everything built so far is permanent; freezing it out of the
+    # collector's scan set keeps multi-hundred-ms gen-2 pauses out of
+    # the admission tail (measured: max latency 1.2s -> ~25ms)
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    # connect eagerly so the engine's connected-workers gauge reflects
+    # the plane before the first request (reconnects are lazy per call)
+    try:
+        client._ensure_connected()
+    except BackplaneError:
+        pass  # engine not up yet; the first forward retries
+    print(f"READY {server.port}", flush=True)
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(frontend_main())

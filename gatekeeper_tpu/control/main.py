@@ -136,7 +136,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="micro-batch queue depth beyond which admission "
                         "requests are SHED immediately with the failure-"
                         "stance verdict (status=shed) instead of "
-                        "queueing into certain timeout; 0 = unbounded")
+                        "queueing into certain timeout; 0 = unbounded. "
+                        "With --admission-workers > 1 the bound lives on "
+                        "the ENGINE side of the backplane, so it stays "
+                        "global across all frontends")
+    p.add_argument("--admission-workers", type=int, default=1,
+                   help="pre-forked HTTP frontend processes over the "
+                        "shared batching backplane: each binds the "
+                        "webhook port with SO_REUSEPORT and does accept/"
+                        "TLS/parse only, forwarding reviews (with their "
+                        "deadlines) over a Unix socket to THIS process — "
+                        "the one engine owning JAX and the micro-"
+                        "batcher, so requests from all workers coalesce "
+                        "into shared device micro-batches. 1 = serve "
+                        "HTTP in-process (no backplane)")
+    p.add_argument("--backplane-socket", default="",
+                   help="Unix socket path for the frontend<->engine "
+                        "backplane (default: a per-process path under "
+                        "the system temp dir); only used with "
+                        "--admission-workers > 1")
+    p.add_argument("--admission-decision-cache", type=int, default=4096,
+                   help="entries in the generation-keyed admission "
+                        "decision cache (identical retries and object "
+                        "storms skip evaluation; any template/"
+                        "constraint/synced-data change invalidates via "
+                        "the library generation). 0 disables")
     p.add_argument("--admission-default-timeout", type=float, default=10.0,
                    help="deadline (seconds) assumed for AdmissionReviews "
                         "that carry no request.timeoutSeconds; the "
@@ -308,6 +332,13 @@ class Runtime:
                               else lambda: self.elector.is_leader))
         self.webhook = None
         self.cert_rotator = None
+        # serving plane (--admission-workers > 1): pre-forked HTTP
+        # frontends over the shared batching backplane; this process is
+        # the engine
+        self.backplane = None
+        self.frontends = None
+        self.validation_handler = None
+        self.mutation_handler = None
         if "webhook" in operations or "mutation-webhook" in operations:
             fail_closed = getattr(args, "fail_closed", False)
             validation = ns_label = None
@@ -327,13 +358,14 @@ class Runtime:
                     traces_provider=lambda:
                     self.manager.config_ctrl.traces,
                     fail_closed=fail_closed,
-                    default_timeout=default_timeout)
+                    default_timeout=default_timeout,
+                    decision_cache_size=getattr(
+                        args, "admission_decision_cache", 4096))
                 ns_label = NamespaceLabelHandler(
                     tuple(args.exempt_namespace))
             mutation = None
+            mut_fail_closed = getattr(args, "mutation_fail_closed", None)
             if self.mutation_system is not None:
-                mut_fail_closed = getattr(args, "mutation_fail_closed",
-                                          None)
                 mutation = MutationHandler(
                     self.mutation_system, kube=self.kube,
                     fail_closed=fail_closed if mut_fail_closed is None
@@ -342,6 +374,8 @@ class Runtime:
                                            0.005),
                     max_queue=max_queue,
                     default_timeout=default_timeout)
+            self.validation_handler = validation
+            self.mutation_handler = mutation
             certfile = keyfile = None
             if not args.disable_cert_rotation:
                 # guarded: secret persistence and CA-bundle injection
@@ -355,11 +389,37 @@ class Runtime:
                 except Exception as e:
                     log.warning("cert bootstrap failed; serving plaintext",
                                 details=str(e))
-            self.webhook = WebhookServer(
-                validation, ns_label, port=args.port, certfile=certfile,
-                keyfile=keyfile,
-                reuse_port=getattr(args, "webhook_reuse_port", False),
-                mutation=mutation)
+            workers = getattr(args, "admission_workers", 1) or 1
+            if workers > 1:
+                from .backplane import (
+                    BackplaneEngine,
+                    FrontendSupervisor,
+                    default_socket_path,
+                )
+
+                sock = getattr(args, "backplane_socket", "") \
+                    or default_socket_path()
+                serve = []
+                if validation is not None:
+                    serve += ["admit", "admitlabel"]
+                if mutation is not None:
+                    serve += ["mutate"]
+                self.backplane = BackplaneEngine(
+                    sock, validation=validation, ns_label=ns_label,
+                    mutation=mutation, default_timeout=default_timeout)
+                self.backplane.configured_workers = workers
+                self.frontends = FrontendSupervisor(
+                    workers, sock, port=args.port,
+                    certfile=certfile, keyfile=keyfile,
+                    serve=tuple(serve), fail_closed=fail_closed,
+                    mutation_fail_closed=mut_fail_closed,
+                    default_timeout=default_timeout)
+            else:
+                self.webhook = WebhookServer(
+                    validation, ns_label, port=args.port,
+                    certfile=certfile, keyfile=keyfile,
+                    reuse_port=getattr(args, "webhook_reuse_port", False),
+                    mutation=mutation)
         self.upgrade = UpgradeManager(self.kube)
         self.metrics_server = None
         self.health = None
@@ -521,7 +581,7 @@ class Runtime:
             try:
                 self.health = health.HealthServer(*addr)
                 self.health.add_readiness("runtime", lambda: self._ready)
-                if self.webhook is None:
+                if self.webhook is None and self.backplane is None:
                     # audit/controller-only pods surface the open
                     # kube-write breaker through readiness. Webhook
                     # pods must NOT: every replica shares one API
@@ -538,17 +598,28 @@ class Runtime:
                     self.health.add_readiness(
                         "webhook",
                         lambda: self.webhook._thread.is_alive())
-                    # liveness watchdogs: a wedged micro-batch pipeline
-                    # (dead flusher, hung evaluation with a growing
-                    # queue) fails /healthz so k8s restarts the pod
-                    if self.webhook.validation is not None:
-                        self.health.add_liveness(
-                            "admission-batcher",
-                            self.webhook.validation.batcher.healthy)
-                    if self.webhook.mutation is not None:
-                        self.health.add_liveness(
-                            "mutation-batcher",
-                            self.webhook.mutation.batcher.healthy)
+                if self.backplane is not None:
+                    # the engine listener and every pre-forked frontend
+                    # must be up for the plane to serve (a crashed
+                    # frontend is respawned by the supervisor; readiness
+                    # dips meanwhile)
+                    self.health.add_readiness("backplane-engine",
+                                              self.backplane.alive)
+                    self.health.add_readiness("admission-frontends",
+                                              self.frontends.alive)
+                # liveness watchdogs: a wedged micro-batch pipeline
+                # (dead flusher, hung evaluation with a growing queue)
+                # fails /healthz so k8s restarts the pod — the
+                # handlers exist in both the in-process and the
+                # backplane serving modes
+                if self.validation_handler is not None:
+                    self.health.add_liveness(
+                        "admission-batcher",
+                        self.validation_handler.batcher.healthy)
+                if self.mutation_handler is not None:
+                    self.health.add_liveness(
+                        "mutation-batcher",
+                        self.mutation_handler.batcher.healthy)
                 if self.audit:
                     self.health.add_liveness("audit-loop",
                                              self.audit.healthy)
@@ -583,6 +654,13 @@ class Runtime:
             self.cert_rotator.start(watch_manager=self.manager.wm)
         if self.webhook:
             self.webhook.start()
+        if self.backplane is not None:
+            # engine first: frontends connect eagerly on boot
+            self.backplane.start()
+            self.frontends.start()
+            metrics.report_admission_workers(
+                self.backplane.configured_workers,
+                self.backplane.connected)
         if self.snapshots is not None:
             self.snapshots.start()
         self._ready = True
@@ -604,6 +682,12 @@ class Runtime:
             self.elector.stop()
         if self.webhook:
             self.webhook.stop()
+        if self.backplane is not None:
+            # frontends FIRST: each stops accepting and finishes its
+            # in-flight HTTP requests (verdicts still flow over the
+            # backplane), THEN the engine drains the shared batcher
+            self.frontends.stop()
+            self.backplane.stop()
         if self.audit:
             self.audit.stop()
         if self.snapshots is not None:

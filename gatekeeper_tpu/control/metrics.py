@@ -79,6 +79,29 @@ class Registry:
             m.sums[key] += value
             m.counts[key] += 1
 
+    def observe_bucketed(self, name: str, help_: str, buckets: tuple,
+                         bucket_counts: list, sum_: float, count: int,
+                         **labels) -> None:
+        """Merge a PRE-AGGREGATED histogram delta. `bucket_counts` must
+        carry len(buckets)+1 entries — the last is the +Inf overflow
+        bucket — and `count` must equal their sum, or the rendered
+        cumulative histogram goes invalid (+Inf bucket < _count). The
+        backplane frontends run in their own processes and ship their
+        forward-latency histograms over the wire as aggregated deltas —
+        replaying observations one by one would cost more than the
+        latency being measured."""
+        m = self._get(name, help_, "histogram", tuple(sorted(labels)))
+        with m.lock:
+            m.buckets = tuple(buckets)
+            key = _lv(labels)
+            if key not in m.bucket_counts:
+                m.bucket_counts[key] = [0] * (len(buckets) + 1)
+            counts = m.bucket_counts[key]
+            for i, c in enumerate(bucket_counts[: len(counts)]):
+                counts[i] += c
+            m.sums[key] += sum_
+            m.counts[key] += count
+
     # ------------------------------------------------------------- render
 
     def render(self) -> str:
@@ -202,6 +225,52 @@ def report_admission_shed(n: int = 1) -> None:
     REGISTRY.counter_add("admission_requests_shed_total",
                          "Admission requests shed by the bounded "
                          "micro-batch queue", n)
+
+
+def report_decision_cache(outcome: str, n: int = 1) -> None:
+    """One admission decision-cache consultation: hit (verdict served
+    without evaluation), miss (evaluated and cached), or bypass (the
+    request is uncacheable — traced, or a deny under --log-denies where
+    every denial must re-log)."""
+    REGISTRY.counter_add("gatekeeper_tpu_admission_decision_cache_total",
+                         "Admission decision cache lookups by outcome",
+                         n, outcome=outcome)
+
+
+def report_admission_workers(configured: int, connected: int) -> None:
+    """Serving-plane topology gauge: --admission-workers as configured
+    and the number of frontend processes currently connected to the
+    engine backplane (equal when the plane is healthy)."""
+    REGISTRY.gauge_set("gatekeeper_tpu_admission_workers",
+                       "Admission frontend worker processes",
+                       configured, state="configured")
+    REGISTRY.gauge_set("gatekeeper_tpu_admission_workers",
+                       "Admission frontend worker processes",
+                       connected, state="connected")
+
+
+# frontends bucket their forward latencies locally with these bounds and
+# ship aggregated deltas over the backplane stats frame
+FORWARD_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 5.0)
+
+
+def report_backplane_forward(worker: str, bucket_counts: list,
+                             sum_: float, count: int) -> None:
+    """Merge one frontend's forward-latency histogram delta (enqueue on
+    the frontend to verdict bytes received back over the backplane)."""
+    REGISTRY.observe_bucketed(
+        "gatekeeper_tpu_backplane_forward_duration_seconds",
+        "Frontend-observed latency of one review forwarded over the "
+        "backplane to the engine and answered", FORWARD_BUCKETS,
+        bucket_counts, sum_, count, worker=worker)
+
+
+def report_backplane_error(worker: str, n: int = 1) -> None:
+    REGISTRY.counter_add(
+        "gatekeeper_tpu_backplane_errors_total",
+        "Reviews a frontend answered per the failure stance because the "
+        "engine backplane was unreachable", n, worker=worker)
 
 
 _BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}

@@ -27,7 +27,6 @@ Behavior parity:
 from __future__ import annotations
 
 import base64
-import http.server
 import json
 import ssl
 import threading
@@ -37,7 +36,7 @@ from typing import Any, Callable, Optional
 from ..client import Client
 from ..target.handler import AugmentedReview
 from ..utils import faults
-from . import metrics
+from . import jsonio, metrics
 from .config_types import trace_enabled
 from .kube import NotFound
 from .logging import logger
@@ -80,6 +79,44 @@ def go_duration_s(text: Optional[str]) -> Optional[float]:
         return float(text)
     except ValueError:
         return None
+
+
+def route_path(path: str) -> Optional[str]:
+    """One routing rule for every serving topology (in-process server,
+    backplane engine, frontends): the admitlabel prefix must be checked
+    BEFORE admit (shared prefix), and unknown paths are None."""
+    if path.startswith("/v1/admitlabel"):
+        return "admitlabel"
+    if path.startswith("/v1/admit"):
+        return "admit"
+    if path.startswith("/v1/mutate"):
+        return "mutate"
+    return None
+
+
+def parse_timeout_query(query: str) -> Optional[float]:
+    """The webhook timeout from a request's URL query string.
+
+    admission.k8s.io/v1 carries NO timeoutSeconds in the body — the API
+    server conveys its budget only as `?timeout=5s`. Tolerates the wild:
+    percent-encoded values, duplicate pairs (first parseable wins), bare
+    keys, and malformed fragments never raise."""
+    if not query:
+        return None
+    from urllib.parse import parse_qsl
+
+    try:
+        pairs = parse_qsl(query, keep_blank_values=True,
+                          strict_parsing=False)
+    except ValueError:  # pragma: no cover - parse_qsl is lenient
+        return None
+    for k, v in pairs:
+        if k != "timeout":
+            continue
+        t = go_duration_s(v)
+        if t is not None and t > 0:
+            return t
+    return None
 
 
 def request_deadline(request: dict, default_s: float =
@@ -379,6 +416,130 @@ def _envelope(admission_review: dict, response: dict) -> dict:
     }
 
 
+# --------------------------------------------------- response encoding
+
+# uid charset the API server actually emits (UUIDs); anything outside it
+# takes the full-encoder fallback rather than a hand-rolled escape
+_UID_SAFE = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_.:")
+_ENVELOPE_PREFIXES: dict = {}
+
+
+def encode_envelope(envelope: dict) -> bytes:
+    """Serialize an AdmissionReview response envelope.
+
+    The overwhelmingly common response is a bare allow; its envelope is
+    PRESERIALIZED per (apiVersion, kind) and patched with the uid, so
+    the hot path is two bytes-joins instead of a full JSON encode. A
+    response carrying a status message rides a fragment splice (the
+    message is the only part needing real escaping); anything else —
+    patches, warnings — falls back to the full encoder."""
+    resp = envelope.get("response")
+    if isinstance(resp, dict):
+        uid = resp.get("uid") or ""
+        if isinstance(uid, str) and _UID_SAFE.issuperset(uid):
+            keys = set(resp)
+            if keys <= {"uid", "allowed"} and resp.get("allowed") is True:
+                return (_envelope_prefix(envelope) + uid.encode()
+                        + b'","allowed":true}}')
+            if keys == {"uid", "allowed", "status"} and \
+                    isinstance(resp.get("status"), dict):
+                return (_envelope_prefix(envelope) + uid.encode()
+                        + b'","allowed":'
+                        + (b"true" if resp.get("allowed") else b"false")
+                        + b',"status":'
+                        + jsonio.dumps_bytes(resp["status"]) + b"}}")
+    return jsonio.dumps_bytes(envelope)
+
+
+def _envelope_prefix(envelope: dict) -> bytes:
+    key = (envelope.get("apiVersion"), envelope.get("kind"))
+    prefix = _ENVELOPE_PREFIXES.get(key)
+    if prefix is None:
+        prefix = (b'{"apiVersion":' + jsonio.dumps_bytes(key[0])
+                  + b',"kind":' + jsonio.dumps_bytes(key[1])
+                  + b',"response":{"uid":"')
+        if len(_ENVELOPE_PREFIXES) < 64:  # callers send ~2 shapes ever
+            _ENVELOPE_PREFIXES[key] = prefix
+    return prefix
+
+
+# ----------------------------------------------------- decision cache
+
+
+class DecisionCache:
+    """Generation-keyed LRU over admission verdicts.
+
+    Key = (canonical request hash, library generation, namespace-label
+    hash). Identical retries and DaemonSet-style object storms (the same
+    pod spec admitted once per node) skip evaluation entirely; any
+    template/constraint/synced-data change bumps the client generation,
+    so every stale entry misses and ages out — there is no explicit
+    invalidation path to get wrong. Namespace label edits flip the
+    namespace hash the same way."""
+
+    def __init__(self, size: int = 4096):
+        from collections import OrderedDict
+
+        self.size = size
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def request_key(request: dict) -> bytes:
+        """Canonical hash of the verdict-relevant request fields: uid is
+        per-attempt noise and timeoutSeconds is a transport budget —
+        neither can change the decision."""
+        import hashlib
+
+        slim = {k: v for k, v in request.items()
+                if k not in ("uid", "timeoutSeconds")}
+        return hashlib.blake2b(jsonio.canonical_bytes(slim),
+                               digest_size=16).digest()
+
+    @staticmethod
+    def ns_key(ns_obj: Optional[dict]) -> bytes:
+        """Hash of the WHOLE sideloaded namespace object: policies can
+        key on annotations or any other namespace field (the full
+        object rides the review), so labels alone would serve stale
+        verdicts across non-label namespace edits."""
+        if not ns_obj:
+            return b""
+        import hashlib
+
+        return hashlib.blake2b(jsonio.canonical_bytes(ns_obj),
+                               digest_size=16).digest()
+
+    def get(self, key: tuple) -> Optional[dict]:
+        with self._lock:
+            resp = self._entries.get(key)
+            if resp is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return resp
+
+    def put(self, key: tuple, response: dict) -> None:
+        with self._lock:
+            self._entries[key] = response
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.size:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class NeedsEvaluation(Exception):
+    """Raised inside a fast=True handle(): the verdict is not in the
+    decision cache, so answering requires the (blocking) micro-batch
+    path — the caller re-dispatches to a worker thread."""
+
+
 class ValidationHandler:
     """The /v1/admit logic, transport-independent.
 
@@ -394,7 +555,8 @@ class ValidationHandler:
                  validate_enforcement: bool = True,
                  traces_provider=None,
                  fail_closed: bool = False,
-                 default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S):
+                 default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S,
+                 decision_cache_size: int = 4096):
         self.opa = opa
         self.kube = kube
         self.batcher = batcher or MicroBatcher(opa)
@@ -403,15 +565,33 @@ class ValidationHandler:
         self.traces_provider = traces_provider or (lambda: [])
         self.fail_closed = fail_closed
         self.default_timeout = default_timeout
+        self.cache = (DecisionCache(decision_cache_size)
+                      if decision_cache_size > 0 else None)
 
-    def handle(self, admission_review: dict) -> dict:
+    def handle(self, admission_review: dict,
+               deadline: Optional[float] = None,
+               fast: bool = False) -> Optional[dict]:
+        """`deadline` (absolute monotonic) overrides the one derived
+        from the request body — the backplane engine pins it at frame
+        receipt so queueing ahead of this call spends the request's
+        budget, not a fresh one.
+
+        fast=True answers ONLY when no blocking work is needed (the
+        short-circuits and decision-cache hits); a request that would
+        have to evaluate returns None instead, and the caller re-issues
+        handle() from a thread that may block. The backplane engine
+        serves cache hits inline in its frame-reader thread this way —
+        no thread handoff on the hot path."""
         t0 = time.time()
         request = admission_review.get("request") or {}
         uid = request.get("uid") or ""
-        deadline = request_deadline(request, self.default_timeout)
+        if deadline is None:
+            deadline = request_deadline(request, self.default_timeout)
         status = None
         try:
-            response = self._decide(request, deadline)
+            response = self._decide(request, deadline, fast=fast)
+        except NeedsEvaluation:
+            return None
         except AdmissionShed as e:
             status = "shed"
             response = {"allowed": not self.fail_closed,
@@ -435,7 +615,8 @@ class ValidationHandler:
         return _envelope(admission_review, response)
 
     def _decide(self, request: dict,
-                deadline: Optional[float] = None) -> dict:
+                deadline: Optional[float] = None,
+                fast: bool = False) -> dict:
         username = (request.get("userInfo") or {}).get("username")
         if username == SERVICE_ACCOUNT:
             return {"allowed": True}
@@ -452,6 +633,11 @@ class ValidationHandler:
         ns_obj = None
         ns_name = request.get("namespace")
         if ns_name and self.kube is not None:
+            if fast:
+                # the namespace fetch may hit the API server: not a
+                # fast-path operation (a future informer cache would
+                # lift this)
+                raise NeedsEvaluation()
             try:
                 ns_obj = self.kube.get(("", "v1", "Namespace"), ns_name)
             except NotFound:
@@ -464,6 +650,29 @@ class ValidationHandler:
         want_trace, want_dump = trace_enabled(
             self.traces_provider(), username,
             (group, kind.get("version") or "", kind.get("kind") or ""))
+        cache_key = None
+        if self.cache is not None and not want_trace:
+            # generation read BEFORE evaluation: a library update racing
+            # the eval stores the old verdict under the old generation,
+            # which no future lookup consults
+            cache_key = (DecisionCache.request_key(request),
+                         self.opa.generation,
+                         DecisionCache.ns_key(ns_obj))
+            cached = self.cache.get(cache_key)
+            if cached is not None and (cached.get("allowed")
+                                       or not self.log_denies):
+                metrics.report_decision_cache("hit")
+                # shallow copy: the caller patches uid into the response
+                return dict(cached)
+            if fast:
+                raise NeedsEvaluation()  # miss reported by the re-issue
+            metrics.report_decision_cache("miss")
+        elif self.cache is not None:
+            if fast:
+                raise NeedsEvaluation()
+            metrics.report_decision_cache("bypass")
+        if fast:
+            raise NeedsEvaluation()  # cache disabled: evaluation ahead
         if want_trace:
             # traced requests bypass the batcher: the trace is per-request
             # (reference policy.go:290-309)
@@ -495,10 +704,17 @@ class ValidationHandler:
             if r.enforcement_action == "deny":
                 denies.append(r.msg)
         if denies:
-            return {"allowed": False,
-                    "status": {"code": 403,
-                               "reason": "; ".join(sorted(denies))}}
-        return {"allowed": True}
+            response = {"allowed": False,
+                        "status": {"code": 403,
+                                   "reason": "; ".join(sorted(denies))}}
+        else:
+            response = {"allowed": True}
+        if cache_key is not None and (not self.log_denies or not results):
+            # under --log-denies a cached answer must not swallow audit
+            # log lines: only violation-FREE responses are cached (deny,
+            # warn, and dryrun results all log per request)
+            self.cache.put(cache_key, dict(response))
+        return response
 
     def _validate_gatekeeper_resource(self, request: dict,
                                       group: str) -> dict:
@@ -583,11 +799,13 @@ class MutationHandler:
     def _evaluate_batch(self, reviews: list[dict]) -> list:
         return self.system.mutate_batch(reviews, self._lookup_namespace)
 
-    def handle(self, admission_review: dict) -> dict:
+    def handle(self, admission_review: dict,
+               deadline: Optional[float] = None) -> dict:
         t0 = time.time()
         request = admission_review.get("request") or {}
         uid = request.get("uid") or ""
-        deadline = request_deadline(request, self.default_timeout)
+        if deadline is None:
+            deadline = request_deadline(request, self.default_timeout)
         status = "allow"
         try:
             response = self._decide(request, deadline)
@@ -646,8 +864,208 @@ class MutationHandler:
         }
 
 
+# -------------------------------------------------- fast HTTP transport
+
+_HTTP_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                 405: "Method Not Allowed", 500: "Internal Server Error",
+                 503: "Service Unavailable"}
+
+
+class FastHTTPServer:
+    """Minimal threaded HTTP/1.1 POST server for the admission hot path.
+
+    `BaseHTTPRequestHandler` costs ~1ms per request at webhook payload
+    sizes (email-module header parsing, per-header writes, logging
+    plumbing) — more than the whole admission decision. This hand-rolled
+    loop parses the request line + the three headers that matter
+    (Content-Length / Transfer-Encoding / Connection, plus a 100-
+    continue Expect), reads the body, and answers with ONE sendall.
+    Keep-alive by default (HTTP/1.1 semantics; Connection: close and
+    HTTP/1.0 honored), TLS via the wrapped listening socket, an idle
+    timeout so silent clients cannot pin threads forever, and in-flight
+    accounting for the graceful-shutdown drain.
+
+    `dispatch(path, body) -> (status, payload_bytes)` is the entire
+    application surface."""
+
+    def __init__(self, addr: tuple, dispatch, reuse_port: bool = False,
+                 certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None,
+                 idle_timeout: float = 60.0):
+        import socket as _socket
+        import socketserver
+
+        outer = self
+        self.dispatch = dispatch
+        self.idle_timeout = idle_timeout
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+            request_queue_size = 128
+
+            def server_bind(self):
+                if reuse_port:
+                    self.socket.setsockopt(_socket.SOL_SOCKET,
+                                           _socket.SO_REUSEPORT, 1)
+                super().server_bind()
+
+            def finish_request(self, request, client_address):
+                outer._serve_connection(request)
+
+            def handle_error(self, request, client_address):
+                # keep-alive clients dropping a connection mid-request
+                # (reset, broken pipe, idle timeout, TLS teardown) are
+                # routine — one log line, not a traceback
+                import sys as _sys
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (ConnectionError, TimeoutError,
+                                    OSError, ssl.SSLError)):
+                    log.info("client connection dropped",
+                             details=str(exc))
+                    return
+                super().handle_error(request, client_address)
+
+        self.server = _Server(addr, None)
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self.server.socket = ctx.wrap_socket(self.server.socket,
+                                                 server_side=True)
+        self.port = self.server.server_address[1]
+
+    # one thread per connection; requests loop here until close
+    def _serve_connection(self, conn) -> None:
+        import socket as _socket
+
+        try:
+            conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        conn.settimeout(self.idle_timeout)
+        rfile = conn.makefile("rb", 65536)
+        try:
+            while True:
+                line = rfile.readline(65537)
+                if not line:
+                    return
+                if line in (b"\r\n", b"\n"):
+                    continue  # stray CRLF between pipelined requests
+                try:
+                    method, path, version = line.split(None, 2)
+                except ValueError:
+                    self._respond(conn, 400, b"", close=True)
+                    return
+                close_after = not version.strip().endswith(b"1.1")
+                clen = 0
+                chunked = False
+                while True:
+                    h = rfile.readline(65537)
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    key, _, value = h.partition(b":")
+                    key = key.strip().lower()
+                    value = value.strip()
+                    if key == b"content-length":
+                        try:
+                            clen = int(value)
+                        except ValueError:
+                            clen = 0
+                    elif key == b"transfer-encoding":
+                        chunked = b"chunked" in value.lower()
+                    elif key == b"connection":
+                        v = value.lower()
+                        if b"close" in v:
+                            close_after = True
+                        elif b"keep-alive" in v:
+                            close_after = False
+                    elif key == b"expect" and \
+                            value.lower().startswith(b"100-"):
+                        conn.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+                body = (self._read_chunked(rfile) if chunked
+                        else (rfile.read(clen) if clen > 0 else b""))
+                if method != b"POST":
+                    self._respond(conn, 405, b"", close_after)
+                    if close_after:
+                        return
+                    continue
+                # in-flight accounting for the graceful-shutdown drain:
+                # idle keep-alive connections do NOT count (the thread
+                # parks on readline between requests)
+                with self._inflight_lock:
+                    self._inflight += 1
+                try:
+                    status, payload = self.dispatch(
+                        path.decode("latin-1"), body)
+                except Exception as e:  # a dispatch bug must still
+                    # ANSWER (zero unanswered admissions), not drop the
+                    # socket and leave the API server to its timeout
+                    log.error("dispatch error", details=str(e))
+                    status, payload = 500, b""
+                finally:
+                    with self._inflight_lock:
+                        self._inflight -= 1
+                self._respond(conn, status, payload, close_after)
+                if close_after:
+                    return
+        except (ConnectionError, TimeoutError, OSError, ssl.SSLError):
+            return  # routine client teardown
+        finally:
+            try:
+                rfile.close()
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _read_chunked(rfile) -> bytes:
+        # minimal RFC 7230 §4.1 decoder (the API server normally sends
+        # Content-Length; this keeps chunked senders working)
+        out = bytearray()
+        while True:
+            size_line = rfile.readline(65537)
+            if not size_line:
+                raise ConnectionError("EOF inside chunked body")
+            size = int(size_line.split(b";", 1)[0].strip() or b"0", 16)
+            if size == 0:
+                while rfile.readline(65537) not in (b"\r\n", b"\n", b""):
+                    pass  # trailers
+                return bytes(out)
+            out += rfile.read(size)
+            rfile.readline(65537)  # chunk-terminating CRLF
+
+    @staticmethod
+    def _respond(conn, status: int, payload: bytes,
+                 close: bool = False) -> None:
+        head = ("HTTP/1.1 %d %s\r\n"
+                "Content-Type: application/json\r\n"
+                "Content-Length: %d\r\n%s\r\n"
+                % (status, _HTTP_REASONS.get(status, "OK"), len(payload),
+                   "Connection: close\r\n" if close else ""))
+        conn.sendall(head.encode("ascii") + payload)
+
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    # socketserver API passthrough (bench/tests drive these directly)
+    def serve_forever(self) -> None:
+        self.server.serve_forever()
+
+    def shutdown(self) -> None:
+        self.server.shutdown()
+
+    def server_close(self) -> None:
+        self.server.server_close()
+
+
 class WebhookServer:
-    """HTTPS transport over the handlers."""
+    """HTTPS transport over the handlers (FastHTTPServer dispatch)."""
 
     def __init__(self, validation: Optional[ValidationHandler],
                  ns_label: Optional[NamespaceLabelHandler],
@@ -659,123 +1077,45 @@ class WebhookServer:
         PROCESSES share one port (the kernel load-balances accepts) —
         the single-process Python frontend is GIL-bound, and this is
         how one node runs N webhook workers without a proxy."""
-        outer = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            # keep-alive: the API server reuses webhook connections; a
-            # connection (and thread) per request doubles syscall load.
-            # The idle timeout bounds how long a half-open or silent
-            # client can pin a serving thread and its socket
-            protocol_version = "HTTP/1.1"
-            timeout = 60
-
-            def do_POST(self):
-                # in-flight accounting for the graceful-shutdown drain:
-                # idle keep-alive connections do NOT count (the thread
-                # parks between requests outside do_POST)
-                with outer._inflight_lock:
-                    outer._inflight += 1
-                try:
-                    self._do_POST()
-                finally:
-                    with outer._inflight_lock:
-                        outer._inflight -= 1
-
-            def _do_POST(self):
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length)
-                try:
-                    review = json.loads(body)
-                except json.JSONDecodeError:
-                    self.send_response(400)
-                    # explicit zero length: HTTP/1.1 keep-alive clients
-                    # would otherwise wait for a close that never comes
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-                # admission.k8s.io/v1 carries NO timeoutSeconds in the
-                # request body — a real API server conveys its webhook
-                # timeout only as the ?timeout=5s URL query. Fold it
-                # into the request so deadline propagation sees the
-                # REAL budget (a body field, e.g. from tests or direct
-                # callers, wins)
-                request = (review or {}).get("request") \
-                    if isinstance(review, dict) else None
-                if isinstance(request, dict) and \
-                        "timeoutSeconds" not in request:
-                    query = self.path.partition("?")[2]
-                    params = dict(p.split("=", 1)
-                                  for p in query.split("&") if "=" in p)
-                    t = go_duration_s(params.get("timeout"))
-                    if t is not None and t > 0:
-                        request["timeoutSeconds"] = t
-                # un-served endpoints 404 (an operation not requested
-                # must not answer admission decisions for it)
-                if self.path.startswith("/v1/admitlabel") \
-                        and outer.ns_label is not None:
-                    out = outer.ns_label.handle(review)
-                elif self.path.startswith("/v1/admit") \
-                        and not self.path.startswith("/v1/admitlabel") \
-                        and outer.validation is not None:
-                    out = outer.validation.handle(review)
-                elif self.path.startswith("/v1/mutate") \
-                        and outer.mutation is not None:
-                    out = outer.mutation.handle(review)
-                else:
-                    self.send_response(404)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-                payload = json.dumps(out).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-
-            def log_message(self, *a):
-                pass
-
         self.validation = validation
         self.ns_label = ns_label
         self.mutation = mutation
-        self._inflight = 0
-        self._inflight_lock = threading.Lock()
-
-        class _Server(http.server.ThreadingHTTPServer):
-            def handle_error(self, request, client_address):
-                # keep-alive clients dropping a connection mid-request
-                # (reset, broken pipe, idle timeout) are routine — log
-                # one line instead of a traceback on stderr
-                import sys
-                exc = sys.exc_info()[1]
-                if isinstance(exc, (ConnectionError, TimeoutError,
-                                    ssl.SSLError)):
-                    log.info("client connection dropped",
-                             details=str(exc))
-                    return
-                super().handle_error(request, client_address)
-
-        server_cls = _Server
-        if reuse_port:
-            import socket as _socket
-
-            class _ReusePort(_Server):
-                def server_bind(self):
-                    self.socket.setsockopt(_socket.SOL_SOCKET,
-                                           _socket.SO_REUSEPORT, 1)
-                    super().server_bind()
-
-            server_cls = _ReusePort
-        self.server = server_cls((addr, port), Handler)
-        if certfile:
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(certfile, keyfile)
-            self.server.socket = ctx.wrap_socket(self.server.socket,
-                                                 server_side=True)
-        self.port = self.server.server_address[1]
+        self.http = FastHTTPServer((addr, port), self._dispatch,
+                                   reuse_port=reuse_port,
+                                   certfile=certfile, keyfile=keyfile)
+        self.server = self.http.server  # legacy handle (bench/tests)
+        self.port = self.http.port
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         name="webhook", daemon=True)
+
+    def _dispatch(self, path: str, body: bytes) -> tuple:
+        try:
+            review = jsonio.loads(body)
+        except ValueError:
+            return 400, b""
+        # admission.k8s.io/v1 carries NO timeoutSeconds in the request
+        # body — a real API server conveys its webhook timeout only as
+        # the ?timeout=5s URL query. Fold it into the request so
+        # deadline propagation sees the REAL budget (a body field, e.g.
+        # from tests or direct callers, wins)
+        request = (review or {}).get("request") \
+            if isinstance(review, dict) else None
+        if isinstance(request, dict) and "timeoutSeconds" not in request:
+            t = parse_timeout_query(path.partition("?")[2])
+            if t is not None:
+                request["timeoutSeconds"] = t
+        # un-served endpoints 404 (an operation not requested must not
+        # answer admission decisions for it)
+        route = route_path(path)
+        if route == "admitlabel" and self.ns_label is not None:
+            out = self.ns_label.handle(review)
+        elif route == "admit" and self.validation is not None:
+            out = self.validation.handle(review)
+        elif route == "mutate" and self.mutation is not None:
+            out = self.mutation.handle(review)
+        else:
+            return 404, b""
+        return 200, encode_envelope(out)
 
     def start(self) -> None:
         self._thread.start()
@@ -788,9 +1128,8 @@ class WebhookServer:
         self.server.shutdown()  # stop the accept loop; handlers continue
         end = time.monotonic() + drain_timeout
         while time.monotonic() < end:
-            with self._inflight_lock:
-                if self._inflight == 0:
-                    break
+            if self.http.inflight() == 0:
+                break
             time.sleep(0.02)
         for handler in (self.validation, self.mutation):
             if handler is not None:

@@ -21,6 +21,8 @@ Spec syntax (env/flag), comma-separated:
     state.snapshot:truncate#1       one snapshot file torn mid-write
     kube.lease:steal                leader lease stolen by a rival
     kube.lease:expire               leader misses renews; lease lapses
+    backplane.engine:error          frontends cannot reach the engine
+                                    (answer per the failure stance)
 
 Injection points in the tree (grep for faults.fire / faults.consume):
     kube.write     control/resilience.py  GuardedKube mutating verbs
@@ -35,6 +37,11 @@ Injection points in the tree (grep for faults.fire / faults.consume):
                    steal -> a rival identity takes the lease; expire ->
                    our renews stop landing and the lease lapses;
                    error -> the renew API call fails)
+    backplane.engine control/backplane.py BackplaneClient.call — the
+                   frontend->engine forward path (raise/error -> the
+                   engine is unreachable and the frontend answers per
+                   the fail-open/closed stance; sleep -> a slow
+                   backplane)
 """
 
 from __future__ import annotations

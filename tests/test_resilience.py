@@ -608,9 +608,8 @@ def test_graceful_shutdown_drains_inflight_reviews():
         t.start()
     deadline = time.time() + 5
     while time.time() < deadline:
-        with server._inflight_lock:
-            if server._inflight >= 4:
-                break
+        if server.http.inflight() >= 4:
+            break
         time.sleep(0.005)
     server.stop(drain_timeout=10.0)
     for t in threads:
@@ -853,3 +852,90 @@ def test_fault_spec_parsing_and_counters():
     t0 = time.monotonic()
     FAULTS.fire("webhook.flush")
     assert time.monotonic() - t0 >= 0.01
+
+
+# ------------------------------------------- serving-plane chaos (PR 5)
+
+
+def test_backplane_engine_kill_mid_burst_zero_unanswered():
+    """The serving-plane acceptance storm: the engine is killed (abort,
+    the in-process analog of kill -9) in the middle of an admission
+    burst with the `backplane.engine` fault point armed for the
+    aftermath — every HTTP caller still gets an AdmissionReview
+    response per the fail-open stance. Zero unanswered admissions."""
+    from gatekeeper_tpu.control.backplane import (
+        BackplaneClient,
+        BackplaneEngine,
+        FrontendServer,
+        default_socket_path,
+    )
+
+    _, client = _policy_client()
+
+    def slow_eval(reviews):
+        time.sleep(0.05)  # keep a healthy backlog in flight at the kill
+        resp = client.driver.review_batch(TARGET, reviews)
+        return resp
+
+    batcher = MicroBatcher(client, max_wait=0.002, max_batch=8,
+                           evaluate=slow_eval)
+    validation = ValidationHandler(client, kube=None, batcher=batcher,
+                                   decision_cache_size=0)
+    sock = default_socket_path() + ".kill"
+    engine = BackplaneEngine(sock, validation=validation)
+    engine.start()
+    bc = BackplaneClient(sock, worker_id="chaos")
+    frontend = FrontendServer(bc, port=0, addr="127.0.0.1")
+    frontend.start()
+    n = 60
+    answered: dict[int, dict] = {}
+    errors: list = []
+    lock = threading.Lock()
+
+    def fire(i):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", frontend.port,
+                                              timeout=15)
+            conn.request("POST", "/v1/admit?timeout=3s",
+                         json.dumps(_review(f"k{i}", timeout_s=3)),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            conn.close()
+            with lock:
+                answered[i] = (resp.status, body["response"])
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(n)]
+    try:
+        for t in threads:
+            t.start()
+        # let part of the burst land real verdicts, then kill the
+        # engine under the rest; arm the fault point so even the
+        # reconnect path stays down for the stragglers
+        deadline = time.time() + 10
+        while len(answered) < n // 6 and time.time() < deadline:
+            time.sleep(0.01)
+        FAULTS.inject("backplane.engine", mode="error")
+        engine.abort()
+        for t in threads:
+            t.join(20)
+            assert not t.is_alive(), "caller wedged past its deadline"
+    finally:
+        frontend.stop(drain_timeout=2.0)
+        batcher.stop()
+        FAULTS.reset()
+    assert not errors, errors[:3]
+    assert len(answered) == n, "unanswered admissions after engine kill"
+    stance = 0
+    for i, (status, resp) in answered.items():
+        assert status == 200
+        assert "allowed" in resp
+        code = (resp.get("status") or {}).get("code")
+        if code in (503, 504):
+            stance += 1
+            assert resp["allowed"] is True  # fail-open stance
+    assert stance > 0, "the kill landed after the whole burst finished"

@@ -1,0 +1,524 @@
+"""Admission serving plane (perf tentpole): pre-fork frontends over the
+shared batching backplane, the generation-keyed decision cache, and the
+HTTP hot-path overhaul.
+
+Covers:
+  * HTTP/1.1 keep-alive regression — two requests MUST reuse one
+    connection (the server answered HTTP/1.0 before the fix);
+  * `?timeout=` query hardening — duplicates, percent-encoding, bare
+    keys, junk;
+  * envelope fast-path encoding equivalence with the full encoder;
+  * decision cache: hits across uid churn, generation invalidation on
+    constraint updates, namespace-label invalidation, --log-denies
+    deny re-evaluation;
+  * backplane frame round-trip, deadline propagation, unreachable-
+    engine failure stance (both stances + the `backplane.engine` fault
+    point), frontend respawn, and the full Runtime smoke the CI
+    `serving` job boots.
+
+Every test runs under a hard SIGALRM timeout: a wedged socket must fail
+that test fast, not eat the CI budget.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import signal
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu.client import Backend, RegoDriver
+from gatekeeper_tpu.control.backplane import (
+    BackplaneClient,
+    BackplaneEngine,
+    BackplaneError,
+    FrontendServer,
+    default_socket_path,
+)
+from gatekeeper_tpu.control.webhook import (
+    DecisionCache,
+    MicroBatcher,
+    NamespaceLabelHandler,
+    ValidationHandler,
+    WebhookServer,
+    encode_envelope,
+    parse_timeout_query,
+)
+from gatekeeper_tpu.target import K8sValidationTarget
+from gatekeeper_tpu.utils.faults import FAULTS
+
+TARGET = "admission.k8s.gatekeeper.sh"
+PER_TEST_TIMEOUT_S = 120
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout_and_clean_faults():
+    def boom(signum, frame):  # pragma: no cover - only on a real hang
+        raise TimeoutError(
+            f"test exceeded the {PER_TEST_TIMEOUT_S}s hard timeout")
+
+    old = signal.signal(signal.SIGALRM, boom)
+    signal.alarm(PER_TEST_TIMEOUT_S)
+    FAULTS.reset()
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+        FAULTS.reset()
+
+
+def _policy_client():
+    client = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sneedowner"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sNeedOwner"}}},
+            "targets": [{"target": TARGET, "rego": """
+package k8sneedowner
+violation[{"msg": "no owner label"}] {
+  not input.review.object.metadata.labels.owner
+}
+"""}]},
+    })
+    return client
+
+
+def _need_owner_constraint(name="need-owner"):
+    return {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K8sNeedOwner", "metadata": {"name": name},
+            "spec": {}}
+
+
+def _review(name, labels=None, uid=None, timeout_s=None):
+    obj = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": name, "namespace": "d"}}
+    if labels:
+        obj["metadata"]["labels"] = labels
+    request = {"uid": uid or f"uid-{name}", "operation": "CREATE",
+               "kind": {"group": "", "version": "v1", "kind": "Pod"},
+               "name": name, "namespace": "d",
+               "userInfo": {"username": "plane"}, "object": obj}
+    if timeout_s is not None:
+        request["timeoutSeconds"] = timeout_s
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": request}
+
+
+def _post(conn, path, review):
+    conn.request("POST", path, json.dumps(review),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp, json.loads(resp.read())
+
+
+# --------------------------------------------------- satellite: keep-alive
+
+
+def test_keepalive_two_requests_reuse_one_connection():
+    """Regression: the Handler must answer HTTP/1.1 — as HTTP/1.0 the
+    server closes after every response despite its keep-alive comments,
+    doubling connection + thread churn on the API server hot path."""
+    server = WebhookServer(None, NamespaceLabelHandler(()), port=0)
+    server.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        review = {"apiVersion": "admission.k8s.io/v1",
+                  "kind": "AdmissionReview",
+                  "request": {"uid": "ka-1", "object": {
+                      "metadata": {"name": "ns1"}}}}
+        resp, out = _post(conn, "/v1/admitlabel", review)
+        assert resp.version == 11, "server must answer HTTP/1.1"
+        assert not resp.will_close, "server closed a keep-alive connection"
+        sock_before = conn.sock
+        assert sock_before is not None
+        resp, out = _post(conn, "/v1/admitlabel", review)
+        assert out["response"]["uid"] == "ka-1"
+        assert conn.sock is sock_before, \
+            "second request did not reuse the connection"
+    finally:
+        server.stop(drain_timeout=1.0)
+
+
+# ---------------------------------------------- satellite: ?timeout= query
+
+
+def test_parse_timeout_query_tolerates_the_wild():
+    assert parse_timeout_query("timeout=5s") == 5.0
+    # duplicates: first parseable wins
+    assert parse_timeout_query("timeout=5s&timeout=10s") == 5.0
+    assert parse_timeout_query("timeout=&timeout=3s") == 3.0
+    # percent-encoding decodes ('1m10s' with encoded 'm'; encoded '.')
+    assert parse_timeout_query("timeout=1%6D10s") == 70.0
+    assert parse_timeout_query("timeout=2%2E5") == 2.5
+    # bare keys / junk / absence never raise
+    assert parse_timeout_query("timeout") is None
+    assert parse_timeout_query("&&=&timeout&x") is None
+    assert parse_timeout_query("") is None
+    assert parse_timeout_query("a=b&c") is None
+    assert parse_timeout_query("timeout=bogus") is None
+    # zero/negative budgets are not budgets
+    assert parse_timeout_query("timeout=0s") is None
+
+
+def test_http_timeout_query_reaches_the_deadline(monkeypatch):
+    """End-to-end: a duplicate + percent-encoded query string still
+    lands in request.timeoutSeconds through the real HTTP server."""
+    seen = {}
+
+    class Probe:
+        batcher = MicroBatcher(None, evaluate=lambda reviews:
+                               [[] for _ in reviews])
+
+        def handle(self, review):
+            seen["timeout"] = review["request"].get("timeoutSeconds")
+            return {"apiVersion": review.get("apiVersion"),
+                    "kind": review.get("kind"),
+                    "response": {"uid": "p", "allowed": True}}
+
+    server = WebhookServer(Probe(), None, port=0)
+    server.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        _post(conn, "/v1/admit?timeout=7%73&timeout=30s",
+              _review("q1"))
+        assert seen["timeout"] == 7.0
+    finally:
+        server.stop(drain_timeout=1.0)
+
+
+# ------------------------------------------------- envelope fast encoding
+
+
+def test_encode_envelope_matches_full_encoder():
+    cases = [
+        {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+         "response": {"uid": "5f0e-11:d.x_Y", "allowed": True}},
+        {"apiVersion": "admission.k8s.io/v1beta1",
+         "kind": "AdmissionReview",
+         "response": {"uid": "u", "allowed": False,
+                      "status": {"code": 403, "reason": 'msg "quoted" \\'}}},
+        # exotic uid must take the fallback, not break JSON
+        {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+         "response": {"uid": 'u"\n', "allowed": True}},
+        # extra keys (patch) take the fallback
+        {"apiVersion": None, "kind": None,
+         "response": {"uid": "u", "allowed": True, "patchType": "JSONPatch",
+                      "patch": "W10="}},
+    ]
+    for env in cases:
+        assert json.loads(encode_envelope(env)) == env
+
+
+# ------------------------------------------------------- decision cache
+
+
+def test_decision_cache_hits_across_uid_churn():
+    client = _policy_client()
+    client.add_constraint(_need_owner_constraint())
+    handler = ValidationHandler(client, kube=None,
+                                batcher=MicroBatcher(client,
+                                                     max_wait=0.001))
+    out1 = handler.handle(_review("p1", {"owner": "me"}, uid="u-1"))
+    out2 = handler.handle(_review("p1", {"owner": "me"}, uid="u-2"))
+    assert out1["response"]["allowed"] and out2["response"]["allowed"]
+    # same object, different uid: one evaluation, one hit — and each
+    # response carries ITS OWN uid
+    assert handler.cache.hits == 1
+    assert out1["response"]["uid"] == "u-1"
+    assert out2["response"]["uid"] == "u-2"
+    # a denied object caches too (no --log-denies here)
+    handler.handle(_review("bad", None, uid="u-3"))
+    out = handler.handle(_review("bad", None, uid="u-4"))
+    assert out["response"]["allowed"] is False
+    assert handler.cache.hits == 2
+    handler.batcher.stop()
+
+
+def test_decision_cache_invalidated_by_constraint_update():
+    """The acceptance case: a cached ALLOW must flip to DENY after a
+    constraint lands and bumps the library generation."""
+    client = _policy_client()  # template only: no constraint yet
+    handler = ValidationHandler(client, kube=None,
+                                batcher=MicroBatcher(client,
+                                                     max_wait=0.001))
+    out = handler.handle(_review("pod-a", None, uid="u-1"))
+    assert out["response"]["allowed"] is True
+    # cached: an identical retry is served without evaluation
+    out = handler.handle(_review("pod-a", None, uid="u-2"))
+    assert handler.cache.hits == 1
+    gen_before = client.generation
+    client.add_constraint(_need_owner_constraint())
+    assert client.generation > gen_before
+    out = handler.handle(_review("pod-a", None, uid="u-3"))
+    assert out["response"]["allowed"] is False, \
+        "stale cached allow served after a constraint update"
+    # and removing the constraint flips it back (another bump)
+    client.remove_constraint(_need_owner_constraint())
+    out = handler.handle(_review("pod-a", None, uid="u-4"))
+    assert out["response"]["allowed"] is True
+    handler.batcher.stop()
+
+
+def test_decision_cache_ns_label_key():
+    ns = {"metadata": {"name": "d", "labels": {"env": "prod"}}}
+    ns2 = {"metadata": {"name": "d", "labels": {"env": "dev"}}}
+    assert DecisionCache.ns_key(ns) != DecisionCache.ns_key(ns2)
+    # the WHOLE namespace object keys the cache: policies can match on
+    # annotations (or anything else the sideload carries), not labels
+    # alone
+    ns3 = {"metadata": {"name": "d", "labels": {"env": "prod"},
+                        "annotations": {"owner": "x"}}}
+    assert DecisionCache.ns_key(ns) != DecisionCache.ns_key(ns3)
+    assert DecisionCache.ns_key(None) == b""
+    # uid and timeoutSeconds are noise; object content is signal
+    r = _review("x", {"owner": "me"})["request"]
+    r2 = dict(r, uid="other", timeoutSeconds=3)
+    assert DecisionCache.request_key(r) == DecisionCache.request_key(r2)
+    r3 = _review("x", {"owner": "you"})["request"]
+    assert DecisionCache.request_key(r) != DecisionCache.request_key(r3)
+
+
+def test_decision_cache_log_denies_reevaluates_denials():
+    """--log-denies: every denial must re-evaluate (and so re-log);
+    allows still serve from the cache."""
+    client = _policy_client()
+    client.add_constraint(_need_owner_constraint())
+    handler = ValidationHandler(client, kube=None, log_denies=True,
+                                batcher=MicroBatcher(client,
+                                                     max_wait=0.001))
+    for uid in ("a", "b"):
+        out = handler.handle(_review("bad", None, uid=uid))
+        assert out["response"]["allowed"] is False
+    assert handler.cache.hits == 0  # denials never hit under log_denies
+    for uid in ("c", "d"):
+        handler.handle(_review("ok", {"owner": "me"}, uid=uid))
+    assert handler.cache.hits == 1  # allows still do
+    handler.batcher.stop()
+
+
+def test_decision_cache_lru_bound():
+    cache = DecisionCache(size=4)
+    for i in range(10):
+        cache.put((bytes([i]), 0, 0), {"allowed": True})
+    assert len(cache) == 4
+
+
+# ------------------------------------------------------ backplane plumbing
+
+
+def _plane(validation=None, ns_label=None, mutation=None,
+           fail_closed=False):
+    sock = default_socket_path() + ".t"
+    engine = BackplaneEngine(sock, validation=validation,
+                             ns_label=ns_label, mutation=mutation)
+    engine.start()
+    client = BackplaneClient(sock, worker_id="test")
+    frontend = FrontendServer(client, port=0, addr="127.0.0.1",
+                              fail_closed=fail_closed)
+    frontend.start()
+    return engine, client, frontend
+
+
+def test_backplane_roundtrip_and_404():
+    client = _policy_client()
+    client.add_constraint(_need_owner_constraint())
+    validation = ValidationHandler(
+        client, kube=None, batcher=MicroBatcher(client, max_wait=0.001))
+    engine, bc, fe = _plane(validation=validation,
+                            ns_label=NamespaceLabelHandler(()))
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=10)
+        _, out = _post(conn, "/v1/admit?timeout=5s",
+                       _review("ok", {"owner": "me"}))
+        assert out["response"]["allowed"] is True
+        _, out = _post(conn, "/v1/admit", _review("bad"))
+        assert out["response"]["allowed"] is False
+        assert "no owner label" in out["response"]["status"]["reason"]
+        _, out = _post(conn, "/v1/admitlabel", _review("ns"))
+        assert out["response"]["allowed"] is True
+        # mutation is NOT served by this plane: 404 locally, no hop
+        conn.request("POST", "/v1/mutate", json.dumps(_review("m")),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 404
+    finally:
+        fe.stop(drain_timeout=1.0)
+        engine.stop(drain_timeout=1.0)
+
+
+def test_backplane_deadline_propagates_to_engine():
+    """A 1s ?timeout= rides the frame: the engine answers per the
+    failure stance BEFORE the budget expires, even when evaluation
+    stalls far longer."""
+    stall = threading.Event()
+
+    def evaluate(reviews):
+        stall.wait(10.0)
+        return [[] for _ in reviews]
+
+    batcher = MicroBatcher(None, max_wait=0.001, evaluate=evaluate)
+    validation = ValidationHandler(_policy_client(), kube=None,
+                                   batcher=batcher)
+    engine, bc, fe = _plane(validation=validation)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=10)
+        t0 = time.monotonic()
+        _, out = _post(conn, "/v1/admit?timeout=1s", _review("slow"))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, "verdict landed after the 1s budget"
+        assert out["response"]["allowed"] is True  # fail-open
+        assert out["response"]["status"]["code"] == 504
+    finally:
+        stall.set()
+        fe.stop(drain_timeout=1.0)
+        engine.stop(drain_timeout=1.0)
+
+
+@pytest.mark.parametrize("fail_closed", [False, True])
+def test_engine_unreachable_answers_per_stance(fail_closed):
+    bc = BackplaneClient(default_socket_path() + ".gone")
+    fe = FrontendServer(bc, port=0, addr="127.0.0.1",
+                        fail_closed=fail_closed)
+    fe.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=10)
+        resp, out = _post(conn, "/v1/admit", _review("x", uid="want-uid"))
+        assert resp.status == 200
+        assert out["response"]["allowed"] is (not fail_closed)
+        assert out["response"]["status"]["code"] == 503
+        # uid recovered by the lazy parse so the API server can match
+        # the response to its request
+        assert out["response"]["uid"] == "want-uid"
+    finally:
+        fe.stop(drain_timeout=1.0)
+
+
+def test_backplane_engine_fault_point():
+    """Arming backplane.engine makes a HEALTHY plane answer per the
+    stance (chaos hook); disarming restores real verdicts."""
+    client = _policy_client()
+    client.add_constraint(_need_owner_constraint())
+    validation = ValidationHandler(
+        client, kube=None, batcher=MicroBatcher(client, max_wait=0.001))
+    engine, bc, fe = _plane(validation=validation)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=10)
+        FAULTS.inject("backplane.engine", mode="error")
+        _, out = _post(conn, "/v1/admit", _review("bad"))
+        assert out["response"]["allowed"] is True  # stance, not verdict
+        assert out["response"]["status"]["code"] == 503
+        assert FAULTS.fired("backplane.engine") == 1
+        FAULTS.clear("backplane.engine")
+        _, out = _post(conn, "/v1/admit", _review("bad2"))
+        assert out["response"]["allowed"] is False  # real verdict again
+    finally:
+        fe.stop(drain_timeout=1.0)
+        engine.stop(drain_timeout=1.0)
+
+
+def test_frontend_forward_stats_reach_engine_metrics():
+    from gatekeeper_tpu.control import metrics as gm
+
+    client = _policy_client()
+    validation = ValidationHandler(
+        client, kube=None, batcher=MicroBatcher(client, max_wait=0.001))
+    engine, bc, fe = _plane(validation=validation)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=10)
+        for i in range(3):
+            _post(conn, "/v1/admit", _review(f"s{i}", {"owner": "x"}))
+        stats = fe.stats.drain("test")
+        assert stats is not None and stats["count"] == 3
+        bc.send_stats(stats)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            text = gm.REGISTRY.render()
+            if "gatekeeper_tpu_backplane_forward_duration_seconds_count" \
+                    in text and 'worker="test"' in text:
+                break
+            time.sleep(0.05)
+        assert 'worker="test"' in gm.REGISTRY.render()
+    finally:
+        fe.stop(drain_timeout=1.0)
+        engine.stop(drain_timeout=1.0)
+
+
+# ------------------------------------------------- full runtime (CI smoke)
+
+
+def test_serving_plane_runtime_smoke():
+    """What the CI `serving` job boots: a Runtime with 2 pre-forked
+    frontend processes + the engine, round-tripping admit / mutate /
+    admitlabel through real subprocesses, then draining cleanly."""
+    from gatekeeper_tpu.control.main import Runtime, build_parser
+
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--disable-cert-rotation", "--health-addr", ":0",
+        "--operation", "webhook", "--operation", "mutation-webhook",
+        "--admission-workers", "2"])
+    rt = Runtime(args)
+    rt.args.metrics_backend = "none"
+    rt.start()
+    try:
+        assert rt.webhook is None and rt.backplane is not None
+        deadline = time.monotonic() + 10
+        while rt.backplane.connected < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert rt.backplane.connected == 2
+        assert rt.frontends.alive()
+        conn = http.client.HTTPConnection("127.0.0.1", rt.frontends.port,
+                                          timeout=15)
+        for path in ("/v1/admit", "/v1/admitlabel", "/v1/mutate"):
+            _, out = _post(conn, path + "?timeout=10s", _review("rt"))
+            assert out["response"]["allowed"] is True, path
+            assert out["response"]["uid"] == "uid-rt"
+    finally:
+        rt.stop()
+    assert not rt.frontends.alive()
+
+
+def test_supervisor_respawns_dead_frontend():
+    from gatekeeper_tpu.control.main import Runtime, build_parser
+
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--disable-cert-rotation", "--health-addr", ":0",
+        "--operation", "webhook", "--admission-workers", "2"])
+    rt = Runtime(args)
+    rt.args.metrics_backend = "none"
+    rt.start()
+    try:
+        victim = rt.frontends._procs[0]
+        victim.kill()
+        victim.wait(10)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if rt.frontends.alive() and \
+                    rt.frontends._procs[0] is not victim:
+                break
+            time.sleep(0.1)
+        assert rt.frontends.alive(), "supervisor did not respawn"
+        # the respawned worker serves
+        conn = http.client.HTTPConnection("127.0.0.1", rt.frontends.port,
+                                          timeout=15)
+        for i in range(4):  # hit both workers' accept queues
+            _, out = _post(conn, "/v1/admit", _review(f"r{i}"))
+            assert out["response"]["allowed"] is True
+            conn.close()
+    finally:
+        rt.stop()
