@@ -29,7 +29,9 @@ import time
 from typing import Callable, Optional
 
 from ..client import Client
+from ..utils import profiling
 from . import metrics
+from . import trace as gtrace
 from .kube import GVK, KubeError, NotFound, WatchEvent
 from .logging import logger
 from .util import prune_stale_by_pod
@@ -694,26 +696,70 @@ class AuditManager:
     def audit_once(self) -> list:
         t0 = time.time()
         self.heartbeat = time.monotonic()
+        # every sweep is traced (a handful of span objects per minute):
+        # the audit plane's flight-recorder entries and per-phase
+        # histograms exist regardless of the admission sample rate.
+        # The driver's internals accumulate into the process-global
+        # PhaseTimers; the snapshot diff below turns this sweep's
+        # encode / device_sweep / materialize / interp_eval /
+        # delta_serve time into trace phases.
+        tr = gtrace.TRACER.start(gtrace.AUDIT, force=True)
+        try:
+            return self._audit_once_traced(tr, t0)
+        except BaseException as e:
+            # a failing sweep must still land in the flight recorder —
+            # the sweeps that error (API outage, eval blowup) are
+            # exactly the ones worth diagnosing after the fact
+            tr.set_status("error")
+            tr.set_attr("error", str(e))
+            raise
+        finally:
+            tr.finish()
+
+    def _audit_once_traced(self, tr, t0: float) -> list:
+        timers = profiling.timers()
+        phases0 = timers.snapshot()
         sweep_stats: dict = {}
+        t_ev0 = time.monotonic()
         if self.incremental:
-            results, sweep_stats = self._audit_incremental()
+            results, sweep_stats = self._audit_incremental(tr)
+            ev_wall = sweep_stats.pop("_eval_wall_s", 0.0)
         elif self.audit_from_cache:
             # one vectorized sweep over the synced inventory
             results = self.opa.audit().results()
+            ev_wall = time.monotonic() - t_ev0
             metrics.report_audit_sweep("full")
         else:
             results = self._audit_resources()
+            ev_wall = time.monotonic() - t_ev0
             metrics.report_audit_sweep("full")
+        # phase attribution, double-count-free: when the driver
+        # instrumented its internals (encode / device_sweep /
+        # materialize / interp_eval / delta_serve — all inside the
+        # evaluation wall), the trace records THOSE plus the
+        # uncovered remainder as evaluate_other, so stages sum to the
+        # sweep. An uninstrumented driver records one aggregate
+        # evaluate span instead.
+        phases = profiling.PhaseTimers.diff(phases0, timers.snapshot())
+        if phases:
+            for name, secs in sorted(phases.items()):
+                tr.add_phase(name, secs)
+            residual = ev_wall - sum(phases.values())
+            if residual > 1e-6:
+                tr.add_phase("evaluate_other", residual)
+        elif ev_wall > 0:
+            tr.add_phase("evaluate", ev_wall)
         by_constraint = self._group_by_constraint(results)
         # delta'd status writes are an INCREMENTAL-mode behavior: the
         # discovery and from-cache modes keep upstream semantics (every
         # sweep rewrites every status, refreshing auditTimestamp). In
         # incremental mode, full-resync sweeps force every write so the
         # timestamp still refreshes every full_resync_every intervals
-        writes = self._write_audit_results(
-            by_constraint,
-            force=not self.incremental
-            or sweep_stats.get("sweep") == "full_resync")
+        with tr.span("status_writes"):
+            writes = self._write_audit_results(
+                by_constraint,
+                force=not self.incremental
+                or sweep_stats.get("sweep") == "full_resync")
         dt = time.time() - t0
         metrics.report_audit_duration(dt)
         metrics.report_audit_last_run()
@@ -743,10 +789,18 @@ class AuditManager:
                 else "last_review_batch_path", None)
             if path:
                 details["audit_path"] = path
+        tr.set_status(sweep_stats.get("sweep") or "full")
+        tr.set_attr("violations", len(results))
+        for k in ("dirty", "inventory"):
+            if k in sweep_stats:
+                tr.set_attr(k, sweep_stats[k])
+        if "audit_path" in details:
+            tr.set_attr("audit_path", details["audit_path"])
+        # finish() runs in audit_once's finally, error or not
         log.info("audit complete", details=details)
         return results
 
-    def _audit_incremental(self) -> tuple[list, dict]:
+    def _audit_incremental(self, tr=gtrace.NOOP) -> tuple[list, dict]:
         """Delta sweep: apply the tracker's pending adds/updates/deletes
         to the persistent encoded inventory (the driver patches only the
         dirty rows), then run the vectorized cached audit. Every
@@ -761,25 +815,33 @@ class AuditManager:
             and self._sweeps % self.full_resync_every == 0)
         self._sweeps += 1
         t0 = time.time()
-        if full:
-            # drop BEFORE re-adding: with warm caches every re-add would
-            # run the per-object patch machinery whose work the drop
-            # then discards; cold caches make each write an early return
-            if hasattr(driver, "drop_inventory_caches"):
-                driver.drop_inventory_caches()
-            stats = self.tracker.full_resync(_auditable_gvks(self.kube))
-            metrics.report_audit_sweep("full_resync")
-        else:
-            stats = self.tracker.apply_pending()
-            metrics.report_audit_sweep("incremental")
+        with tr.span("list_delta_apply"):
+            if full:
+                # drop BEFORE re-adding: with warm caches every re-add
+                # would run the per-object patch machinery whose work
+                # the drop then discards; cold caches make each write
+                # an early return
+                if hasattr(driver, "drop_inventory_caches"):
+                    driver.drop_inventory_caches()
+                stats = self.tracker.full_resync(
+                    _auditable_gvks(self.kube))
+                metrics.report_audit_sweep("full_resync")
+            else:
+                stats = self.tracker.apply_pending()
+                metrics.report_audit_sweep("incremental")
         sync_s = time.time() - t0
+        t_ev0 = time.monotonic()
         results = self.opa.audit().results()
+        ev_wall = time.monotonic() - t_ev0
         grown = strtab.grown_since(snap) if strtab is not None else 0
         metrics.report_audit_dirty(stats["dirty"], stats["total"], grown)
         return results, {
             "sweep": "full_resync" if full else "incremental",
             "dirty": stats["dirty"], "inventory": stats["total"],
             "sync_s": round(sync_s, 3), "vocab_grown": grown,
+            # evaluation wall clock for the caller's phase attribution
+            # (popped before the stats reach the log line)
+            "_eval_wall_s": ev_wall,
         }
 
     def _audit_resources(self) -> list:

@@ -26,12 +26,24 @@ Wire protocol, length-prefixed frames over a Unix domain socket
 
     frame    := u32be payload_len, payload
     payload  := type(1 byte) + body
-    'Q'      := id u32be, timeout_s f64be (0 = absent), path_len u16be,
+    'Q'      := id u32be, timeout_s f64be (0 = absent), tflags u8,
+                [tflags&1: trace_id 16 bytes, t_recv f64be,
+                t_fwd f64be], path_len u16be,
                 path bytes, review bytes            (frontend -> engine)
     'R'      := id u32be, http_status u16be, body   (engine -> frontend)
     'H'      := hello JSON {"worker": id}           (frontend -> engine)
     'S'      := stats JSON (aggregated forward-latency histogram delta
-                + failure-stance answer count)      (frontend -> engine)
+                + failure-stance answer count + per-stage span-duration
+                histogram deltas for sampled requests) (frontend -> engine)
+
+Span context over the split: the FRONTEND makes the sampling decision
+at the HTTP edge (it parses `traceparent`, answers `X-Trace-Id`); a
+sampled request's Q frame carries the trace id plus the frontend's
+receive/forward monotonic instants (CLOCK_MONOTONIC is system-wide, so
+the engine compares them directly), and the engine reconstructs the
+frontend_parse and backplane_forward spans, then times its own stages.
+An UNSAMPLED request pays one zero byte on the wire and no span
+allocations anywhere.
 
 Resilience contract across the split:
   * deadlines propagate — the frame carries the request's timeout and
@@ -61,6 +73,7 @@ from typing import Callable, Optional
 
 from ..utils import faults
 from . import jsonio
+from . import trace as gtrace
 from .logging import logger
 from .webhook import (
     DEFAULT_WEBHOOK_TIMEOUT_S,
@@ -74,12 +87,24 @@ from .webhook import (
 log = logger("backplane")
 
 _Q_HEADER = struct.Struct("!Id")   # request id, timeout seconds
+_Q_TRACE = struct.Struct("!16sdd")  # trace id, t_recv, t_fwd (monotonic)
 _Q_PATHLEN = struct.Struct("!H")
 _R_HEADER = struct.Struct("!IH")   # request id, http status
 
 # frontends bucket forward latencies with the same bounds the engine
 # registry renders — one constant, no drift into mislabeled buckets
 from .metrics import FORWARD_BUCKETS as STATS_BUCKETS  # noqa: E402
+from .metrics import STAGE_BUCKETS  # noqa: E402
+
+
+def _bucket_observe(counts: list, bounds: tuple, seconds: float) -> None:
+    """Accumulate one observation into a local histogram delta
+    (counts carries len(bounds)+1 slots; the last is +Inf)."""
+    for i, b in enumerate(bounds):
+        if seconds <= b:
+            counts[i] += 1
+            return
+    counts[-1] += 1
 
 STATS_INTERVAL_S = 2.0
 # per-operation socket timeout on backplane I/O: a WEDGED (not dead)
@@ -272,6 +297,30 @@ class BackplaneEngine:
                 if kind == b"Q":
                     rid, timeout_s = _Q_HEADER.unpack_from(payload, 1)
                     off = 1 + _Q_HEADER.size
+                    tflags = payload[off]
+                    off += 1
+                    tr = gtrace.NOOP
+                    if tflags & 1:
+                        # sampled: reconstruct the frontend-side spans
+                        # from the carried span context (same-host
+                        # CLOCK_MONOTONIC). frontend_parse is remote —
+                        # the frontend ships its histogram delta over S
+                        # frames, so the engine's metrics sink must not
+                        # double it. backplane_forward (t_fwd -> frame
+                        # receipt) is timed HERE and histogrammed here:
+                        # it is the true one-way hop — the frontend
+                        # only knows its full call round trip, which
+                        # would re-count every engine stage
+                        tid, t_recv, t_fwd = _Q_TRACE.unpack_from(
+                            payload, off)
+                        off += _Q_TRACE.size
+                        tr = gtrace.TRACER.resume(gtrace.ADMISSION,
+                                                  tid.hex())
+                        tr.t0 = t_recv  # the trace starts at the edge
+                        tr.add_span("frontend_parse", t_recv, t_fwd,
+                                    remote=True)
+                        tr.add_span("backplane_forward", t_fwd,
+                                    time.monotonic())
                     (plen,) = _Q_PATHLEN.unpack_from(payload, off)
                     off += _Q_PATHLEN.size
                     path = payload[off:off + plen].decode("ascii", "replace")
@@ -288,7 +337,7 @@ class BackplaneEngine:
                     # reuses the already-parsed review).
                     try:
                         inline = self._try_inline(timeout_s, deadline,
-                                                  path, body)
+                                                  path, body, tr)
                     except Exception as e:
                         log.error("backplane inline serve error",
                                   details=str(e))
@@ -296,15 +345,20 @@ class BackplaneEngine:
                     if inline[0] != "eval":
                         # a failed/partial send desyncs the stream:
                         # close and let the frontend reconnect
+                        t_send = time.monotonic()
                         _send_frame(conn, wlock, b"R",
                                     _R_HEADER.pack(rid, inline[0]),
                                     inline[1])
+                        if tr.sampled:
+                            tr.add_span("respond", t_send,
+                                        time.monotonic())
+                            tr.finish()
                         continue
                     with self._inflight_lock:
                         self._inflight += 1
                     self._pool.submit(self._serve, conn, wlock, rid,
                                       timeout_s, deadline, path, body,
-                                      inline[1])
+                                      inline[1], tr, time.monotonic())
                 elif kind == b"H":
                     info = jsonio.loads(payload[1:]) or {}
                     worker = str(info.get("worker", "?"))
@@ -346,6 +400,16 @@ class BackplaneEngine:
         errs = int(stats.get("errors") or 0)
         if errs:
             metrics.report_backplane_error(worker, errs)
+        # frontend-side span deltas (sampled requests only): each
+        # frontend ships aggregated histograms for the stages it owns
+        # (frontend_parse) — the engine's trace sink skips those
+        # remote spans so they are counted exactly once
+        for stage, d in (stats.get("stages") or {}).items():
+            n = int(d.get("count") or 0)
+            if n:
+                metrics.report_stage_bucketed(
+                    "admission", str(stage), d.get("buckets") or [],
+                    float(d.get("sum") or 0.0), n)
 
     # serve ----------------------------------------------------------
 
@@ -367,7 +431,7 @@ class BackplaneEngine:
         return deadline
 
     def _try_inline(self, timeout_s: float, deadline: float, path: str,
-                    body: bytes) -> tuple:
+                    body: bytes, tr=gtrace.NOOP) -> tuple:
         """(status, payload) when the verdict needs no blocking work
         (cache hit / short-circuit / namespace-label check / 404);
         ("eval", parsed_review_or_None) hands it to the worker pool."""
@@ -389,12 +453,16 @@ class BackplaneEngine:
                 return (400, b"")
             eff_deadline = self._fold_timeout(review, timeout_s, deadline)
             out = self.validation.handle(review, deadline=eff_deadline,
-                                         fast=True)
+                                         fast=True, trace=tr)
             if out is None:
                 # cache miss: evaluation needs a thread; hand over the
                 # parsed review AND the folded deadline
                 return ("eval", (review, eff_deadline))
-            return (200, encode_envelope(out))
+            if not tr.sampled:
+                return (200, encode_envelope(out))
+            with tr.span("serialize"):
+                payload = encode_envelope(out)
+            return (200, payload)
         if route == "mutate":
             return ("eval", None) if self.mutation is not None \
                 else (404, b"")
@@ -402,13 +470,19 @@ class BackplaneEngine:
 
     def _serve(self, conn: socket.socket, wlock: threading.Lock,
                rid: int, timeout_s: float, deadline: float, path: str,
-               body: bytes, handoff=None) -> None:
+               body: bytes, handoff=None, tr=gtrace.NOOP,
+               t_queued: float = 0.0) -> None:
         review = None
         if handoff is not None:
             review, deadline = handoff
+        if tr.sampled:
+            # executor queue wait: frame receipt -> a pool thread
+            # actually picked the request up
+            tr.add_span("engine_queue", t_queued, time.monotonic())
         try:
             status, out = self._decide(timeout_s, deadline, path, body,
-                                       review=review)
+                                       review=review, tr=tr)
+            t_send = time.monotonic()
             try:
                 _send_frame(conn, wlock, b"R",
                             _R_HEADER.pack(rid, status), out)
@@ -420,12 +494,16 @@ class BackplaneEngine:
                     conn.close()
                 except OSError:
                     pass
+            if tr.sampled:
+                tr.add_span("respond", t_send, time.monotonic())
+                tr.finish()
         finally:
             with self._inflight_lock:
                 self._inflight -= 1
 
     def _decide(self, timeout_s: float, deadline: float, path: str,
-                body: bytes, review=None) -> tuple[int, bytes]:
+                body: bytes, review=None,
+                tr=gtrace.NOOP) -> tuple[int, bytes]:
         if review is None:
             try:
                 review = jsonio.loads(body)
@@ -439,12 +517,18 @@ class BackplaneEngine:
             if route == "admitlabel" and self.ns_label is not None:
                 out = self.ns_label.handle(review)
             elif route == "admit" and self.validation is not None:
-                out = self.validation.handle(review, deadline=deadline)
+                out = self.validation.handle(review, deadline=deadline,
+                                             trace=tr)
             elif route == "mutate" and self.mutation is not None:
-                out = self.mutation.handle(review, deadline=deadline)
+                out = self.mutation.handle(review, deadline=deadline,
+                                           trace=tr)
             else:
                 return 404, b""
-            return 200, encode_envelope(out)
+            if not tr.sampled:
+                return 200, encode_envelope(out)
+            with tr.span("serialize"):
+                payload = encode_envelope(out)
+            return 200, payload
         except Exception as e:  # handlers answer their own errors; this
             # is the backstop for anything outside them
             log.error("backplane serve error", details=str(e))
@@ -565,11 +649,17 @@ class BackplaneClient:
     # calls ----------------------------------------------------------
 
     def call(self, path: str, body: bytes, timeout_s: float,
-             deadline: float) -> tuple[int, bytes]:
+             deadline: float,
+             trace_ctx: Optional[tuple] = None) -> tuple[int, bytes]:
         """Forward one review; returns (http_status, response_bytes).
         Raises BackplaneError when the engine is unreachable, the
         connection dies mid-flight, or no verdict lands by `deadline`
-        (+ grace) — the caller answers per the failure stance."""
+        (+ grace) — the caller answers per the failure stance.
+
+        `trace_ctx` = (trace_id_hex, t_recv_monotonic) for a SAMPLED
+        request: the span context rides the Q frame (t_fwd is stamped
+        here, just before the send) so the engine reconstructs the
+        frontend-side spans."""
         try:
             faults.fire("backplane.engine")
         except BackplaneError:
@@ -580,6 +670,16 @@ class BackplaneClient:
             # per the failure stance instead of dropping the socket
             raise BackplaneError(f"injected engine fault: {e}") from e
         sock = self._ensure_connected()
+        # trace block built BEFORE the waiter registers: nothing
+        # between registration and the send may raise anything but the
+        # handled OSError, or the pending entry leaks forever
+        if trace_ctx is None:
+            tblock = b"\x00"
+        else:
+            tid_hex, t_recv = trace_ctx
+            tblock = b"\x01" + _Q_TRACE.pack(
+                bytes.fromhex(tid_hex)[:16].ljust(16, b"\x00"),
+                t_recv, time.monotonic())
         waiter = _Waiter()
         with self._pending_lock:
             self._next_id = (self._next_id + 1) & 0xFFFFFFFF
@@ -587,7 +687,7 @@ class BackplaneClient:
             self._pending[rid] = waiter
         try:
             _send_frame(sock, self._wlock, b"Q",
-                        _Q_HEADER.pack(rid, timeout_s or 0.0),
+                        _Q_HEADER.pack(rid, timeout_s or 0.0), tblock,
                         _Q_PATHLEN.pack(len(path)), path.encode("ascii"),
                         body)
         except OSError as e:
@@ -622,7 +722,8 @@ class BackplaneClient:
 
 
 class _StatsAccumulator:
-    """Forward-latency histogram + failure-stance counter, accumulated
+    """Forward-latency histogram + failure-stance counter + per-stage
+    span-duration histograms (sampled requests only), accumulated
     locally and shipped to the engine as periodic deltas."""
 
     def __init__(self):
@@ -631,17 +732,26 @@ class _StatsAccumulator:
         self._sum = 0.0
         self._n = 0
         self._errors = 0
+        # stage -> [bucket_counts, sum, n] over metrics.STAGE_BUCKETS:
+        # the frontend-side spans of SAMPLED requests, merged into
+        # gatekeeper_tpu_stage_duration_seconds engine-side
+        self._stages: dict[str, list] = {}
 
     def observe(self, seconds: float) -> None:
         with self._lock:
-            for i, b in enumerate(STATS_BUCKETS):
-                if seconds <= b:
-                    self._counts[i] += 1
-                    break
-            else:
-                self._counts[-1] += 1
+            _bucket_observe(self._counts, STATS_BUCKETS, seconds)
             self._sum += seconds
             self._n += 1
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            ent = self._stages.get(stage)
+            if ent is None:
+                ent = self._stages[stage] = [
+                    [0] * (len(STAGE_BUCKETS) + 1), 0.0, 0]
+            _bucket_observe(ent[0], STAGE_BUCKETS, seconds)
+            ent[1] += seconds
+            ent[2] += 1
 
     def error(self) -> None:
         with self._lock:
@@ -649,11 +759,17 @@ class _StatsAccumulator:
 
     def drain(self, worker: str) -> Optional[dict]:
         with self._lock:
-            if not self._n and not self._errors:
+            if not self._n and not self._errors and not self._stages:
                 return None
             out = {"worker": worker, "buckets": self._counts,
                    "sum": round(self._sum, 6), "count": self._n,
                    "errors": self._errors}
+            if self._stages:
+                out["stages"] = {
+                    stage: {"buckets": ent[0],
+                            "sum": round(ent[1], 6), "count": ent[2]}
+                    for stage, ent in self._stages.items()}
+                self._stages = {}
             self._counts = [0] * (len(STATS_BUCKETS) + 1)
             self._sum = 0.0
             self._n = 0
@@ -702,12 +818,19 @@ class FrontendServer:
         route = route_path(path)
         return route if route in self.serve else None
 
-    def _dispatch(self, path: str, body: bytes) -> tuple:
+    def _dispatch(self, path: str, body: bytes,
+                  traceparent: Optional[str] = None) -> tuple:
+        t_recv = time.monotonic()
         route = self._route(path)
         if route is None:
             # un-served endpoints 404 LOCALLY: no backplane hop for an
             # operation the operator turned off
             return 404, b""
+        # the frontend is the sampling edge: it decides, forwards the
+        # span context over the Q frame, and answers X-Trace-Id. The
+        # engine owns the flight recorder; the frontend only ships its
+        # own two stages as aggregated S-frame deltas.
+        tid = gtrace.TRACER.sample_context(traceparent)
         timeout_s = parse_timeout_query(path.partition("?")[2]) or 0.0
         if timeout_s > 0:
             deadline = request_deadline({"timeoutSeconds": timeout_s},
@@ -721,13 +844,26 @@ class FrontendServer:
             deadline = time.monotonic() + MAX_WEBHOOK_TIMEOUT_S
         t0 = time.monotonic()
         try:
-            status, payload = self.client.call(path, body, timeout_s,
-                                               deadline)
-            self.stats.observe(time.monotonic() - t0)
-            return status, payload
+            status, payload = self.client.call(
+                path, body, timeout_s, deadline,
+                trace_ctx=None if tid is None else (tid, t_recv))
+            now = time.monotonic()
+            self.stats.observe(now - t0)
+            if tid is None:
+                return status, payload
+            # ship ONLY the stage this process truly owns: the forward
+            # hop and every engine stage are timed (and histogrammed)
+            # engine-side — shipping the call round trip as a stage
+            # would re-count all of them under one label
+            self.stats.observe_stage("frontend_parse", t0 - t_recv)
+            return status, payload, {"X-Trace-Id": tid}
         except BackplaneError as e:
             self.stats.error()
-            return 200, self._stance_envelope(route, body, str(e))
+            out = 200, self._stance_envelope(route, body, str(e))
+            # a stance answer still reports its trace id: the id is in
+            # the caller's hands (and logs) even though the engine
+            # never saw the request
+            return out if tid is None else (*out, {"X-Trace-Id": tid})
 
     def _stance_envelope(self, route: str, body: bytes,
                          message: str) -> bytes:
@@ -796,8 +932,10 @@ class FrontendSupervisor:
                  fail_closed: bool = False,
                  mutation_fail_closed: Optional[bool] = None,
                  default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S,
-                 ready_timeout: float = 30.0):
+                 ready_timeout: float = 30.0,
+                 trace_sample_rate: float = 0.0):
         self.n = n
+        self.trace_sample_rate = trace_sample_rate
         self.socket_path = socket_path
         self.addr = addr
         self.certfile = certfile
@@ -830,7 +968,8 @@ class FrontendSupervisor:
                "--addr", self.addr,
                "--worker-id", str(k),
                "--serve", ",".join(self.serve),
-               "--default-timeout", str(self.default_timeout)]
+               "--default-timeout", str(self.default_timeout),
+               "--trace-sample-rate", str(self.trace_sample_rate)]
         if self.certfile:
             cmd += ["--certfile", self.certfile]
             if self.keyfile:
@@ -964,8 +1103,15 @@ def frontend_main(argv=None) -> int:
                         "inherits --fail-closed")
     p.add_argument("--default-timeout", type=float,
                    default=DEFAULT_WEBHOOK_TIMEOUT_S)
+    p.add_argument("--trace-sample-rate", type=float, default=0.0,
+                   help="fraction of requests traced at this edge "
+                        "(stride-sampled; an inbound sampled "
+                        "traceparent always traces)")
     p.add_argument("--no-reuse-port", action="store_true")
     args = p.parse_args(argv)
+    # the frontend is a sampling edge only — span context forwards to
+    # the engine, which owns the recorder/metrics sinks
+    gtrace.TRACER.configure(args.trace_sample_rate)
     client = BackplaneClient(args.socket, worker_id=args.worker_id)
     server = FrontendServer(
         client, port=args.port, addr=args.addr,

@@ -42,9 +42,14 @@ class HealthServer:
     def __init__(self, host: str, port: int):
         self._checks: dict[str, Callable[[], bool]] = {}
         self._live: dict[str, Callable[[], bool]] = {}
+        # /debug/<name> providers (shared registry with the metrics
+        # server — an audit-only pod without a scrape port still
+        # exposes its flight recorder through the health port)
+        self._debug: dict[str, Callable] = {}
         self._lock = threading.Lock()
         checks = self._checks
         live = self._live
+        debug = self._debug
         lock = self._lock
 
         def failing(items) -> list[str]:
@@ -62,7 +67,17 @@ class HealthServer:
                 pass
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0].rstrip("/")
+                path, _, query = self.path.partition("?")
+                path = path.rstrip("/")
+                if path.startswith("/debug/"):
+                    with lock:
+                        providers = dict(debug)
+                    if providers:
+                        from .metrics import render_debug
+                        body, code = render_debug(
+                            providers, path[len("/debug/"):], query)
+                        self._reply(code, body, "application/json")
+                        return
                 if path == "/healthz":
                     # liveness watchdog: a wedged flusher/audit loop
                     # fails liveness so k8s restarts the pod (a process
@@ -90,9 +105,10 @@ class HealthServer:
                     return
                 self._reply(404, b"not found")
 
-            def _reply(self, code: int, body: bytes):
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "text/plain"):
                 self.send_response(code)
-                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -114,6 +130,13 @@ class HealthServer:
         registered check fails, so the kubelet restarts a wedged pod."""
         with self._lock:
             self._live[name] = check
+
+    def add_debug(self, name: str, provider: Callable) -> None:
+        """Mount a /debug/<name> provider (same callable contract as
+        metrics.serve's debug_providers: raw query string in, JSON-
+        serializable object out)."""
+        with self._lock:
+            self._debug[name] = provider
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -15,6 +15,7 @@ import argparse
 import signal
 import sys
 import threading
+import time
 
 from ..client import Backend
 from ..ir import TpuDriver
@@ -22,6 +23,7 @@ from ..target import K8sValidationTarget
 from . import health
 from . import logging as glog
 from . import metrics
+from . import trace as gtrace
 from .audit import (
     DEFAULT_AUDIT_INTERVAL,
     DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT,
@@ -218,6 +220,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="namespace for the leader lease and status "
                         "bookkeeping (downward-API metadata.namespace; "
                         "falls back to $POD_NAMESPACE)")
+    p.add_argument("--trace-sample-rate", type=float, default=0.01,
+                   help="fraction of admission requests traced end to "
+                        "end (stride-sampled; near-zero hot-path cost "
+                        "when unsampled). Sampled requests answer "
+                        "X-Trace-Id, decompose into per-stage spans in "
+                        "gatekeeper_tpu_stage_duration_seconds, and "
+                        "land in the /debug/traces flight recorder. An "
+                        "inbound W3C traceparent with the sampled flag "
+                        "always traces. Audit sweeps are always traced. "
+                        "0 disables admission tracing")
+    p.add_argument("--trace-slow-threshold", type=float, default=1.0,
+                   help="seconds beyond which a completed trace also "
+                        "logs a structured slow-request line with its "
+                        "full stage decomposition; <= 0 disables")
+    p.add_argument("--debug-endpoints", nargs="?", const=True,
+                   default=True, type=_parse_bool,
+                   help="serve /debug/traces (flight-recorder dump), "
+                        "/debug/templates (per-template compile state, "
+                        "quarantine, eval counts), and /debug/profile"
+                        "?seconds=N (arm a jax.profiler device-trace "
+                        "window) on the metrics and health ports")
     p.add_argument("--disable-cert-rotation", action="store_true")
     p.add_argument("--disable-enforcementaction-validation",
                    action="store_true")
@@ -253,6 +276,18 @@ class Runtime:
             FAULTS.configure(args.fault_injection)
             log.warning("fault injection armed",
                         details={"points": FAULTS.armed()})
+        # request tracing: the process-global tracer feeds the stage
+        # histograms, the flight recorder (/debug/traces), and the
+        # slow-request log. With --admission-workers > 1 the FRONTENDS
+        # are the sampling edge (the rate rides their spawn args); this
+        # engine-side tracer still samples the in-process server and
+        # records every audit sweep.
+        gtrace.TRACER.configure(
+            getattr(args, "trace_sample_rate", 0.01),
+            getattr(args, "trace_slow_threshold", 1.0))
+        # a debug profile window must not run twice concurrently
+        self._profile_until = 0.0
+        self._profile_lock = threading.Lock()
         # HA: Lease-based leader election — only the lease holder runs
         # the audit sweep and the in-cluster status/CRD/cert writers;
         # every replica serves admission. The elector itself talks to
@@ -413,7 +448,9 @@ class Runtime:
                     certfile=certfile, keyfile=keyfile,
                     serve=tuple(serve), fail_closed=fail_closed,
                     mutation_fail_closed=mut_fail_closed,
-                    default_timeout=default_timeout)
+                    default_timeout=default_timeout,
+                    trace_sample_rate=getattr(args, "trace_sample_rate",
+                                              0.01))
             else:
                 self.webhook = WebhookServer(
                     validation, ns_label, port=args.port,
@@ -536,6 +573,80 @@ class Runtime:
                         driver.encoded_rows_restore, blob=True),
                     name="rows-restore", daemon=True).start()
 
+    # ---------------------------------------------------- debug endpoints
+
+    def debug_providers(self) -> dict:
+        """The /debug/* registry mounted on BOTH the metrics and the
+        health servers: the flight-recorder dump, the per-template
+        compile/quarantine/eval-count state, and the device-profile
+        armer."""
+        return {
+            "traces": lambda q: gtrace.TRACER.recorder.dump(),
+            "templates": self._debug_templates,
+            "profile": self._debug_profile,
+        }
+
+    def _debug_templates(self, query: str) -> dict:
+        driver = getattr(self.opa, "driver", None)
+        if hasattr(driver, "templates_debug"):
+            return driver.templates_debug()
+        # interpreter-only driver (tests/embedders): still answer with
+        # the known template kinds rather than 500
+        return {"templates": {k: {"state": "interpreter"}
+                              for k in self.opa.template_kinds()}}
+
+    def _debug_profile(self, query: str) -> dict:
+        """Arm a jax.profiler device-trace window (?seconds=N, capped):
+        the TPU-native pprof analog — the resulting trace directory
+        opens in TensorBoard/Perfetto with the device timeline."""
+        from urllib.parse import parse_qsl
+        seconds = 5.0
+        for k, v in parse_qsl(query, keep_blank_values=True):
+            if k == "seconds":
+                try:
+                    seconds = float(v)
+                except ValueError:
+                    pass
+        # capped at 30s: the window thread is deliberately NON-daemon
+        # (a daemon profiler thread skips the profiler's thread-state
+        # teardown and the interpreter segfaults at exit), so the cap
+        # bounds how long an in-flight window can delay process exit —
+        # strictly UNDER the manifests' 60s terminationGracePeriodSeconds
+        # so a window armed right before pod deletion still leaves the
+        # SIGTERM drain room to finish before the kubelet SIGKILLs
+        seconds = min(max(seconds, 0.5), 30.0)
+        with self._profile_lock:
+            now = time.monotonic()
+            if now < self._profile_until:
+                return {"armed": False,
+                        "error": "a profile window is already running",
+                        "remaining_s": round(self._profile_until - now,
+                                             1)}
+            self._profile_until = now + seconds
+        import tempfile
+        log_dir = tempfile.mkdtemp(prefix="gatekeeper-tpu-trace-")
+
+        def run():
+            try:
+                from ..utils.profiling import device_trace
+                with device_trace(log_dir):
+                    time.sleep(seconds)
+                log.info("device profile window captured",
+                         details={"log_dir": log_dir,
+                                  "seconds": seconds})
+            except Exception as e:
+                log.error("device profile window failed",
+                          details=str(e))
+            finally:
+                with self._profile_lock:
+                    self._profile_until = 0.0
+
+        threading.Thread(target=run, name="debug-profile",
+                         daemon=False).start()
+        return {"armed": True, "seconds": seconds, "log_dir": log_dir,
+                "viewer": "tensorboard --logdir <log_dir> (or load the "
+                          "trace in Perfetto) for the device timeline"}
+
     def snapshot_now(self) -> None:
         """Force an immediate snapshot (SIGHUP): runs off-thread, safe
         from a signal context; save_now serializes concurrent passes."""
@@ -569,9 +680,12 @@ class Runtime:
             self.kube.register_kind(gvk, namespaced=namespaced)
 
     def start(self) -> None:
+        debug = (self.debug_providers()
+                 if getattr(self.args, "debug_endpoints", True) else None)
         if self.args.metrics_backend == "prometheus":
             try:
-                self.metrics_server = metrics.serve(self.args.prometheus_port)
+                self.metrics_server = metrics.serve(
+                    self.args.prometheus_port, debug_providers=debug)
             except OSError as e:
                 log.warning("metrics port unavailable", details=str(e))
         # healthz/readyz on --health-addr (reference main.go:205-212)
@@ -636,6 +750,11 @@ class Runtime:
                     # Ready and serve admission.
                     self.health.add_readiness("leader-elector",
                                               self.elector.healthy)
+                if debug:
+                    # same registry as the metrics server: an audit-only
+                    # pod scraped by nothing still dumps its recorder
+                    for name, provider in debug.items():
+                        self.health.add_debug(name, provider)
                 self.health.start()
             except OSError as e:
                 log.warning("health port unavailable", details=str(e))

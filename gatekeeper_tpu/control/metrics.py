@@ -12,6 +12,7 @@ text format (reference pkg/metrics/prometheus_exporter.go:17-43).
 from __future__ import annotations
 
 import http.server
+import os
 import threading
 import time
 from collections import defaultdict
@@ -60,12 +61,27 @@ class Registry:
         with m.lock:
             m.values[_lv(labels)] = value
 
+    @staticmethod
+    def _freeze_buckets(m: _Metric, buckets) -> None:
+        """Pin a histogram's bucket bounds at FIRST registration and
+        raise on any later mismatch. Re-assigning per call (the old
+        behavior) let two call sites with different bounds silently
+        mis-bucket counts against each other's stale lists — the
+        rendered cumulative histogram stayed plausible while every
+        quantile computed from it was wrong."""
+        if not m.buckets:
+            m.buckets = tuple(buckets)
+        elif m.buckets != tuple(buckets):
+            raise ValueError(
+                f"histogram {m.name} registered with buckets "
+                f"{m.buckets}; observe called with {tuple(buckets)}")
+
     def observe(self, name: str, help_: str, value: float,
                 buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 60,
                          300), **labels) -> None:
         m = self._get(name, help_, "histogram", tuple(sorted(labels)))
         with m.lock:
-            m.buckets = tuple(buckets)
+            self._freeze_buckets(m, buckets)
             key = _lv(labels)
             if key not in m.bucket_counts:
                 m.bucket_counts[key] = [0] * (len(buckets) + 1)
@@ -92,7 +108,7 @@ class Registry:
         latency being measured."""
         m = self._get(name, help_, "histogram", tuple(sorted(labels)))
         with m.lock:
-            m.buckets = tuple(buckets)
+            self._freeze_buckets(m, buckets)
             key = _lv(labels)
             if key not in m.bucket_counts:
                 m.bucket_counts[key] = [0] * (len(buckets) + 1)
@@ -140,8 +156,16 @@ def _lv(labels: dict) -> tuple:
     return tuple(str(labels[k]) for k in sorted(labels))
 
 
+def _esc(v) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline). Without it one kind name carrying a quote breaks every
+    scraper parsing the whole exposition."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt(names: tuple, values: tuple, **extra) -> str:
-    pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
+    pairs = [f'{n}="{_esc(v)}"' for n, v in zip(names, values)]
     pairs += [f'{k}="{v}"' for k, v in extra.items()]
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
@@ -155,23 +179,128 @@ def _num(v: float) -> str:
 REGISTRY = Registry()
 
 
-def serve(port: int, registry: Registry = REGISTRY,
-          addr: str = "") -> http.server.ThreadingHTTPServer:
-    """Start the /metrics endpoint (reference --prometheus-port 8888)."""
+# ------------------------------------------------- process self-metrics
+
+_PROCESS_START_TIME: Optional[float] = None
+_GC_SEEN: dict[tuple, float] = {}
+# serializes the GC-delta read-modify-write: the exposition server is
+# threaded, and two concurrent scrapes would both read the stale seen
+# value and double-add the delta — permanently overcounting exactly
+# the counters meant to prove gc.freeze held
+_GC_SEEN_LOCK = threading.Lock()
+
+
+def _process_start_time() -> float:
+    """Unix timestamp of process start: /proc/self/stat field 22 (clock
+    ticks since boot) + /proc/stat btime, the same derivation the
+    official prometheus clients use; import time of this module as the
+    fallback off Linux."""
+    global _PROCESS_START_TIME
+    if _PROCESS_START_TIME is not None:
+        return _PROCESS_START_TIME
+    try:
+        with open("/proc/self/stat") as f:
+            # comm may contain spaces/parens: fields start after ')'
+            fields = f.read().rpartition(")")[2].split()
+        ticks = float(fields[19])  # starttime is field 22 overall
+        with open("/proc/stat") as f:
+            btime = next(float(line.split()[1]) for line in f
+                         if line.startswith("btime "))
+        hz = os.sysconf("SC_CLK_TCK")
+        _PROCESS_START_TIME = btime + ticks / hz
+    except Exception:
+        _PROCESS_START_TIME = _IMPORT_TIME
+    return _PROCESS_START_TIME
+
+
+def update_process_metrics(registry: Registry = REGISTRY) -> None:
+    """Refresh the standard process/runtime self-metrics. Called on
+    every scrape (the values are reads of /proc and gc state, not
+    accumulation): process start time, RSS, open FDs, thread count,
+    and Python GC generation counts + collection totals — the GC
+    series exist specifically to PROVE the serving processes'
+    gc.freeze tuning held (a frozen heap shows near-zero gen-2
+    collections under load)."""
+    import gc
+
+    registry.gauge_set("process_start_time_seconds",
+                       "Start time of the process since unix epoch in "
+                       "seconds", _process_start_time())
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        registry.gauge_set("process_resident_memory_bytes",
+                           "Resident memory size in bytes",
+                           rss_pages * os.sysconf("SC_PAGE_SIZE"))
+    except Exception:
+        pass
+    try:
+        registry.gauge_set("process_open_fds",
+                           "Number of open file descriptors",
+                           len(os.listdir("/proc/self/fd")))
+    except Exception:
+        pass
+    registry.gauge_set("process_threads",
+                       "Live Python threads in this process",
+                       threading.active_count())
+    for gen, count in enumerate(gc.get_count()):
+        registry.gauge_set("python_gc_objects_tracked",
+                           "Objects tracked by the Python GC per "
+                           "generation (collection pressure; stays "
+                           "flat when gc.freeze held)",
+                           count, generation=str(gen))
+    for gen, stats in enumerate(gc.get_stats()):
+        for field, metric, help_ in (
+                ("collections", "python_gc_collections_total",
+                 "GC collections per generation"),
+                ("collected", "python_gc_objects_collected_total",
+                 "Objects collected by the GC per generation")):
+            cur = float(stats.get(field, 0))
+            key = (metric, gen)
+            with _GC_SEEN_LOCK:
+                delta = cur - _GC_SEEN.get(key, 0.0)
+                _GC_SEEN[key] = cur
+            if delta > 0:
+                registry.counter_add(metric, help_, delta,
+                                     generation=str(gen))
+
+
+_IMPORT_TIME = time.time()
+
+
+def serve(port: int, registry: Registry = REGISTRY, addr: str = "",
+          debug_providers: Optional[dict] = None
+          ) -> http.server.ThreadingHTTPServer:
+    """Start the /metrics endpoint (reference --prometheus-port 8888).
+
+    `debug_providers` maps endpoint names to callables taking the raw
+    query string and returning a JSON-serializable object; each is
+    served at /debug/<name> (the flight recorder dump, per-template
+    compile state, and the device-profile armer ride here)."""
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path.rstrip("/") in ("", "/metrics"):
-                body = registry.render().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-            else:
-                self.send_response(404)
-                self.end_headers()
+            path, _, query = self.path.partition("?")
+            path = path.rstrip("/")
+            if path in ("", "/metrics"):
+                update_process_metrics(registry)
+                self._reply(200, registry.render().encode(),
+                            "text/plain; version=0.0.4")
+                return
+            if path.startswith("/debug/") and debug_providers:
+                body, status = render_debug(
+                    debug_providers, path[len("/debug/"):], query)
+                self._reply(status, body, "application/json")
+                return
+            self.send_response(404)
+            self.end_headers()
+
+        def _reply(self, status: int, body: bytes, ctype: str):
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def log_message(self, *a):  # quiet
             pass
@@ -180,6 +309,25 @@ def serve(port: int, registry: Registry = REGISTRY,
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server
+
+
+def render_debug(providers: dict, name: str, query: str
+                 ) -> tuple[bytes, int]:
+    """Shared /debug/<name> dispatch (the metrics server and the
+    health server both mount the same provider registry): returns
+    (json_body, http_status). Provider errors answer 500 with the
+    error text instead of dropping the connection."""
+    from . import jsonio
+
+    provider = providers.get(name)
+    if provider is None:
+        return (jsonio.dumps_bytes(
+            {"error": f"unknown debug endpoint {name!r}",
+             "available": sorted(providers)}), 404)
+    try:
+        return jsonio.dumps_bytes(provider(query)), 200
+    except Exception as e:
+        return jsonio.dumps_bytes({"error": str(e)}), 500
 
 
 # convenience recorders with reference metric names
@@ -462,6 +610,46 @@ def report_leader(is_leader: bool) -> None:
                        "1 when this replica holds the leader lease "
                        "(audit sweep + status writers run here)",
                        0 if is_leader else 1, is_leader="false")
+
+
+# per-stage latency bounds: admission stages live in the sub-ms to
+# seconds range, audit phases run to minutes — one bound set covers
+# both planes (the metric is shared, and bucket bounds are frozen at
+# first registration)
+STAGE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                 30.0, 60.0, 120.0)
+
+_STAGE_HELP = ("Latency of one named pipeline stage of a SAMPLED "
+               "request (admission plane) or sweep phase (audit "
+               "plane), from the request-scoped trace layer")
+
+
+def report_stage(plane: str, stage: str, seconds: float) -> None:
+    """One span of a sampled trace: the per-stage latency histogram
+    that decomposes an admission p99 (or an audit sweep duration) into
+    its pipeline stages."""
+    REGISTRY.observe("gatekeeper_tpu_stage_duration_seconds",
+                     _STAGE_HELP, seconds, buckets=STAGE_BUCKETS,
+                     plane=plane, stage=stage)
+
+
+def report_stage_bucketed(plane: str, stage: str, bucket_counts: list,
+                          sum_: float, count: int) -> None:
+    """Merge a frontend's pre-aggregated stage-histogram delta (the
+    frontends time their own stages — frontend_parse, the backplane
+    forward — and ship them over the S frame like the forward-latency
+    histogram; replaying spans one at a time over the wire would cost
+    more than the stages being measured)."""
+    REGISTRY.observe_bucketed("gatekeeper_tpu_stage_duration_seconds",
+                              _STAGE_HELP, STAGE_BUCKETS, bucket_counts,
+                              sum_, count, plane=plane, stage=stage)
+
+
+def report_trace(plane: str) -> None:
+    REGISTRY.counter_add("gatekeeper_tpu_traces_total",
+                         "Sampled traces completed per plane",
+                         plane=plane)
 
 
 def report_watch_manager(gvk_count: int, intended: int) -> None:

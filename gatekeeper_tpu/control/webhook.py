@@ -37,6 +37,7 @@ from ..client import Client
 from ..target.handler import AugmentedReview
 from ..utils import faults
 from . import jsonio, metrics
+from . import trace as gtrace
 from .config_types import trace_enabled
 from .kube import NotFound
 from .logging import logger
@@ -137,14 +138,21 @@ def request_deadline(request: dict, default_s: float =
 
 
 class _Pending:
-    __slots__ = ("review", "done", "results", "error", "deadline")
+    __slots__ = ("review", "done", "results", "error", "deadline",
+                 "trace", "t_submit")
 
-    def __init__(self, review: dict, deadline: float):
+    def __init__(self, review: dict, deadline: float, trace=None):
         self.review = review
         self.done = threading.Event()
         self.results: list = []
         self.error: Optional[Exception] = None
         self.deadline = deadline
+        # span context pinned to the entry: the flush stamps this
+        # request's batch_seal (submit -> eval start) and evaluate
+        # spans. None for unsampled requests — no span objects ride
+        # the hot path.
+        self.trace = trace
+        self.t_submit = 0.0
 
 
 class MicroBatcher:
@@ -204,15 +212,20 @@ class MicroBatcher:
         self._pending = 0
 
     def submit(self, review: dict, timeout: float = 60.0,
-               deadline: Optional[float] = None) -> list:
+               deadline: Optional[float] = None, trace=None) -> list:
         """Enqueue and wait for the batched verdict. `deadline` is an
         absolute time.monotonic() instant (propagated from the request's
         timeoutSeconds); without one, `timeout` seconds from now. On
         expiry raises AdmissionDeadline; a full queue or a draining
-        batcher raises AdmissionShed without queueing."""
+        batcher raises AdmissionShed without queueing. `trace` (a
+        sampled gtrace.Trace) is pinned to the queue entry so the flush
+        stamps this request's batch spans."""
         now = time.monotonic()
         p = _Pending(review, deadline if deadline is not None
-                     else now + timeout)
+                     else now + timeout,
+                     trace=trace if trace is not None
+                     and trace.sampled else None)
+        p.t_submit = now
         with self._cv:
             if self._stop.is_set():
                 raise AdmissionShed("admission batcher is shutting down")
@@ -349,25 +362,42 @@ class MicroBatcher:
     def _flush(self, batch: list[_Pending]) -> None:
         self.batches += 1
         self.batched_requests += len(batch)
+        t_eval0 = time.monotonic()
         try:
             # inside the try: a raise-mode flush fault must error THIS
             # batch (and release its _pending slots), not kill the
             # flusher thread and leak the count toward permanent shed
             faults.fire("webhook.flush")
             outs = self._evaluate([p.review for p in batch])
+            t_eval1 = time.monotonic()
             for p, results in zip(batch, outs):
                 if isinstance(results, Exception):
                     p.error = results
                 else:
                     p.results = results
+                if p.trace is not None:
+                    self._stamp_spans(p, t_eval0, t_eval1)
                 p.done.set()
         except Exception as e:
+            t_eval1 = time.monotonic()
             for p in batch:
                 p.error = e
+                if p.trace is not None:
+                    self._stamp_spans(p, t_eval0, t_eval1)
                 p.done.set()
         finally:
             with self._cv:
                 self._pending -= len(batch)
+
+    @staticmethod
+    def _stamp_spans(p: _Pending, t_eval0: float, t_eval1: float) -> None:
+        """Batch spans for one sampled member: batch_seal (submit ->
+        eval start: collection window + flusher backlog) and evaluate
+        (the shared batched evaluation — the same interval for every
+        co-batched member, which is exactly the attribution wanted:
+        the request DID wait that long for its verdict)."""
+        p.trace.add_span("batch_seal", p.t_submit, t_eval0)
+        p.trace.add_span("evaluate", t_eval0, t_eval1)
 
     def _evaluate_violations(self, reviews: list[dict]) -> list:
         driver = self.opa.driver
@@ -570,7 +600,8 @@ class ValidationHandler:
 
     def handle(self, admission_review: dict,
                deadline: Optional[float] = None,
-               fast: bool = False) -> Optional[dict]:
+               fast: bool = False,
+               trace=gtrace.NOOP) -> Optional[dict]:
         """`deadline` (absolute monotonic) overrides the one derived
         from the request body — the backplane engine pins it at frame
         receipt so queueing ahead of this call spends the request's
@@ -581,7 +612,13 @@ class ValidationHandler:
         have to evaluate returns None instead, and the caller re-issues
         handle() from a thread that may block. The backplane engine
         serves cache hits inline in its frame-reader thread this way —
-        no thread handoff on the hot path."""
+        no thread handoff on the hot path.
+
+        `trace` is the request's span context (gtrace.NOOP when
+        unsampled): batch spans are stamped through the batcher entry,
+        and the OUTCOME — allow/deny/shed/timeout/error — lands on the
+        trace either way, so shed storms are diagnosable from the
+        flight recorder after the fact."""
         t0 = time.time()
         request = admission_review.get("request") or {}
         uid = request.get("uid") or ""
@@ -589,7 +626,8 @@ class ValidationHandler:
             deadline = request_deadline(request, self.default_timeout)
         status = None
         try:
-            response = self._decide(request, deadline, fast=fast)
+            response = self._decide(request, deadline, fast=fast,
+                                    trace=trace)
         except NeedsEvaluation:
             return None
         except AdmissionShed as e:
@@ -611,13 +649,15 @@ class ValidationHandler:
         if status is None:
             status = "allow" if response.get("allowed") else "deny"
         metrics.report_request(status, time.time() - t0)
+        trace.set_status(status)
         response["uid"] = uid
         return _envelope(admission_review, response)
 
     def _decide(self, request: dict,
                 deadline: Optional[float] = None,
-                fast: bool = False) -> dict:
+                fast: bool = False, trace=gtrace.NOOP) -> dict:
         username = (request.get("userInfo") or {}).get("username")
+        t_dec0 = time.monotonic() if trace.sampled else 0.0
         if username == SERVICE_ACCOUNT:
             return {"allowed": True}
         kind = request.get("kind") or {}
@@ -662,6 +702,8 @@ class ValidationHandler:
             if cached is not None and (cached.get("allowed")
                                        or not self.log_denies):
                 metrics.report_decision_cache("hit")
+                if trace.sampled:
+                    trace.add_span("cache_hit", t_dec0, time.monotonic())
                 # shallow copy: the caller patches uid into the response
                 return dict(cached)
             if fast:
@@ -685,7 +727,8 @@ class ValidationHandler:
                 log.info("state dump", dump=self.opa.dump())
             results = resps.results()
         else:
-            results = self.batcher.submit(gk_review, deadline=deadline)
+            results = self.batcher.submit(gk_review, deadline=deadline,
+                                          trace=trace)
         denies = []
         for r in results:
             if self.log_denies:
@@ -800,7 +843,8 @@ class MutationHandler:
         return self.system.mutate_batch(reviews, self._lookup_namespace)
 
     def handle(self, admission_review: dict,
-               deadline: Optional[float] = None) -> dict:
+               deadline: Optional[float] = None,
+               trace=gtrace.NOOP) -> dict:
         t0 = time.time()
         request = admission_review.get("request") or {}
         uid = request.get("uid") or ""
@@ -808,7 +852,7 @@ class MutationHandler:
             deadline = request_deadline(request, self.default_timeout)
         status = "allow"
         try:
-            response = self._decide(request, deadline)
+            response = self._decide(request, deadline, trace=trace)
         except AdmissionShed as e:
             status = "shed"
             response = {"allowed": not self.fail_closed,
@@ -823,11 +867,13 @@ class MutationHandler:
             response = {"allowed": not self.fail_closed,
                         "status": {"code": 500, "message": str(e)}}
         metrics.report_mutation_request(status, time.time() - t0)
+        trace.set_status(status)
         response["uid"] = uid
         return _envelope(admission_review, response)
 
     def _decide(self, request: dict,
-                deadline: Optional[float] = None) -> dict:
+                deadline: Optional[float] = None,
+                trace=gtrace.NOOP) -> dict:
         username = (request.get("userInfo") or {}).get("username")
         if username == SERVICE_ACCOUNT:
             return {"allowed": True}
@@ -848,7 +894,8 @@ class MutationHandler:
         # namespaces through _lookup_namespace only for mutators whose
         # match actually needs them (once per projection group, not per
         # request)
-        mutated = self.batcher.submit(dict(request), deadline=deadline)
+        mutated = self.batcher.submit(dict(request), deadline=deadline,
+                                      trace=trace)
         if mutated is None:
             return {"allowed": True}
         from ..mutation.patch import json_patch
@@ -961,6 +1008,7 @@ class FastHTTPServer:
                 close_after = not version.strip().endswith(b"1.1")
                 clen = 0
                 chunked = False
+                traceparent = None
                 while True:
                     h = rfile.readline(65537)
                     if h in (b"\r\n", b"\n", b""):
@@ -975,6 +1023,10 @@ class FastHTTPServer:
                             clen = 0
                     elif key == b"transfer-encoding":
                         chunked = b"chunked" in value.lower()
+                    elif key == b"traceparent":
+                        # the one tracing header that matters: a W3C
+                        # span context from the caller joins our trace
+                        traceparent = value.decode("latin-1")
                     elif key == b"connection":
                         v = value.lower()
                         if b"close" in v:
@@ -996,9 +1048,17 @@ class FastHTTPServer:
                 # parks on readline between requests)
                 with self._inflight_lock:
                     self._inflight += 1
+                extra_headers = None
                 try:
-                    status, payload = self.dispatch(
-                        path.decode("latin-1"), body)
+                    out = self.dispatch(path.decode("latin-1"), body,
+                                        traceparent)
+                    # dispatch returns (status, payload) or (status,
+                    # payload, extra_headers) — the tracing path adds
+                    # X-Trace-Id without taxing the untraced one
+                    if len(out) == 3:
+                        status, payload, extra_headers = out
+                    else:
+                        status, payload = out
                 except Exception as e:  # a dispatch bug must still
                     # ANSWER (zero unanswered admissions), not drop the
                     # socket and leave the API server to its timeout
@@ -1007,7 +1067,8 @@ class FastHTTPServer:
                 finally:
                     with self._inflight_lock:
                         self._inflight -= 1
-                self._respond(conn, status, payload, close_after)
+                self._respond(conn, status, payload, close_after,
+                              extra_headers)
                 if close_after:
                     return
         except (ConnectionError, TimeoutError, OSError, ssl.SSLError):
@@ -1041,12 +1102,17 @@ class FastHTTPServer:
 
     @staticmethod
     def _respond(conn, status: int, payload: bytes,
-                 close: bool = False) -> None:
+                 close: bool = False,
+                 extra_headers: Optional[dict] = None) -> None:
+        extra = ""
+        if extra_headers:
+            extra = "".join(f"{k}: {v}\r\n"
+                            for k, v in extra_headers.items())
         head = ("HTTP/1.1 %d %s\r\n"
                 "Content-Type: application/json\r\n"
-                "Content-Length: %d\r\n%s\r\n"
+                "Content-Length: %d\r\n%s%s\r\n"
                 % (status, _HTTP_REASONS.get(status, "OK"), len(payload),
-                   "Connection: close\r\n" if close else ""))
+                   extra, "Connection: close\r\n" if close else ""))
         conn.sendall(head.encode("ascii") + payload)
 
     def inflight(self) -> int:
@@ -1088,11 +1154,18 @@ class WebhookServer:
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         name="webhook", daemon=True)
 
-    def _dispatch(self, path: str, body: bytes) -> tuple:
+    def _dispatch(self, path: str, body: bytes,
+                  traceparent: Optional[str] = None) -> tuple:
+        tr = gtrace.TRACER.start(gtrace.ADMISSION, traceparent)
+        t_parse0 = time.monotonic() if tr.sampled else 0.0
         try:
             review = jsonio.loads(body)
         except ValueError:
+            tr.set_status("bad_request")
+            tr.finish()
             return 400, b""
+        if tr.sampled:
+            tr.add_span("frontend_parse", t_parse0, time.monotonic())
         # admission.k8s.io/v1 carries NO timeoutSeconds in the request
         # body — a real API server conveys its webhook timeout only as
         # the ?timeout=5s URL query. Fold it into the request so
@@ -1107,15 +1180,25 @@ class WebhookServer:
         # un-served endpoints 404 (an operation not requested must not
         # answer admission decisions for it)
         route = route_path(path)
+        # the trace kwarg rides only on sampled requests: unsampled
+        # calls stay signature-identical for handler stubs/embedders
+        kw = {"trace": tr} if tr.sampled else {}
         if route == "admitlabel" and self.ns_label is not None:
             out = self.ns_label.handle(review)
         elif route == "admit" and self.validation is not None:
-            out = self.validation.handle(review)
+            out = self.validation.handle(review, **kw)
         elif route == "mutate" and self.mutation is not None:
-            out = self.mutation.handle(review)
+            out = self.mutation.handle(review, **kw)
         else:
+            tr.set_status("not_found")
+            tr.finish()
             return 404, b""
-        return 200, encode_envelope(out)
+        if not tr.sampled:
+            return 200, encode_envelope(out)
+        with tr.span("serialize"):
+            payload = encode_envelope(out)
+        tr.finish()
+        return 200, payload, {"X-Trace-Id": tr.trace_id}
 
     def start(self) -> None:
         self._thread.start()

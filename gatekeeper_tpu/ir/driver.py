@@ -38,7 +38,7 @@ from ..ops.derived import (
 )
 from ..ops.strtab import MatchTables, StringTable
 from ..rego import ast as A
-from ..utils import faults
+from ..utils import faults, profiling
 from ..target.batch import match_masks
 from .compile import Uncompilable, compile_template
 from .evaljax import CompiledTemplate, EvalError, _param_c
@@ -291,6 +291,11 @@ class TpuDriver(RegoDriver):
             "GATEKEEPER_TPU_QUARANTINE_MAX_S", "600"))
         # optional observer wired by the control plane (template status)
         self.on_quarantine: Optional[Any] = None
+        # per-(kind, path) evaluation counters for /debug/templates:
+        # how many sweeps/batches each template served from the device,
+        # the delta cache, or the interpreter fallback
+        self._eval_counts: dict[tuple, int] = {}
+        self._eval_counts_lock = threading.Lock()
 
     def _build_mesh(self, mesh):
         import os
@@ -577,6 +582,66 @@ class TpuDriver(RegoDriver):
     def compiled_kinds(self) -> list[str]:
         return sorted(set(self._programs) | set(self._join_progs))
 
+    def note_eval(self, kind: str, path: str) -> None:
+        """Count one evaluation of `kind` via `path` (device / delta /
+        interp / join): the per-template eval breakdown /debug/templates
+        reports."""
+        with self._eval_counts_lock:
+            self._eval_counts[(kind, path)] = \
+                self._eval_counts.get((kind, path), 0) + 1
+
+    def templates_debug(self) -> dict:
+        """Per-template compile/serve state for /debug/templates: how
+        each kind evaluates right now (device program, join program, or
+        interpreter), its quarantine state, eval counts by path, and
+        the HLO-dump pointer (profiling.compiled_hlo renders the exact
+        device program; the XLA_FLAGS dump dir captures what the
+        COMPILER emitted)."""
+        quarantined = self.quarantine_status()
+        with self._eval_counts_lock:
+            counts = dict(self._eval_counts)
+        out = {}
+        # the program maps mutate from compile/eval threads (lazy
+        # compiled_for inserts, background warms) with no shared lock;
+        # snapshotting can race a resize mid-iteration, so retry the
+        # cheap copy instead of 500ing the endpoint during exactly the
+        # compile churn an operator is most likely to be inspecting
+        for _attempt in range(5):
+            try:
+                programs = set(self._programs)
+                joins = set(self._join_progs)
+                kinds = (set(self._compiled) | programs | joins
+                         | {k for (k, _p) in counts})
+                break
+            except RuntimeError:
+                continue
+        else:
+            programs = joins = set()
+            kinds = {k for (k, _p) in counts}
+        for kind in sorted(kinds):
+            if kind in programs:
+                state = "compiled"
+            elif kind in joins:
+                state = "join"
+            else:
+                state = "interpreter"
+            evals = {p: n for (k, p), n in sorted(counts.items())
+                     if k == kind}
+            out[kind] = {
+                "state": state,
+                "quarantine": quarantined.get(kind),
+                "eval_counts": evals,
+                "hlo_dump": ("gatekeeper_tpu.utils.profiling."
+                             f"compiled_hlo(driver.compiled_for({kind!r})"
+                             ", ...) renders the device program; set "
+                             "XLA_FLAGS=--xla_dump_to=<dir> to capture "
+                             "the compiler's own dumps"),
+            }
+        return {"templates": out,
+                "warm": self.warm_status(),
+                "mesh": None if self._mesh is None
+                else dict(self._mesh.shape)}
+
     def join_for(self, kind: str):
         """Lazily wrap a JoinProgram in its runtime evaluator. A
         quarantined kind answers None (interpreter fallback) until its
@@ -820,12 +885,14 @@ class TpuDriver(RegoDriver):
             ct = self.compiled_for(kind)
             if ct is not None and trace is None and \
                     not self._template_reads_data(kind):
-                served = self._audit_delta_serve(target, kind, cons,
-                                                 reviews, lookup_ns,
-                                                 sig_cache, inventory)
+                with profiling.timers().phase("delta_serve"):
+                    served = self._audit_delta_serve(target, kind, cons,
+                                                     reviews, lookup_ns,
+                                                     sig_cache, inventory)
                 if served is not None:
                     by_res[kind] = served
                     delta_served.add(kind)
+                    self.note_eval(kind, "delta")
                     continue
             if ct is not None and trace is None:
                 while len(pending) >= window:
@@ -1025,9 +1092,10 @@ class TpuDriver(RegoDriver):
             cand_reviews = [reviews[int(i)] for i in cand]
             use_mesh = self._mesh_shardable(len(cand_reviews))
             feat_key = (self._data_gen, hash(cand.tobytes()))
-            feats, enc, table, derived = self._prepare_eval(
-                ct, kind, cand_reviews, cons, feat_key, cand=cand,
-                target=target, mesh=use_mesh)
+            with profiling.timers().phase("encode"):
+                feats, enc, table, derived = self._prepare_eval(
+                    ct, kind, cand_reviews, cons, feat_key, cand=cand,
+                    target=target, mesh=use_mesh)
             c_dev = _param_c(enc)
             if self.async_warm:
                 sig = self._sweep_sig(kind, feats, enc, table, derived,
@@ -1046,6 +1114,7 @@ class TpuDriver(RegoDriver):
                                            len(cand_reviews), use_mesh)
             if use_mesh:
                 self._audit_used_mesh = True
+            self.note_eval(kind, "device")
             return ("h", mask, cand, cand_reviews, handle, c_dev,
                     _time.time())
         except DriverError:
@@ -1064,8 +1133,21 @@ class TpuDriver(RegoDriver):
 
         out: list[Result] = []
         first_sync = True
+        # two stopwatches through one slab loop: time blocked on the
+        # device (generator next) vs host materialization — the audit
+        # trace's device_sweep / materialize phases (a context manager
+        # per slab would mis-nest across the interleaving)
+        t_dev = t_mat = 0.0
         try:
-            for rows, cols in handle.pairs():
+            it = iter(handle.pairs())
+            while True:
+                t0 = _time.time()
+                try:
+                    rows, cols = next(it)
+                except StopIteration:
+                    t_dev += _time.time() - t0
+                    break
+                t_dev += _time.time() - t0
                 if first_sync:
                     # DISPATCH->first-result latency, sampled only for
                     # the audit's first consumed kind (later kinds'
@@ -1078,18 +1160,26 @@ class TpuDriver(RegoDriver):
                         self._observe("_dev_batch_lat_s",
                                       _time.time() - t_dispatch)
                     first_sync = False
+                t0 = _time.time()
                 rows, cols = _expand_parameterless(rows, cols, c_dev,
                                                    len(cons))
                 keep = mask[cand[rows], cols]
                 out.extend(self.materialize_pairs(
                     target, cons, cand_reviews, rows[keep], cols[keep],
                     inventory))
+                t_mat += _time.time() - t0
         except DriverError:
             raise
         except Exception as e:
             self._quarantine_kind(kind, "audit-eval", e)
             return self._audit_interp(target, kind, cons, reviews,
                                       lookup_ns, inventory, None, sig_cache)
+        finally:
+            timers = profiling.timers()
+            if t_dev > 0:
+                timers.add("device_sweep", t_dev)
+            if t_mat > 0:
+                timers.add("materialize", t_mat)
         if self._quarantine:
             self._quarantine_clear(kind)
         return out
@@ -1106,6 +1196,7 @@ class TpuDriver(RegoDriver):
         cand = np.flatnonzero(mask.any(axis=1))
         if cand.size == 0:
             return []
+        self.note_eval(kind, "join")
         cand_reviews = [reviews[int(i)] for i in cand]
         if self._join_frz[0] != self._data_rev:
             self._join_frz = (self._data_rev, {}, {})
@@ -1285,6 +1376,7 @@ class TpuDriver(RegoDriver):
                       inventory, trace, sig_cache=None) -> list[Result]:
         import time as _time
 
+        self.note_eval(kind, "interp")
         out: list[Result] = []
         mask = self._match_mask(target, kind, cons, reviews, lookup_ns,
                                 sig_cache)
@@ -1302,6 +1394,8 @@ class TpuDriver(RegoDriver):
                     target, constraint, review, enforcement, inventory, trace))
         # feed the cost model in its own units (masked pairs per second)
         el = _time.time() - t0
+        if el > 0:
+            profiling.timers().add("interp_eval", el)
         if trace is None and el > 0.005 and n_masked >= 256:
             self._observe("_host_pair_rate", n_masked / el)
         return out

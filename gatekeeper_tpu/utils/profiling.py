@@ -47,10 +47,18 @@ def device_trace(log_dir: str):
 
 
 class PhaseTimers:
-    """Named wall-clock phase accumulators (audit: match/sweep/
-    materialize), exposed via control.metrics when wired."""
+    """Named wall-clock phase accumulators (audit: encode/device_sweep/
+    materialize/...), exposed via control.metrics + the trace layer.
+
+    The driver's audit internals add() into the process-global timers()
+    instance; the audit manager snapshots before/after a sweep and
+    diffs, turning the per-sweep phase durations into trace spans and
+    per-stage histograms — the attribution PAPER.md's per-package stats
+    reporters provide in the reference line."""
 
     def __init__(self):
+        import threading
+        self._lock = threading.Lock()
         self.totals: dict[str, float] = {}
         self.counts: dict[str, int] = {}
 
@@ -60,12 +68,30 @@ class PhaseTimers:
         try:
             yield
         finally:
-            self.totals[name] = self.totals.get(name, 0.0) + \
-                (time.time() - t0)
-            self.counts[name] = self.counts.get(name, 0) + 1
+            self.add(name, time.time() - t0)
+
+    def add(self, name: str, seconds: float, n: int = 1) -> None:
+        """Accumulate an externally-timed interval (slab pipelines time
+        device-wait and materialize with two stopwatches inside one
+        loop — a context manager per slab would mis-nest)."""
+        with self._lock:
+            self.totals[name] = self.totals.get(name, 0.0) + seconds
+            self.counts[name] = self.counts.get(name, 0) + n
 
     def snapshot(self) -> dict[str, tuple[float, int]]:
-        return {k: (self.totals[k], self.counts[k]) for k in self.totals}
+        with self._lock:
+            return {k: (self.totals[k], self.counts[k])
+                    for k in self.totals}
+
+    @staticmethod
+    def diff(before: dict, after: dict) -> dict[str, float]:
+        """Per-phase seconds accumulated between two snapshots."""
+        out = {}
+        for name, (total, _n) in after.items():
+            delta = total - before.get(name, (0.0, 0))[0]
+            if delta > 1e-9:
+                out[name] = delta
+        return out
 
 
 _timers: Optional[PhaseTimers] = None

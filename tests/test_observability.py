@@ -1,0 +1,659 @@
+"""Observability (PR 6 tentpole): end-to-end request tracing, latency
+attribution, and the flight recorder.
+
+Covers:
+  * W3C traceparent parse/format + stride sampling + the preallocated
+    no-op context (an UNSAMPLED request must allocate zero span
+    objects on the hot path — asserted via the module allocation
+    counter);
+  * a traced admission request through 2 real subprocess frontends:
+    one trace whose stage spans are complete, ordered, and sum to
+    ~wall clock; inbound traceparent honored; X-Trace-Id answered;
+    /debug/traces + /debug/templates + /metrics scraped over HTTP and
+    validated (what the CI `observability` job boots);
+  * audit-plane sweep traces with phase spans + stage histograms;
+  * the Registry bucket-skew regression (bounds frozen at first
+    registration, mismatch raises);
+  * process self-metrics (start time, RSS, FDs, threads, GC);
+  * a STRICT text-exposition parse of a loaded Runtime's full /metrics
+    output (HELP/TYPE present, +Inf == _count, label escaping).
+
+Every test runs under a hard SIGALRM timeout.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import re
+import signal
+import time
+
+import pytest
+
+from gatekeeper_tpu.client import Backend, RegoDriver
+from gatekeeper_tpu.control import metrics as gm
+from gatekeeper_tpu.control import trace as gt
+from gatekeeper_tpu.control.backplane import _StatsAccumulator
+from gatekeeper_tpu.control.webhook import (
+    MicroBatcher,
+    NamespaceLabelHandler,
+    ValidationHandler,
+    WebhookServer,
+)
+from gatekeeper_tpu.target import K8sValidationTarget
+from gatekeeper_tpu.utils.faults import FAULTS
+
+TARGET = "admission.k8s.gatekeeper.sh"
+PER_TEST_TIMEOUT_S = 120
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout_and_tracer_reset():
+    def boom(signum, frame):  # pragma: no cover - only on a real hang
+        raise TimeoutError(
+            f"test exceeded the {PER_TEST_TIMEOUT_S}s hard timeout")
+
+    old = signal.signal(signal.SIGALRM, boom)
+    signal.alarm(PER_TEST_TIMEOUT_S)
+    FAULTS.reset()
+    rate, slow = gt.TRACER.sample_rate, gt.TRACER.slow_threshold_s
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+        gt.TRACER.configure(rate, slow)
+        gt.TRACER.recorder.clear()
+        FAULTS.reset()
+
+
+def _policy_client():
+    client = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    client.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sneedowner"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sNeedOwner"}}},
+            "targets": [{"target": TARGET, "rego": """
+package k8sneedowner
+violation[{"msg": "no owner label"}] {
+  not input.review.object.metadata.labels.owner
+}
+"""}]},
+    })
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sNeedOwner", "metadata": {"name": "need-owner"},
+        "spec": {}})
+    return client
+
+
+def _review(name, labels=None, uid=None):
+    obj = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": name, "namespace": "d"}}
+    if labels:
+        obj["metadata"]["labels"] = labels
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": uid or f"uid-{name}", "operation": "CREATE",
+                        "kind": {"group": "", "version": "v1",
+                                 "kind": "Pod"},
+                        "name": name, "namespace": "d",
+                        "userInfo": {"username": "obs"}, "object": obj}}
+
+
+# ------------------------------------------------------ traceparent + sampling
+
+
+def test_traceparent_parse_and_format():
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    parsed, sampled = gt.parse_traceparent(
+        f"00-{tid}-00f067aa0ba902b7-01")
+    assert parsed == tid and sampled is True
+    parsed, sampled = gt.parse_traceparent(
+        f"00-{tid}-00f067aa0ba902b7-00")
+    assert parsed == tid and sampled is False
+    # malformed / all-zero never raise, never sample
+    for bad in (None, "", "junk", "00-short-x-01", "00-" + "0" * 32
+                + "-00f067aa0ba902b7-01", "zz-" + tid + "-gg-01"):
+        assert gt.parse_traceparent(bad) == (None, False)
+    # STRICT hex ids only: int(x, 16) would accept these, but they
+    # would blow up bytes.fromhex when the context rides the backplane
+    # frame (regression: 500 + a leaked frontend waiter per request)
+    for evil in ("0x" + "a" * 30, "a_" * 16, "+" + "a" * 31,
+                 " " + "a" * 31):
+        assert gt.parse_traceparent(
+            f"00-{evil}-00f067aa0ba902b7-01") == (None, False), evil
+    # uppercase ids normalize to lowercase (fromhex-safe either way)
+    assert gt.parse_traceparent(
+        "00-" + "AB" * 16 + "-00f067aa0ba902b7-01")[0] == "ab" * 16
+    hdr = gt.format_traceparent(tid)
+    assert gt.parse_traceparent(hdr) == (tid, True)
+
+
+def test_stride_sampling_and_forced_traceparent():
+    tracer = gt.Tracer(sample_rate=0.5, metrics_sink=False)
+    kinds = [tracer.start("admission") is gt.NOOP for _ in range(10)]
+    assert kinds.count(False) == 5  # every 2nd samples
+    tracer.configure(0.0)
+    assert tracer.start("admission") is gt.NOOP
+    # an inbound sampled traceparent forces tracing past rate 0 AND
+    # carries its trace id through
+    tid = "ab" * 16
+    tr = tracer.start("admission", f"00-{tid}-00f067aa0ba902b7-01")
+    assert tr is not gt.NOOP and tr.trace_id == tid
+    tr.finish()
+    # sample_context (the frontend edge) agrees
+    assert tracer.sample_context() is None
+    assert tracer.sample_context(f"00-{tid}-00f067aa0ba902b7-01") == tid
+
+
+def test_unsampled_request_allocates_no_span_objects():
+    """The acceptance bar for hot-path cost: with sampling off, a full
+    admission round trip through the real HTTP server must not
+    construct a single Span/Trace object."""
+    gt.TRACER.configure(0.0)
+    client = _policy_client()
+    handler = ValidationHandler(client, kube=None,
+                                batcher=MicroBatcher(client,
+                                                     max_wait=0.001))
+    server = WebhookServer(handler, NamespaceLabelHandler(()), port=0)
+    server.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        conn.request("POST", "/v1/admit",
+                     json.dumps(_review("warm", {"owner": "x"})),
+                     {"Content-Type": "application/json"})
+        conn.getresponse().read()  # warm the path first
+        before = gt.ALLOCATIONS
+        for i in range(20):
+            conn.request("POST", "/v1/admit",
+                         json.dumps(_review(f"p{i}", {"owner": "x"})),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.getheader("X-Trace-Id") is None
+        assert gt.ALLOCATIONS == before, \
+            "unsampled requests allocated span objects on the hot path"
+    finally:
+        server.stop(drain_timeout=1.0)
+
+
+# ---------------------------------------------------------- flight recorder
+
+
+def _mk_trace(tracer, duration, plane="admission"):
+    tr = tracer.start(plane, force=True)
+    tr.add_span("evaluate", tr.t0, tr.t0 + duration)
+    tr.t1 = tr.t0 + duration
+    return tr
+
+
+def test_flight_recorder_keeps_recent_and_slowest():
+    rec = gt.FlightRecorder(keep=3)
+    tracer = gt.Tracer(sample_rate=1.0, recorder=rec,
+                       metrics_sink=False, slow_threshold_s=0)
+    durations = [0.1, 5.0, 0.2, 4.0, 0.3, 3.0, 0.05]
+    for d in durations:
+        tr = _mk_trace(tracer, d)
+        rec.record(tr)
+    dump = rec.dump()["planes"]["admission"]
+    assert len(dump["recent"]) == 3 and len(dump["slowest"]) == 3
+    # recent = last three, oldest first
+    assert [t["duration_s"] for t in dump["recent"]] == [0.3, 3.0, 0.05]
+    # slowest = global top three, slowest first — the 5.0s outlier is
+    # retained long after it aged out of the recent ring
+    assert [t["duration_s"] for t in dump["slowest"]] == [5.0, 4.0, 3.0]
+    # per-plane isolation
+    rec.record(_mk_trace(tracer, 9.0, plane="audit"))
+    planes = rec.dump()["planes"]
+    assert planes["audit"]["slowest"][0]["duration_s"] == 9.0
+    assert planes["admission"]["slowest"][0]["duration_s"] == 5.0
+
+
+def test_slow_trace_logs_structured_line(caplog):
+    import logging as pylog
+
+    tracer = gt.Tracer(sample_rate=1.0, slow_threshold_s=0.0001,
+                       metrics_sink=False)
+    with caplog.at_level(pylog.WARNING, logger="gatekeeper.trace"):
+        tr = tracer.start("admission", force=True)
+        time.sleep(0.002)
+        tr.finish()
+    assert any("slow request trace" in r.getMessage()
+               for r in caplog.records)
+
+
+# ------------------------------------------------- registry bucket freeze
+
+
+def test_histogram_buckets_freeze_at_first_registration():
+    """Regression for the bucket-skew bug: two call sites passing
+    different bounds for the same metric silently mis-bucketed counts
+    against stale lists (m.buckets was re-assigned on every observe)."""
+    reg = gm.Registry()
+    reg.observe("skew_test_seconds", "h", 0.3, buckets=(0.1, 1.0))
+    with pytest.raises(ValueError, match="skew_test_seconds"):
+        reg.observe("skew_test_seconds", "h", 0.3,
+                    buckets=(0.5, 2.0, 10.0))
+    # the original bounds survived, counts landed against them
+    reg.observe("skew_test_seconds", "h", 0.05, buckets=(0.1, 1.0))
+    text = reg.render()
+    assert 'skew_test_seconds_bucket{le="0.1"} 1' in text
+    assert 'skew_test_seconds_bucket{le="1"} 2' in text
+    assert 'le="0.5"' not in text
+    # observe_bucketed enforces the same freeze
+    with pytest.raises(ValueError):
+        reg.observe_bucketed("skew_test_seconds", "h", (9.9,), [1, 0],
+                             0.1, 1)
+
+
+def test_label_values_are_escaped():
+    reg = gm.Registry()
+    reg.counter_add("esc_total", "c", kind='we"ird\\na\nme')
+    text = reg.render()
+    assert 'kind="we\\"ird\\\\na\\nme"' in text
+
+
+# ---------------------------------------------------- process self-metrics
+
+
+def test_process_self_metrics_exposed():
+    reg = gm.Registry()
+    gm.update_process_metrics(reg)
+    text = reg.render()
+    for name in ("process_start_time_seconds",
+                 "process_resident_memory_bytes", "process_open_fds",
+                 "process_threads", "python_gc_objects_tracked"):
+        assert name in text, f"{name} missing from exposition"
+    start = float(re.search(
+        r"^process_start_time_seconds (\S+)$", text, re.M).group(1))
+    assert 0 < start <= time.time()
+    rss = float(re.search(
+        r"^process_resident_memory_bytes (\S+)$", text, re.M).group(1))
+    assert rss > 1 << 20  # a live interpreter holds > 1MiB
+
+
+# -------------------------------------------------- exposition strict parse
+
+
+def _parse_exposition_strict(text: str) -> dict:
+    """Strict text-format parse: every sample must belong to an
+    announced metric family (HELP + TYPE first), histogram +Inf bucket
+    must equal _count, label values must round-trip the escaping.
+    Returns {family: {"type", "samples": [(name, labels, value)]}}."""
+    families: dict = {}
+    cur = None
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$")
+    label_re = re.compile(
+        r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"(,|$)')
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in families, f"duplicate HELP for {name}"
+            families[name] = {"type": None, "samples": []}
+            cur = name
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert parts[2] == cur, "TYPE does not follow its HELP"
+            assert families[cur]["type"] is None, "duplicate TYPE"
+            assert parts[3] in ("counter", "gauge", "histogram")
+            families[cur]["type"] = parts[3]
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = sample_re.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, _, labeltext, value = m.groups()
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        fam = name if name in families else base
+        assert fam in families, f"sample {name} has no HELP/TYPE"
+        assert families[fam]["type"] is not None
+        labels = {}
+        if labeltext:
+            consumed = 0
+            for lm in label_re.finditer(labeltext):
+                labels[lm.group(1)] = lm.group(2)
+                consumed = lm.end()
+            assert consumed == len(labeltext), \
+                f"bad label syntax: {labeltext!r}"
+        float(value)  # must be numeric
+        families[fam]["samples"].append((name, labels, float(value)))
+    # histogram invariants
+    for fam, data in families.items():
+        if data["type"] != "histogram":
+            continue
+        series: dict = {}
+        for name, labels, value in data["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            ent = series.setdefault(key, {})
+            if name.endswith("_bucket"):
+                ent.setdefault("buckets", {})[labels["le"]] = value
+            elif name.endswith("_count"):
+                ent["count"] = value
+            elif name.endswith("_sum"):
+                ent["sum"] = value
+        for key, ent in series.items():
+            assert "+Inf" in ent.get("buckets", {}), \
+                f"{fam}{dict(key)} missing +Inf bucket"
+            assert ent["buckets"]["+Inf"] == ent["count"], \
+                f"{fam}{dict(key)}: +Inf bucket != _count"
+            # cumulative buckets must be monotonic
+            prev = 0.0
+            for le, v in sorted(
+                    ent["buckets"].items(),
+                    key=lambda kv: float("inf") if kv[0] == "+Inf"
+                    else float(kv[0])):
+                assert v >= prev, f"{fam}: non-monotonic buckets"
+                prev = v
+    return families
+
+
+def test_full_runtime_exposition_parses_strictly():
+    """The whole /metrics output of a LOADED runtime — histograms,
+    escaped labels, merged bucketed deltas — must satisfy a strict
+    text-format parser, so malformed series can never ship again."""
+    gt.TRACER.configure(1.0, 10.0)
+    client = _policy_client()
+    handler = ValidationHandler(client, kube=None,
+                                batcher=MicroBatcher(client,
+                                                     max_wait=0.001))
+    for i in range(5):
+        handler.handle(_review(f"ok{i}", {"owner": "me"}))
+        handler.handle(_review(f"bad{i}"))
+    # a pre-aggregated delta merge (the backplane stats path)
+    gm.report_backplane_forward(
+        "w0", [1] * (len(gm.FORWARD_BUCKETS) + 1), 0.5,
+        len(gm.FORWARD_BUCKETS) + 1)
+    gm.report_stage_bucketed(
+        "admission", "frontend_parse",
+        [2] * (len(gm.STAGE_BUCKETS) + 1), 0.1,
+        2 * (len(gm.STAGE_BUCKETS) + 1))
+    # a label value that needs escaping
+    gm.REGISTRY.counter_add("gatekeeper_tpu_test_escape_total", "t",
+                            kind='K8s"Weird\\Kind')
+    gm.update_process_metrics()
+    families = _parse_exposition_strict(gm.REGISTRY.render())
+    assert families["request_duration_seconds"]["type"] == "histogram"
+    assert families["gatekeeper_tpu_stage_duration_seconds"]["type"] \
+        == "histogram"
+    esc = families["gatekeeper_tpu_test_escape_total"]["samples"]
+    assert esc[0][1]["kind"] == 'K8s\\"Weird\\\\Kind'
+    handler.batcher.stop()
+
+
+# ----------------------------------------------- single-process trace path
+
+
+def test_single_process_trace_decomposition_and_header():
+    gt.TRACER.configure(1.0, slow_threshold_s=0)
+    gt.TRACER.recorder.clear()
+    client = _policy_client()
+    handler = ValidationHandler(client, kube=None,
+                                batcher=MicroBatcher(client,
+                                                     max_wait=0.002))
+    server = WebhookServer(handler, None, port=0)
+    server.start()
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        conn.request("POST", "/v1/admit",
+                     json.dumps(_review("t1", {"owner": "x"})),
+                     {"Content-Type": "application/json",
+                      "traceparent": f"00-{tid}-00f067aa0ba902b7-01"})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.getheader("X-Trace-Id") == tid, \
+            "inbound traceparent trace id not honored"
+        dump = gt.TRACER.recorder.dump()["planes"]["admission"]
+        trace = next(t for t in dump["recent"] if t["trace_id"] == tid)
+        stages = [s["stage"] for s in trace["spans"]]
+        for want in ("frontend_parse", "batch_seal", "evaluate",
+                     "serialize"):
+            assert want in stages, f"stage {want} missing: {stages}"
+        assert trace["status"] == "allow"
+    finally:
+        server.stop(drain_timeout=1.0)
+
+
+def test_cache_hit_stage_replaces_evaluate():
+    gt.TRACER.configure(1.0, slow_threshold_s=0)
+    gt.TRACER.recorder.clear()
+    client = _policy_client()
+    handler = ValidationHandler(client, kube=None,
+                                batcher=MicroBatcher(client,
+                                                     max_wait=0.001))
+    handler.handle(_review("same", {"owner": "x"}, uid="u1"))
+    tr = gt.TRACER.start(gt.ADMISSION, force=True)
+    handler.handle(_review("same", {"owner": "x"}, uid="u2"), trace=tr)
+    tr.finish()
+    stages = [s["stage"] for s in tr.to_dict()["spans"]]
+    assert "cache_hit" in stages and "evaluate" not in stages
+    handler.batcher.stop()
+
+
+# ------------------------------------------------- backplane stats plumbing
+
+
+def test_stats_accumulator_ships_stage_deltas():
+    acc = _StatsAccumulator()
+    acc.observe(0.001)
+    acc.observe_stage("frontend_parse", 0.0002)
+    acc.observe_stage("frontend_parse", 0.3)
+    acc.observe_stage("some_other_stage", 0.001)
+    out = acc.drain("w3")
+    assert out["count"] == 1
+    stages = out["stages"]
+    assert stages["frontend_parse"]["count"] == 2
+    assert abs(stages["frontend_parse"]["sum"] - 0.3002) < 1e-6
+    assert sum(stages["frontend_parse"]["buckets"]) == 2
+    assert stages["some_other_stage"]["count"] == 1
+    # drained clean
+    assert acc.drain("w3") is None
+
+
+# --------------------------------------------------------- audit plane trace
+
+
+def test_audit_sweep_trace_phases_and_histograms():
+    from gatekeeper_tpu.control.audit import AuditManager
+    from gatekeeper_tpu.control.kube import FakeKube
+
+    gt.TRACER.recorder.clear()
+    kube = FakeKube()
+    for gvk, namespaced in [(("", "v1", "Namespace"), False),
+                            (("", "v1", "Pod"), True)]:
+        kube.register_kind(gvk, namespaced=namespaced)
+    kube.create({"apiVersion": "v1", "kind": "Namespace",
+                 "metadata": {"name": "d"}})
+    for i in range(4):
+        kube.create({"apiVersion": "v1", "kind": "Pod",
+                     "metadata": {"name": f"p{i}", "namespace": "d"}})
+    client = _policy_client()
+    mgr = AuditManager(kube, client, incremental=True,
+                       gc_stale_statuses=False)
+    mgr.audit_once()
+    mgr.audit_once()  # one incremental sweep too
+    mgr.stop()
+    dump = gt.TRACER.recorder.dump()["planes"]
+    assert "audit" in dump, "audit sweeps must always trace"
+    stages_seen = set()
+    for t in dump["audit"]["recent"]:
+        stages_seen.update(s["stage"] for s in t["spans"])
+    for want in ("list_delta_apply", "evaluate", "status_writes"):
+        assert want in stages_seen
+    statuses = {t["status"] for t in dump["audit"]["recent"]}
+    assert {"full_resync", "incremental"} <= statuses
+    text = gm.REGISTRY.render()
+    assert 'gatekeeper_tpu_stage_duration_seconds_count' \
+        '{plane="audit",stage="evaluate"}' in text
+
+
+def test_failed_sweep_still_records_error_trace():
+    """A sweep that blows up mid-evaluation must still land in the
+    flight recorder with status=error — the failing sweeps are exactly
+    the ones worth diagnosing after the fact."""
+    from gatekeeper_tpu.control.audit import AuditManager
+    from gatekeeper_tpu.control.kube import FakeKube
+
+    gt.TRACER.recorder.clear()
+    client = _policy_client()
+
+    def boom():
+        raise RuntimeError("device on fire")
+
+    client.audit = boom
+    mgr = AuditManager(FakeKube(), client, audit_from_cache=True,
+                       gc_stale_statuses=False)
+    with pytest.raises(RuntimeError):
+        mgr.audit_once()
+    dump = gt.TRACER.recorder.dump()["planes"]["audit"]["recent"]
+    assert dump and dump[-1]["status"] == "error"
+    assert "device on fire" in dump[-1]["attrs"]["error"]
+
+
+# ------------------------------------- full plane: subprocess frontends
+
+
+def _get(conn_host, port, path):
+    conn = http.client.HTTPConnection(conn_host, port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_traced_request_through_subprocess_frontends():
+    """The acceptance path: a Runtime with 2 pre-forked frontend
+    PROCESSES at sample rate 1.0 — a traced request yields ONE trace
+    whose stage spans are complete, ordered, and sum to ~wall clock;
+    /metrics and /debug/* validate over real HTTP."""
+    from gatekeeper_tpu.control.main import Runtime, build_parser
+
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--disable-cert-rotation", "--health-addr", ":0",
+        "--operation", "webhook", "--admission-workers", "2",
+        "--trace-sample-rate", "1.0", "--trace-slow-threshold", "0"])
+    rt = Runtime(args)
+    rt.start()
+    # load a real template so the traced request evaluates something
+    # and /debug/templates has per-kind state to report
+    rt.opa.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1beta1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sneedowner"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sNeedOwner"}}},
+            "targets": [{"target": TARGET, "rego": """
+package k8sneedowner
+violation[{"msg": "no owner label"}] {
+  not input.review.object.metadata.labels.owner
+}
+"""}]}})
+    rt.opa.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sNeedOwner", "metadata": {"name": "need-owner"},
+        "spec": {}})
+    tid = "aabbccddeeff00112233445566778899"
+    try:
+        deadline = time.monotonic() + 10
+        while rt.backplane.connected < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert rt.backplane.connected == 2
+        mport = rt.metrics_server.server_address[1]
+        hport = rt.health.port
+        conn = http.client.HTTPConnection("127.0.0.1", rt.frontends.port,
+                                          timeout=15)
+        conn.request("POST", "/v1/admit?timeout=10s",
+                     json.dumps(_review("traced")),
+                     {"Content-Type": "application/json",
+                      "traceparent": f"00-{tid}-00f067aa0ba902b7-01"})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.getheader("X-Trace-Id") == tid
+        # the engine records the trace at respond time; poll the dump
+        trace = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            status, body = _get("127.0.0.1", mport, "/debug/traces")
+            assert status == 200
+            planes = json.loads(body).get("planes", {})
+            for t in planes.get("admission", {}).get("recent", []):
+                if t["trace_id"] == tid:
+                    trace = t
+                    break
+            if trace:
+                break
+            time.sleep(0.1)
+        assert trace is not None, "traced request never reached the " \
+            "flight recorder"
+        stages = [s["stage"] for s in trace["spans"]]
+        # >= 5 named stages spanning frontend -> backplane -> engine ->
+        # eval path
+        for want in ("frontend_parse", "backplane_forward",
+                     "batch_seal", "evaluate", "serialize", "respond"):
+            assert want in stages, f"{want} missing from {stages}"
+        assert len(stages) >= 5
+        # complete + ordered: spans start in order, live inside the
+        # trace window, and sum to ~wall clock (sequential stages;
+        # small gaps for untimed glue are expected)
+        starts = [s["start_s"] for s in trace["spans"]]
+        assert starts == sorted(starts), "stage spans out of order"
+        total = trace["duration_s"]
+        span_sum = sum(s["duration_s"] for s in trace["spans"])
+        assert all(0 <= s["start_s"] <= total + 1e-6
+                   for s in trace["spans"])
+        assert span_sum <= total * 1.10 + 1e-4
+        assert span_sum >= total * 0.5, \
+            f"spans cover too little of the trace: {span_sum} / {total}"
+        # a second, uid-churned request serves from the decision cache
+        # and still decomposes (cache_hit path)
+        conn.request("POST", "/v1/admit?timeout=10s",
+                     json.dumps(_review("traced", uid="uid-2")),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        tid2 = resp.getheader("X-Trace-Id")
+        assert tid2 and tid2 != tid
+        # /metrics: engine-side stages appear immediately; the
+        # frontend-shipped stage deltas land within one S-frame
+        # interval (2s)
+        deadline = time.monotonic() + 8
+        text = ""
+        while time.monotonic() < deadline:
+            status, body = _get("127.0.0.1", mport, "/metrics")
+            assert status == 200
+            text = body.decode()
+            if ('stage="frontend_parse"' in text
+                    and 'stage="evaluate"' in text):
+                break
+            time.sleep(0.2)
+        for frag in ('plane="admission"', 'stage="evaluate"',
+                     'stage="frontend_parse"', 'stage="respond"',
+                     "gatekeeper_tpu_traces_total",
+                     "process_resident_memory_bytes"):
+            assert frag in text, f"{frag} missing from /metrics"
+        _parse_exposition_strict(text)
+        # /debug/templates on the metrics port, /debug/traces on the
+        # health port (same registry), unknown endpoints 404
+        status, body = _get("127.0.0.1", mport, "/debug/templates")
+        assert status == 200
+        tmpl = json.loads(body)
+        assert "K8sNeedOwner" in tmpl["templates"]
+        status, _ = _get("127.0.0.1", hport, "/debug/traces")
+        assert status == 200
+        status, body = _get("127.0.0.1", mport, "/debug/nope")
+        assert status == 404
+        assert "available" in json.loads(body)
+    finally:
+        rt.stop()
