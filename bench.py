@@ -203,7 +203,7 @@ def main() -> None:
         proc = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(
                 os.path.abspath(__file__)), "bench_configs.py"),
-             "1", "2", "3", "5", "6", "7", "9"],
+             "1", "2", "3", "5", "6", "7", "9", "10"],
             capture_output=True, text=True, env=env,
             timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 2700)))
         for line in proc.stdout.splitlines():
@@ -261,6 +261,12 @@ def main() -> None:
         # time-to-ready vs the cold full list/encode boot
         "warm_boot_s": (configs.get("9") or {}).get("value"),
         "cold_boot_s": (configs.get("9") or {}).get("cold_boot_s"),
+        # multichip headline (config 10): default mesh-sharded audit at
+        # 1M+ objects vs the forced single-device path
+        "mesh_audit_s": (configs.get("10") or {}).get("value"),
+        "mesh_audit_vs_single_device":
+            (configs.get("10") or {}).get("vs_single_device"),
+        "mesh_audit_path": (configs.get("10") or {}).get("audit_path"),
         "objects": N_OBJECTS,
         "constraints": N_CONSTRAINTS,
         "violating_pairs": n_pairs,

@@ -29,6 +29,10 @@ One JSON line per config:
      scale — restore the durable state snapshots (vocab + library +
      encoded inventory + tracker) and re-validate vs a live list,
      against the cold library-ingest + full list/encode resync path
+  #10 multichip audit promotion at 1M+ objects: the default (no-flag)
+     mesh-sharded audit path vs the forced single-device path, each in
+     a fresh subprocess (on a 1-device host the mesh run forces 8
+     host-platform devices so the slab pipeline is exercised)
 
 All audits run steady-state through client.audit() (warm caches), same
 contract as bench.py. Run: python bench_configs.py [1 2 3 5 6 7 8 9]
@@ -1083,56 +1087,147 @@ def config5():
 
     from gatekeeper_tpu.control.backplane import FrontendSupervisor
 
+    def _spawn_engines(n: int, tag: str) -> tuple:
+        """Spawn n --serve-engine children, each on its own socket.
+        Returns (procs, socket_paths); raises with the child's stderr
+        tail when one fails to come up (the caller records an explicit
+        skip — a silent empty sweep hid exactly this in BENCH_r05)."""
+        procs, socks = [], []
+        try:
+            for k in range(n):
+                sp = os.path.join(
+                    tempfile.gettempdir(),
+                    f"gk-bench-bp-{os.getpid()}-{tag}{k}.sock")
+                socks.append(sp)
+                procs.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--serve-engine", sp],
+                    cwd=here, stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE, text=True))
+            for k, proc in enumerate(procs):
+                line = proc.stdout.readline()
+                if "READY" not in (line or ""):
+                    err = (proc.stderr.read() or "")[-300:]
+                    raise RuntimeError(
+                        f"backplane engine {k} failed to start: "
+                        f"{err or 'no stderr'}")
+                # drain later output so a full pipe can never block
+                import threading as _th
+                _th.Thread(target=proc.stdout.read, daemon=True).start()
+                _th.Thread(target=proc.stderr.read, daemon=True).start()
+            return procs, socks
+        except Exception:
+            for p in procs:
+                p.kill()
+            raise
+
     worker_counts = [int(w) for w in os.environ.get(
         "BENCH_C5_WORKERS", "1,2,4").split(",") if w.strip()]
-    sock_path = os.path.join(tempfile.gettempdir(),
-                             f"gk-bench-backplane-{os.getpid()}.sock")
     mw_sweep: list = []
     mw_sustained = None
-    engine_proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--serve-engine",
-         sock_path],
-        cwd=here, stdout=subprocess.PIPE, text=True)
-    try:
-        line = engine_proc.stdout.readline()
-        if "READY" not in line:
-            raise RuntimeError("backplane engine failed to start")
-        base = sustained["offered_rps"] if sustained else 500
-        for n_workers in worker_counts:
-            fronts = FrontendSupervisor(n_workers, sock_path, port=0,
-                                        addr="127.0.0.1")
-            fronts.start()
-            try:
-                mults = (1, 2, 3, 4, 6, 8) if n_workers > 1 else (1, 2)
-                rates = sorted({int(base * m) for m in mults})
-                sweep_n, sus_n = _run_sweep(fronts.port, rates,
-                                            n_procs, duration, here)
-            finally:
-                fronts.stop()
-            best_n = sus_n or (max(sweep_n,
-                                   key=lambda e: e["achieved_rps"])
-                               if sweep_n else {})
-            mw_sweep.append({
-                "workers": n_workers,
-                "admission_rps": best_n.get("achieved_rps", 0),
-                "slo_met": sus_n is not None,
-                "p50_ms": best_n.get("p50_ms"),
-                "p99_ms": best_n.get("p99_ms"),
-                "sweep": sweep_n,
-            })
-            if sus_n is not None and (
-                    mw_sustained is None
-                    or sus_n["achieved_rps"]
-                    > mw_sustained["achieved_rps"]):
-                mw_sustained = sus_n
-    except Exception as e:  # the plane must never lose the config
-        mw_sweep.append({"error": str(e)[:200]})
-    finally:
-        engine_proc.kill()
+    base = sustained["offered_rps"] if sustained else 500
+    if not worker_counts:
+        mw_sweep.append({"skipped": "BENCH_C5_WORKERS is empty"})
+    else:
+        engine_procs: list = []
+        try:
+            engine_procs, socks = _spawn_engines(1, "w")
+            for n_workers in worker_counts:
+                fronts = FrontendSupervisor(n_workers, socks[0],
+                                            port=0, addr="127.0.0.1")
+                fronts.start()
+                try:
+                    mults = (1, 2, 3, 4, 6, 8) if n_workers > 1 \
+                        else (1, 2)
+                    rates = sorted({int(base * m) for m in mults})
+                    sweep_n, sus_n = _run_sweep(fronts.port, rates,
+                                                n_procs, duration,
+                                                here)
+                finally:
+                    fronts.stop()
+                best_n = sus_n or (max(sweep_n,
+                                       key=lambda e: e["achieved_rps"])
+                                   if sweep_n else {})
+                mw_sweep.append({
+                    "workers": n_workers,
+                    "admission_rps": best_n.get("achieved_rps", 0),
+                    "slo_met": sus_n is not None,
+                    "p50_ms": best_n.get("p50_ms"),
+                    "p99_ms": best_n.get("p99_ms"),
+                    "sweep": sweep_n,
+                })
+                if sus_n is not None and (
+                        mw_sustained is None
+                        or sus_n["achieved_rps"]
+                        > mw_sustained["achieved_rps"]):
+                    mw_sustained = sus_n
+        except Exception as e:  # an EXPLICIT record, never a silent []
+            mw_sweep.append({"skipped": str(e)[:300]})
+        finally:
+            for p in engine_procs:
+                p.kill()
 
-    all_entries = sweep + [e for m in mw_sweep
+    # --- 5. N-engine plane (--admission-engines): K engine processes,
+    # one per chip, frontends routing least-load across them — the
+    # scale-with-chips topology. Each engine child self-ingests the
+    # general library; 2 frontends route over all K sockets.
+    engine_counts = [int(c) for c in os.environ.get(
+        "BENCH_C5_ENGINES", "1,2").split(",") if c.strip()]
+    me_sweep: list = []
+    me_sustained = None
+    if not engine_counts:
+        me_sweep.append({"skipped": "BENCH_C5_ENGINES is empty"})
+    elif cores < 2 and "BENCH_C5_ENGINES" not in os.environ:
+        me_sweep.append({
+            "skipped": f"{cores} host core(s): N JAX engine processes "
+                       "would time-share one core (set BENCH_C5_ENGINES "
+                       "to force)"})
+    else:
+        for n_engines in engine_counts:
+            engine_procs = []
+            try:
+                engine_procs, socks = _spawn_engines(n_engines,
+                                                     f"e{n_engines}-")
+                fronts = FrontendSupervisor(2, socks, port=0,
+                                            addr="127.0.0.1")
+                fronts.start()
+                try:
+                    rates = sorted({int(base * m)
+                                    for m in (1, 2, 4, 6, 8)})
+                    sweep_n, sus_n = _run_sweep(fronts.port, rates,
+                                                n_procs, duration,
+                                                here)
+                finally:
+                    fronts.stop()
+                best_n = sus_n or (max(sweep_n,
+                                       key=lambda e: e["achieved_rps"])
+                                   if sweep_n else {})
+                me_sweep.append({
+                    "engines": n_engines,
+                    "admission_rps": best_n.get("achieved_rps", 0),
+                    "slo_met": sus_n is not None,
+                    "p50_ms": best_n.get("p50_ms"),
+                    "p99_ms": best_n.get("p99_ms"),
+                    "sweep": sweep_n,
+                })
+                if sus_n is not None and (
+                        me_sustained is None
+                        or sus_n["achieved_rps"]
+                        > me_sustained["achieved_rps"]):
+                    me_sustained = sus_n
+            except Exception as e:
+                me_sweep.append({"engines": n_engines,
+                                 "skipped": str(e)[:300]})
+            finally:
+                for p in engine_procs:
+                    p.kill()
+
+    all_entries = sweep + [e for m in mw_sweep + me_sweep
                            for e in m.get("sweep", [])]
-    best = (mw_sustained or sustained
+    best_sus = max((s for s in (mw_sustained, me_sustained, sustained)
+                    if s is not None),
+                   key=lambda s: s["achieved_rps"], default=None)
+    best = (best_sus
             or (max(all_entries, key=lambda e: e["achieved_rps"])
                 if all_entries else {}))
     print(json.dumps({
@@ -1143,7 +1238,7 @@ def config5():
                 "library; highest offered rate with p99<100ms, else "
                 "the measured host ceiling; best across the serving-"
                 "plane worker counts)",
-        "slo_met": (mw_sustained or sustained) is not None,
+        "slo_met": best_sus is not None,
         "p50_ms": best.get("p50_ms"), "p99_ms": best.get("p99_ms"),
         "host_cores": cores,
         "worker_counts": worker_counts,
@@ -1163,7 +1258,127 @@ def config5():
                       "backplane (--admission-workers)",
         "sweep": sweep,
         "multi_worker_sweep": mw_sweep,
+        # K engine processes (the --admission-engines topology), 2
+        # frontends routing least-load across all K sockets; entries
+        # are per engine count, or one explicit skip record
+        "multi_engine_sweep": me_sweep,
     }))
+
+
+# -------------------------------------------------------------- config 10
+
+
+def _mesh_audit_child(n_objects: int, n_constraints: int) -> None:
+    """--mesh-audit child: one audit-scaling measurement in a fresh
+    process (the parent sets GATEKEEPER_TPU_MESH / XLA_FLAGS before
+    JAX initializes here). Prints one JSON line."""
+    import jax
+
+    from gatekeeper_tpu.client import Backend
+    from gatekeeper_tpu.ir import TpuDriver
+    from gatekeeper_tpu.parallel.workload import (
+        REQUIRED_LABELS_TEMPLATE, synth_constraints, synth_objects)
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    driver = TpuDriver()
+    client = Backend(driver).new_client([K8sValidationTarget()])
+    client.add_template(REQUIRED_LABELS_TEMPLATE)
+    for c in synth_constraints(n_constraints, seed=1):
+        client.add_constraint(c)
+    for o in synth_objects(n_objects, violate_frac=0.002, seed=0):
+        client.add_data(o)
+    t0 = time.time()
+    resp = client.audit()
+    first_s = time.time() - t0
+    t_warm = time.time()
+    while driver.warm_status()["compiling"] and \
+            time.time() - t_warm < 600:
+        time.sleep(0.2)
+    audit_s = float("inf")
+    for _ in range(3):
+        cache = getattr(driver, "_audit_results_cache", None)
+        if cache is not None:
+            cache.clear()  # measure the full sweep, not the delta hit
+        t0 = time.time()
+        resp = client.audit()
+        audit_s = min(audit_s, time.time() - t0)
+    print(json.dumps({
+        "audit_s": round(audit_s, 3),
+        "first_audit_s": round(first_s, 2),
+        "path": driver.last_audit_path,
+        "violations": len(resp.results()),
+        "n_devices": len(jax.devices())}))
+
+
+def config10():
+    """Multichip audit promotion at 1M+ objects: the DEFAULT no-flag
+    audit path must report mesh(data=N) whenever more than one device
+    is visible, and wall-clock must improve against the forced
+    single-device path. Each measurement runs in a fresh subprocess so
+    the device topology (GATEKEEPER_TPU_MESH, XLA_FLAGS) binds before
+    JAX initializes; on a 1-device host the mesh run forces 8
+    host-platform devices so the sharded slab pipeline is exercised
+    (those time-share the same cores — the record says which it was,
+    so a CPU ratio is read as path validation, not chip scaling)."""
+    import subprocess
+
+    n_objects = int(os.environ.get("BENCH_C10_OBJECTS",
+                                   int(1_000_000 * SCALE)))
+    n_cons = int(os.environ.get("BENCH_C10_CONSTRAINTS", 100))
+    here = os.path.dirname(os.path.abspath(__file__))
+    import jax
+    n_dev = len(jax.devices())
+    forced = n_dev < 2
+
+    def run_child(mesh_cfg: str) -> dict:
+        env = dict(os.environ)
+        env["GATEKEEPER_TPU_MESH"] = mesh_cfg
+        if forced:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mesh-audit",
+             str(n_objects), str(n_cons)],
+            cwd=here, capture_output=True, text=True, env=env,
+            timeout=int(os.environ.get("BENCH_C10_TIMEOUT", 1800)))
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError(f"mesh-audit child ({mesh_cfg}) failed: "
+                           f"{(proc.stderr or '')[-300:]}")
+
+    out = {"config": 10, "metric": "mesh_audit_wall_clock_s",
+           "objects": n_objects, "constraints": n_cons,
+           "host_devices": n_dev,
+           "mesh_platform": ("8 forced host-platform (cpu) devices"
+                             if forced else f"{n_dev} devices")}
+    try:
+        mesh = run_child("auto")
+        single = run_child("off")
+        out.update({
+            "value": mesh["audit_s"],
+            "unit": f"s (one client.audit(), min of 3 warm sweeps; "
+                    f"{n_cons} constraints x {n_objects} objects, "
+                    "default no-flag mesh path)",
+            "audit_path": mesh["path"],
+            "first_audit_s": mesh["first_audit_s"],
+            "violations": mesh["violations"],
+            "single_device_s": single["audit_s"],
+            "single_first_audit_s": single["first_audit_s"],
+            "vs_single_device": (round(single["audit_s"]
+                                       / mesh["audit_s"], 2)
+                                 if mesh["audit_s"] else None),
+        })
+        if forced:
+            out["note"] = ("host-platform devices time-share the same "
+                           "CPU cores: vs_single_device here validates "
+                           "the sharded path, not chip scaling")
+    except Exception as e:  # an explicit record, never a lost config
+        out.update({"value": None, "skipped": str(e)[:300]})
+    print(json.dumps(out))
 
 
 # --------------------------------------------------------------- config 8
@@ -1258,7 +1473,7 @@ def config8():
 
 def run(which: list[int]) -> None:
     table = {1: config1, 2: config2, 3: config3, 5: config5, 6: config6,
-             7: config7, 8: config8, 9: config9}
+             7: config7, 8: config8, 9: config9, 10: config10}
     for c in which:
         if c not in table:
             sys.exit(f"unknown bench config {c}: choose from "
@@ -1275,6 +1490,9 @@ def main() -> None:
         return
     if sys.argv[1:2] == ["--serve-engine"]:
         _engine_child(sys.argv[2])
+        return
+    if sys.argv[1:2] == ["--mesh-audit"]:
+        _mesh_audit_child(int(sys.argv[2]), int(sys.argv[3]))
         return
     run([int(a) for a in sys.argv[1:]] or [1, 2, 3, 5, 6, 7])
 

@@ -19,7 +19,7 @@ from __future__ import annotations
 import copy
 import json
 import threading
-from typing import Any, Iterable, Union
+from typing import Any, Iterable, Optional, Union
 
 from .crd import CRDError, create_crd, create_schema, validate_cr, validate_crd
 from .drivers import Driver, hook_audit_path, hook_violation_path
@@ -84,6 +84,31 @@ class Client:
         # a level-triggered controller replaying identical CRs must not
         # cold the cache.
         self._generation = 0
+        # library-change observer (the N-engine admission plane's
+        # replication hook): called AFTER a mutation applied and bumped
+        # this client's generation, with (op, plain object) — ops:
+        # add_template / remove_template / add_constraint /
+        # remove_constraint / add_data / remove_data. Semantic-equal
+        # dedupes do not notify (nothing changed, nothing to fan out).
+        # Each replica client bumps ITS OWN generation when the op
+        # lands there, so every engine's decision-cache keys stay
+        # coherent with that engine's library.
+        self.on_change: Optional[Any] = None
+
+    def _notify(self, op: str, obj) -> None:
+        """Run the observer OUTSIDE the client lock (it does I/O to the
+        engine processes); a replication failure is the supervisor's to
+        heal (resync), never an ingestion error."""
+        cb = self.on_change
+        if cb is None or obj is None:
+            return
+        try:
+            cb(op, obj)
+        except Exception:
+            import logging
+
+            logging.getLogger("gatekeeper_tpu.client").warning(
+                "library change notification failed", exc_info=True)
 
     @property
     def generation(self) -> int:
@@ -165,6 +190,8 @@ class Client:
             self._templates[ct.kind] = entry
             resp.handled[handler.get_name()] = True
             self._generation += 1
+        self._notify("add_template",
+                     templ if isinstance(templ, dict) else ct.raw)
         return resp
 
     def remove_template(self, templ: Union[dict, ConstraintTemplate]) -> Responses:
@@ -182,6 +209,8 @@ class Client:
                 )
                 resp.handled[target] = True
             self._generation += 1
+        self._notify("remove_template",
+                     templ if isinstance(templ, dict) else ct.raw)
         return resp
 
     def get_template(self, kind_or_templ: Union[str, dict, ConstraintTemplate]
@@ -249,6 +278,7 @@ class Client:
                 self._generation += 1
         if errs:
             raise ClientError(str(errs))
+        self._notify("add_constraint", constraint)
         return resp
 
     def remove_constraint(self, constraint: dict) -> Responses:
@@ -261,6 +291,7 @@ class Client:
                 resp.handled[target] = True
             entry.constraints.pop(name, None)
             self._generation += 1
+        self._notify("remove_constraint", constraint)
         return resp
 
     def get_constraint(self, kind: str, name: str) -> dict:
@@ -311,6 +342,7 @@ class Client:
             # can flip a cached verdict, so it invalidates like a
             # constraint change (clusters without sync never pay this)
             self._bump_generation()
+            self._notify("add_data", obj)
         if errs:
             raise ClientError(str(errs))
         return resp
@@ -333,6 +365,7 @@ class Client:
                 errs[name] = e
         if resp.handled:
             self._bump_generation()
+            self._notify("remove_data", obj)
         if errs:
             raise ClientError(str(errs))
         return resp
@@ -498,6 +531,14 @@ class Client:
     def knows_kind(self, kind: str) -> bool:
         with self._lock:
             return kind in self._templates
+
+    def library_index(self) -> dict:
+        """{kind: [constraint names]} of the ingested library — the
+        N-engine sync diff uses it to drop templates/constraints a
+        restarted primary no longer carries."""
+        with self._lock:
+            return {k: sorted(e.constraints)
+                    for k, e in self._templates.items()}
 
     def template_kinds(self) -> list[str]:
         with self._lock:

@@ -35,6 +35,21 @@ Wire protocol, length-prefixed frames over a Unix domain socket
     'S'      := stats JSON (aggregated forward-latency histogram delta
                 + failure-stance answer count + per-stage span-duration
                 histogram deltas for sampled requests) (frontend -> engine)
+    'L'      := id u32be, library-op JSON           (primary -> engine)
+    'M'      := id u32be (stats poll; engine answers R with its
+                relayed-metrics snapshot JSON)      (primary -> engine)
+
+N-engine plane (--admission-engines > 1): one engine PROCESS per chip,
+each with its own Client/MicroBatcher/device and its own socket
+(`<base>.<k>`); frontends hold one multiplexed connection per engine
+and route each review to the least-loaded engine (fallback:
+request-hash), failing over to the next engine when one dies
+mid-burst. The PRIMARY process (engine 0, in-process) replicates every
+library mutation to every engine child over L frames — each child's
+Client bumps its own generation when the op lands, so decision-cache
+keys stay coherent per engine — and polls per-engine metric totals
+over M frames, merging deltas into its registry so shed accounting and
+decision counts stay global.
 
 Span context over the split: the FRONTEND makes the sampling decision
 at the HTTP edge (it parses `traceparent`, answers `X-Trace-Id`); a
@@ -107,6 +122,10 @@ def _bucket_observe(counts: list, bounds: tuple, seconds: float) -> None:
     counts[-1] += 1
 
 STATS_INTERVAL_S = 2.0
+# R-frame status an engine answers while it is NOT READY to serve (a
+# respawned engine child before its library sync): never surfaces as an
+# HTTP verdict — the router fails the request over to a synced engine
+STATUS_NOT_READY = 599
 # per-operation socket timeout on backplane I/O: a WEDGED (not dead)
 # peer must unblock senders so frontends can answer per the failure
 # stance instead of hanging HTTP threads past their deadlines
@@ -159,12 +178,26 @@ class BackplaneEngine:
 
     def __init__(self, socket_path: str, validation=None, ns_label=None,
                  mutation=None, max_workers: int = 128,
-                 default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S):
+                 default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S,
+                 engine_id: str = "0", library_sink=None,
+                 stats_source=None):
         self.socket_path = socket_path
         self.validation = validation
         self.ns_label = ns_label
         self.mutation = mutation
         self.default_timeout = default_timeout
+        self.engine_id = str(engine_id)
+        # L-frame handler (engine children): applies one replicated
+        # library op to this engine's Client/MutationSystem
+        self.library_sink = library_sink
+        # M-frame handler: answers the primary's stats poll (defaults
+        # to the registry's relay snapshot in engine children)
+        self.stats_source = stats_source
+        # when set, Q frames answer STATUS_NOT_READY until it returns
+        # True: a RESPAWNED engine child must not serve admission
+        # verdicts from its empty pre-sync library — the router fails
+        # those requests over to a synced engine
+        self.ready_check: Optional[Callable[[], bool]] = None
         self._max_workers = max_workers
         self._listener: Optional[socket.socket] = None
         self._pool = None
@@ -296,6 +329,13 @@ class BackplaneEngine:
                 kind = payload[:1]
                 if kind == b"Q":
                     rid, timeout_s = _Q_HEADER.unpack_from(payload, 1)
+                    if self.ready_check is not None \
+                            and not self.ready_check():
+                        _send_frame(conn, wlock, b"R",
+                                    _R_HEADER.pack(rid,
+                                                   STATUS_NOT_READY),
+                                    b"engine awaiting library sync")
+                        continue
                     off = 1 + _Q_HEADER.size
                     tflags = payload[off]
                     off += 1
@@ -370,6 +410,41 @@ class BackplaneEngine:
                              details={"worker": worker})
                 elif kind == b"S":
                     self._merge_stats(jsonio.loads(payload[1:]) or {})
+                elif kind == b"L":
+                    # replicated library op from the primary: applied
+                    # INLINE on this read loop, so ops from the one
+                    # control connection apply in send order (the
+                    # engine's own Client bumps its generation under
+                    # the op — decision-cache coherence needs no extra
+                    # fence). Admission traffic rides the frontends'
+                    # separate connections, unaffected.
+                    (rid,) = struct.unpack("!I", payload[1:5])
+                    status, out = 200, b""
+                    try:
+                        if self.library_sink is None:
+                            status = 404
+                        else:
+                            self.library_sink(jsonio.loads(payload[5:])
+                                              or {})
+                    except Exception as e:
+                        log.error("library replication op failed",
+                                  details=str(e))
+                        status = 500
+                        out = str(e).encode("utf-8", "replace")[:512]
+                    _send_frame(conn, wlock, b"R",
+                                _R_HEADER.pack(rid, status), out)
+                elif kind == b"M":
+                    (rid,) = struct.unpack("!I", payload[1:5])
+                    try:
+                        src = self.stats_source
+                        stats = src() if src is not None else {}
+                        _send_frame(conn, wlock, b"R",
+                                    _R_HEADER.pack(rid, 200),
+                                    jsonio.dumps_bytes(stats))
+                    except Exception as e:
+                        log.error("stats poll failed", details=str(e))
+                        _send_frame(conn, wlock, b"R",
+                                    _R_HEADER.pack(rid, 500), b"")
         except (ConnectionError, OSError):
             pass
         finally:
@@ -640,6 +715,17 @@ class BackplaneClient:
     def connected(self) -> bool:
         return self._sock is not None
 
+    def ensure_connected(self) -> None:
+        """Eager connect (boot-time): lets the engine's connected-
+        workers gauge reflect the plane before the first request."""
+        self._ensure_connected()
+
+    def inflight(self) -> int:
+        """Requests forwarded and not yet answered — the router's
+        least-load signal."""
+        with self._pending_lock:
+            return len(self._pending)
+
     def close(self) -> None:
         self._closed = True
         sock = self._sock
@@ -716,6 +802,138 @@ class BackplaneClient:
             _send_frame(sock, self._wlock, b"S", jsonio.dumps_bytes(stats))
         except OSError:
             self._drop(sock)
+
+    def _request_frame(self, kind: bytes, body: bytes,
+                       timeout: float) -> tuple[int, bytes]:
+        """One control round trip (L/M frames): send, wait on the
+        shared waiter map. Raises BackplaneError on loss/timeout."""
+        sock = self._ensure_connected()
+        waiter = _Waiter()
+        with self._pending_lock:
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+            rid = self._next_id
+            self._pending[rid] = waiter
+        try:
+            _send_frame(sock, self._wlock, kind, struct.pack("!I", rid),
+                        body)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            self._drop(sock)
+            raise BackplaneError(
+                f"engine connection lost: {e}") from e
+        if not waiter.event.wait(timeout):
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise BackplaneError("engine control call timed out")
+        if waiter.status < 0:
+            raise BackplaneError("engine connection lost")
+        return waiter.status, waiter.body
+
+    def control(self, op: dict, timeout: float = 30.0) -> None:
+        """Replicate one library op to this engine (primary-side).
+        Raises BackplaneError when the op did not land — the caller
+        marks the engine dirty and resyncs."""
+        status, body = self._request_frame(
+            b"L", jsonio.dumps_bytes(op), timeout)
+        if status != 200:
+            raise BackplaneError(
+                f"library op refused ({status}): "
+                f"{body.decode('utf-8', 'replace')[:200]}")
+
+    def poll_stats(self, timeout: float = 10.0) -> dict:
+        """Fetch this engine's relayed-metrics snapshot (M frame)."""
+        status, body = self._request_frame(b"M", b"", timeout)
+        if status != 200:
+            raise BackplaneError(f"stats poll refused ({status})")
+        try:
+            return jsonio.loads(body) or {}
+        except ValueError as e:
+            raise BackplaneError(f"stats poll unparseable: {e}") from e
+
+
+# ----------------------------------------------------------------- router
+
+
+class BackplaneRouter:
+    """Frontend-side fan-in over N engine sockets: one multiplexed
+    BackplaneClient per engine. Routing: least in-flight forwards
+    first; ties break on the request hash (stable spread under equal
+    load); an engine that fails mid-call (died, wedged, unreachable)
+    fails over to the next-best engine — each tried at most once — so
+    one killed engine costs its in-flight requests one retry, not a
+    stance answer, and the burst keeps completing on the survivors.
+
+    Drop-in for BackplaneClient where the FrontendServer is concerned
+    (call / send_stats / connected / close)."""
+
+    def __init__(self, socket_paths, worker_id: str = "0",
+                 connect_timeout: float = 1.0):
+        paths = list(socket_paths)
+        if not paths:
+            raise ValueError("router needs at least one engine socket")
+        self.clients = [BackplaneClient(p, worker_id=worker_id,
+                                        connect_timeout=connect_timeout)
+                        for p in paths]
+
+    def connected(self) -> bool:
+        return any(c.connected() for c in self.clients)
+
+    def ensure_connected(self) -> None:
+        for c in self.clients:
+            try:
+                c.ensure_connected()
+            except BackplaneError:
+                pass  # that engine retries lazily on first forward
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close()
+
+    def call(self, path: str, body: bytes, timeout_s: float,
+             deadline: float,
+             trace_ctx: Optional[tuple] = None) -> tuple[int, bytes]:
+        clients = self.clients
+        if len(clients) == 1:
+            status, out = clients[0].call(path, body, timeout_s,
+                                          deadline,
+                                          trace_ctx=trace_ctx)
+            if status == STATUS_NOT_READY:
+                # no synced engine to fail over to: the frontend
+                # answers per the failure stance
+                raise BackplaneError("engine awaiting library sync")
+            return status, out
+        import zlib
+
+        h = zlib.crc32(body) % len(clients)
+        order = sorted(range(len(clients)),
+                       key=lambda k: (clients[k].inflight(),
+                                      (k - h) % len(clients)))
+        err: Optional[BackplaneError] = None
+        for k in order:
+            try:
+                status, out = clients[k].call(path, body, timeout_s,
+                                              deadline,
+                                              trace_ctx=trace_ctx)
+            except BackplaneError as e:
+                err = e  # next engine; the burst must not drop
+                continue
+            if status == STATUS_NOT_READY:
+                # a respawned engine awaiting its library sync: a
+                # synced engine must answer instead
+                err = BackplaneError("engine awaiting library sync")
+                continue
+            return status, out
+        raise err if err is not None else BackplaneError("no engines")
+
+    def send_stats(self, stats: dict) -> None:
+        # stats go to the PRIMARY engine (index 0 — the process whose
+        # registry is scraped); fall back to any connected engine so a
+        # dead primary does not silently eat the deltas forever
+        for c in self.clients:
+            if c.connected():
+                c.send_stats(stats)
+                return
 
 
 # --------------------------------------------------------------- frontend
@@ -925,7 +1143,7 @@ class FrontendSupervisor:
     them all to one SO_REUSEPORT port, respawns crashed children, and
     drains them BEFORE the engine on shutdown."""
 
-    def __init__(self, n: int, socket_path: str, port: int = 8443,
+    def __init__(self, n: int, socket_path, port: int = 8443,
                  addr: str = "", certfile: Optional[str] = None,
                  keyfile: Optional[str] = None,
                  serve: tuple = ("admit", "admitlabel", "mutate"),
@@ -936,6 +1154,10 @@ class FrontendSupervisor:
                  trace_sample_rate: float = 0.0):
         self.n = n
         self.trace_sample_rate = trace_sample_rate
+        # one socket (single engine) or a list (the N-engine plane:
+        # each frontend connects to every engine and routes)
+        if not isinstance(socket_path, str):
+            socket_path = ",".join(socket_path)
         self.socket_path = socket_path
         self.addr = addr
         self.certfile = certfile
@@ -1079,6 +1301,259 @@ class FrontendSupervisor:
             self._holder = None
 
 
+# ------------------------------------------------------ engine supervisor
+
+
+class EngineSupervisor:
+    """Spawns the N-1 admission ENGINE child processes of the N-engine
+    plane (engine 0 stays in the primary process), one per chip —
+    `python -m gatekeeper_tpu.control.engine --engine-id k --device k`
+    — monitors and respawns them, replicates every library mutation to
+    each over L frames (a freshly (re)spawned or replication-failed
+    engine gets a FULL sync first), and polls per-engine metric totals
+    over M frames, merging the deltas into this process's registry so
+    shed accounting / decision counts / cache outcomes stay global on
+    the primary's /metrics."""
+
+    POLL_INTERVAL_S = 2.0
+
+    def __init__(self, engine_ids, socket_for, spawn_args=(),
+                 snapshot_provider=None, ready_timeout: float = 180.0):
+        self.engine_ids = list(engine_ids)
+        self.socket_for = socket_for          # engine id -> socket path
+        self.spawn_args = list(spawn_args)    # passthrough CLI flags
+        self.snapshot_provider = snapshot_provider  # () -> full sync op
+        self.ready_timeout = ready_timeout
+        self._procs: dict[int, Optional[subprocess.Popen]] = \
+            {k: None for k in self.engine_ids}
+        self._ctl: dict[int, BackplaneClient] = {}
+        self._dirty: dict[int, bool] = {k: True for k in self.engine_ids}
+        self._prev_stats: dict[int, dict] = {}
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # spawn / readiness ----------------------------------------------
+
+    def _spawn(self, k: int) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "gatekeeper_tpu.control.engine",
+               "--socket", self.socket_for(k),
+               "--engine-id", str(k),
+               "--device", str(k)] + self.spawn_args
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+
+    def _await_ready(self, k: int, proc: subprocess.Popen,
+                     deadline: float) -> None:
+        line: list = []
+
+        def read():
+            line.append(proc.stdout.readline())
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(max(0.1, deadline - time.monotonic()))
+        if not line or "READY" not in (line[0] or ""):
+            raise RuntimeError(f"admission engine {k} failed to start")
+        threading.Thread(target=lambda: proc.stdout.read(),
+                         daemon=True).start()
+
+    def start(self) -> None:
+        try:
+            deadline = time.monotonic() + self.ready_timeout
+            for k in self.engine_ids:
+                self._procs[k] = self._spawn(k)
+            for k in self.engine_ids:
+                self._await_ready(k, self._procs[k], deadline)
+        except Exception:
+            self._stopping.set()
+            for proc in self._procs.values():
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+            raise
+        for k in self.engine_ids:
+            self._ctl[k] = BackplaneClient(self.socket_for(k),
+                                           worker_id=f"ctl-{k}",
+                                           connect_timeout=5.0)
+            self._resync(k)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="engine-supervisor",
+                                         daemon=True)
+        self._monitor.start()
+        log.info("admission engines serving",
+                 details={"engines": 1 + len(self.engine_ids)})
+
+    # library replication --------------------------------------------
+
+    def _resync(self, k: int) -> None:
+        """Full library sync to one engine (boot, respawn, or heal
+        after a failed incremental op). A sync that itself fails keeps
+        the engine dirty; the monitor loop retries.
+
+        The lock makes (clear dirty, snapshot, send) atomic with
+        respect to replicate(): without it, an op landing between the
+        snapshot and the sync SEND could replicate first and then be
+        REMOVED by the sync's drop-extras reconciliation (built from
+        the pre-op snapshot) — a permanently lost mutation on that
+        engine. Under the lock every racing op sends after the sync
+        frame on the ordered control stream, so it re-applies; an op
+        the snapshot already caught applies twice, which the clients'
+        semantic-equal dedupe absorbs."""
+        provider = self.snapshot_provider
+        if provider is None:
+            self._dirty[k] = False
+            return
+        with self._lock:
+            self._dirty[k] = False
+            try:
+                op = provider()
+                op["op"] = "sync"
+                self._ctl[k].control(op, timeout=120.0)
+                log.info("engine resynced", details={"engine": k})
+            except Exception as e:
+                self._dirty[k] = True
+                log.warning("engine resync failed; will retry",
+                            details={"engine": k, "error": str(e)})
+
+    def replicate(self, op: str, obj) -> None:
+        """Fan one library mutation out to every engine child (the
+        primary's own client already applied it). Called from the
+        Client's on_change observer — failures mark the engine dirty
+        for a monitor-loop resync, they never raise into ingestion.
+        Serialized against _resync by the lock (see there)."""
+        msg = {"op": op, "obj": obj}
+        with self._lock:
+            for k in self.engine_ids:
+                ctl = self._ctl.get(k)
+                if ctl is None or self._dirty.get(k):
+                    continue  # resync (which includes this op) pending
+                try:
+                    ctl.control(msg)
+                except BackplaneError as e:
+                    self._dirty[k] = True
+                    log.warning("library replication failed; engine "
+                                "marked for resync",
+                                details={"engine": k, "error": str(e)})
+
+    # monitor / stats ------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        last_poll = 0.0
+        while not self._stopping.wait(0.5):
+            # two-pass respawn: spawn EVERY dead engine first, then
+            # await readiness — concurrently-dead engines initialize
+            # in parallel instead of head-of-line blocking on one
+            # child's (potentially slow) JAX/device init
+            spawned: list = []
+            for k in self.engine_ids:
+                proc = self._procs.get(k)
+                if proc is not None and proc.poll() is not None \
+                        and not self._stopping.is_set():
+                    log.warning("admission engine died; respawning",
+                                details={"engine": k,
+                                         "rc": proc.returncode})
+                    old = self._ctl.pop(k, None)
+                    if old is not None:
+                        old.close()
+                    self._prev_stats.pop(k, None)
+                    try:
+                        spawned.append((k, self._spawn(k)))
+                    except Exception as e:
+                        log.error("engine respawn failed",
+                                  details={"engine": k,
+                                           "error": str(e)})
+            for k, p in spawned:
+                try:
+                    self._await_ready(
+                        k, p, time.monotonic() + self.ready_timeout)
+                    self._procs[k] = p
+                    self._ctl[k] = BackplaneClient(
+                        self.socket_for(k), worker_id=f"ctl-{k}",
+                        connect_timeout=5.0)
+                    self._dirty[k] = True
+                    # sync NOW, not next pass: the engine refuses
+                    # admission (NOT_READY) until this lands, so the
+                    # shorter the window the less failover traffic
+                    # the survivors absorb
+                    self._resync(k)
+                except Exception as e:
+                    log.error("engine respawn failed",
+                              details={"engine": k, "error": str(e)})
+                    # the dead proc stays in _procs[k]: retried next
+                    # pass; never leak the half-started child
+                    try:
+                        p.kill()
+                    except OSError:
+                        pass
+            for k in self.engine_ids:
+                if self._dirty.get(k) and k in self._ctl:
+                    self._resync(k)
+            now = time.monotonic()
+            if now - last_poll >= self.POLL_INTERVAL_S:
+                last_poll = now
+                self.poll_stats()
+                from . import metrics
+
+                metrics.report_admission_engines(
+                    1 + len(self.engine_ids), 1 + self.alive_count())
+
+    def poll_stats(self) -> None:
+        """Pull each engine's relayed metric totals and merge the
+        delta since the previous poll into this process's registry."""
+        from . import metrics
+
+        for k in self.engine_ids:
+            ctl = self._ctl.get(k)
+            if ctl is None:
+                continue
+            try:
+                cur = ctl.poll_stats(timeout=5.0)
+            except BackplaneError:
+                continue  # dead/respawning engine: next pass
+            metrics.merge_engine_stats(cur, self._prev_stats.get(k))
+            self._prev_stats[k] = cur
+
+    def alive_count(self) -> int:
+        return sum(1 for p in self._procs.values()
+                   if p is not None and p.poll() is None)
+
+    def monitoring(self) -> bool:
+        """The supervisor's health signal: the monitor thread is still
+        respawning dead engines (NOT all-alive — a dead child mid-
+        respawn is a degraded-but-serving state)."""
+        t = self._monitor
+        return bool(t and t.is_alive()) and not self._stopping.is_set()
+
+    def alive(self) -> bool:
+        return self.alive_count() == len(self.engine_ids)
+
+    def kill_engine(self, k: int) -> None:
+        """Chaos hook: SIGKILL one engine child (the monitor respawns
+        it; frontends fail its in-flight requests over to survivors)."""
+        proc = self._procs.get(k)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    def stop(self, timeout: float = 15.0) -> None:
+        self._stopping.set()
+        for ctl in self._ctl.values():
+            ctl.close()
+        self._ctl.clear()
+        for proc in self._procs.values():
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+        end = time.monotonic() + timeout
+        for proc in self._procs.values():
+            if proc is None:
+                continue
+            try:
+                proc.wait(max(0.1, end - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 # ------------------------------------------------------- frontend process
 
 
@@ -1089,7 +1564,10 @@ def frontend_main(argv=None) -> int:
     import argparse
 
     p = argparse.ArgumentParser(prog="gatekeeper-tpu-frontend")
-    p.add_argument("--socket", required=True)
+    p.add_argument("--socket", required=True,
+                   help="engine backplane socket(s); comma-separated "
+                        "for the N-engine plane (the frontend routes "
+                        "least-load with request-hash fallback)")
     p.add_argument("--port", type=int, default=8443)
     p.add_argument("--addr", default="")
     p.add_argument("--certfile", default="")
@@ -1112,7 +1590,10 @@ def frontend_main(argv=None) -> int:
     # the frontend is a sampling edge only — span context forwards to
     # the engine, which owns the recorder/metrics sinks
     gtrace.TRACER.configure(args.trace_sample_rate)
-    client = BackplaneClient(args.socket, worker_id=args.worker_id)
+    sockets = [s for s in args.socket.split(",") if s]
+    client = (BackplaneClient(sockets[0], worker_id=args.worker_id)
+              if len(sockets) == 1 else
+              BackplaneRouter(sockets, worker_id=args.worker_id))
     server = FrontendServer(
         client, port=args.port, addr=args.addr,
         certfile=args.certfile or None, keyfile=args.keyfile or None,
@@ -1138,7 +1619,7 @@ def frontend_main(argv=None) -> int:
     # connect eagerly so the engine's connected-workers gauge reflects
     # the plane before the first request (reconnects are lazy per call)
     try:
-        client._ensure_connected()
+        client.ensure_connected()
     except BackplaneError:
         pass  # engine not up yet; the first forward retries
     print(f"READY {server.port}", flush=True)

@@ -1,0 +1,244 @@
+"""One admission ENGINE process of the N-engine serving plane.
+
+`python -m gatekeeper_tpu.control.engine --socket S --engine-id K
+--device K ...` builds a full evaluation stack — TpuDriver pinned to
+ONE chip, Client, MicroBatcher, validation/mutation handlers — behind a
+BackplaneEngine on its own Unix socket. Frontends route reviews across
+all engines (least-load, request-hash fallback), so `admission_rps`
+scales with chips instead of saturating one GIL + one device queue.
+
+The engine owns no kube connection and no controllers: the PRIMARY
+process (engine 0) watches the cluster and replicates every library
+mutation here over L frames — templates, constraints, synced data,
+mutators — applied through this process's own Client, which bumps its
+own generation per op, keeping the decision cache's generation keys
+coherent with the library this engine actually evaluates. A fresh or
+healed engine receives a full `sync` op first (library snapshot +
+inventory tree + mutator sources, with stale extras diffed away).
+
+The PR 3-6 serving contracts hold unchanged because the serving path IS
+BackplaneEngine: deadlines pin at frame receipt, `--admission-max-queue`
+arrives pre-divided by the engine count (the bound stays global), shed
+and decision metrics accumulate in this process's registry and relay to
+the primary over M-frame polls, and SIGTERM drains the batcher.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from . import logging as glog
+from . import metrics
+from . import trace as gtrace
+from .backplane import BackplaneEngine
+from .webhook import (
+    DEFAULT_WEBHOOK_TIMEOUT_S,
+    MicroBatcher,
+    MutationHandler,
+    NamespaceLabelHandler,
+    ValidationHandler,
+)
+
+log = glog.logger("engine")
+
+
+def _template_kind(tpl: dict) -> str:
+    spec = tpl.get("spec") or {}
+    names = ((spec.get("crd") or {}).get("spec") or {}).get("names") or {}
+    return names.get("kind") or (tpl.get("metadata") or {}).get("name", "")
+
+
+class LibrarySink:
+    """Applies replicated library ops to this engine's Client (and
+    MutationSystem). Ops arrive in send order on the primary's one
+    control connection; `sync` reconciles the full state — replaying
+    the snapshot through normal ingestion (semantic-equal dedupe makes
+    it idempotent) and removing templates/constraints/mutators the
+    primary no longer carries."""
+
+    def __init__(self, client, mutation_system=None):
+        self.client = client
+        self.mutation_system = mutation_system
+        # flips on the first full sync: the backplane answers admission
+        # Q frames NOT_READY until then, so a respawned engine never
+        # issues verdicts from its empty pre-sync library
+        self.synced = False
+
+    def __call__(self, op: dict) -> None:
+        kind = op.get("op")
+        obj = op.get("obj")
+        client = self.client
+        if kind == "sync":
+            self._sync(op)
+        elif kind == "add_template":
+            client.add_template(obj)
+        elif kind == "remove_template":
+            client.remove_template(obj)
+        elif kind == "add_constraint":
+            client.add_constraint(obj)
+        elif kind == "remove_constraint":
+            client.remove_constraint(obj)
+        elif kind == "add_data":
+            client.add_data(obj)
+        elif kind == "remove_data":
+            client.remove_data(obj)
+        elif kind == "upsert_mutator":
+            if self.mutation_system is not None:
+                self.mutation_system.upsert(obj)
+        elif kind == "remove_mutator":
+            if self.mutation_system is not None:
+                self.mutation_system.remove(
+                    (obj.get("kind"), (obj.get("metadata") or {})
+                     .get("name")))
+        else:
+            raise ValueError(f"unknown library op {kind!r}")
+
+    def _sync(self, op: dict) -> None:
+        client = self.client
+        lib = op.get("library") or {}
+        snap_kinds = {_template_kind(t)
+                      for t in lib.get("templates") or []}
+        snap_cons = {((c.get("kind") or ""),
+                      ((c.get("metadata") or {}).get("name") or ""))
+                     for c in lib.get("constraints") or []}
+        # drop extras FIRST (a removed template must stop enforcing
+        # even though the snapshot replay would never mention it)
+        index = client.library_index()
+        for tk, names in index.items():
+            if tk not in snap_kinds:
+                try:
+                    client.remove_template(client.get_template(tk))
+                except Exception:
+                    pass
+                continue
+            for name in names:
+                if (tk, name) not in snap_cons:
+                    try:
+                        client.remove_constraint(
+                            client.get_constraint(tk, name))
+                    except Exception:
+                        pass
+        out = client.restore_library(lib)
+        data = op.get("data")
+        n_data = 0
+        driver = getattr(client, "driver", None)
+        if data and hasattr(driver, "inventory_restore"):
+            n_data = driver.inventory_restore(data)
+        ms = self.mutation_system
+        if ms is not None:
+            keep = set()
+            for m in op.get("mutators") or []:
+                ms.upsert(m)
+                keep.add(((m.get("kind") or ""),
+                          ((m.get("metadata") or {}).get("name") or "")))
+            for mut in ms.mutators():
+                if tuple(mut.id) not in keep:
+                    ms.remove(mut.id)
+        self.synced = True
+        log.info("library synced",
+                 details={**out, "data_objects": n_data})
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="gatekeeper-tpu-engine")
+    p.add_argument("--socket", required=True)
+    p.add_argument("--engine-id", default="1")
+    p.add_argument("--device", type=int, default=-1,
+                   help="index into jax.devices() this engine pins its "
+                        "evaluation to; -1 = the platform default")
+    p.add_argument("--serve", default="admit,admitlabel",
+                   help="operations this engine evaluates "
+                        "(admit,admitlabel,mutate)")
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--log-denies", action="store_true")
+    p.add_argument("--fail-closed", action="store_true")
+    p.add_argument("--mutation-fail-closed", default="unset",
+                   choices=["true", "false", "unset"])
+    p.add_argument("--mutation-max-iterations", type=int, default=10)
+    p.add_argument("--mutation-batch-max-wait", type=float, default=0.005)
+    p.add_argument("--admission-max-queue", type=int, default=4096,
+                   help="THIS engine's share of the global bound (the "
+                        "primary divides --admission-max-queue by the "
+                        "engine count)")
+    p.add_argument("--admission-default-timeout", type=float,
+                   default=DEFAULT_WEBHOOK_TIMEOUT_S)
+    p.add_argument("--admission-decision-cache", type=int, default=4096)
+    p.add_argument("--exempt-namespace", action="append", default=[])
+    p.add_argument("--trace-sample-rate", type=float, default=0.0)
+    p.add_argument("--trace-slow-threshold", type=float, default=1.0)
+    p.add_argument("--fault-injection", default="")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    glog.setup(args.log_level)
+    metrics.set_engine_id(args.engine_id)
+    gtrace.TRACER.configure(args.trace_sample_rate,
+                            args.trace_slow_threshold)
+    if args.fault_injection:
+        from ..utils.faults import FAULTS
+
+        FAULTS.configure(args.fault_injection)
+    from ..client import Backend
+    from ..ir import TpuDriver
+    from ..target import K8sValidationTarget
+
+    serve = frozenset(s for s in args.serve.split(",") if s)
+    driver = TpuDriver(device=args.device if args.device >= 0 else None)
+    client = Backend(driver).new_client([K8sValidationTarget()])
+    fail_closed = args.fail_closed
+    validation = ns_label = mutation = mutation_system = None
+    if "admit" in serve:
+        batcher = MicroBatcher(client,
+                               max_queue=args.admission_max_queue)
+        validation = ValidationHandler(
+            client, kube=None, batcher=batcher,
+            log_denies=args.log_denies, fail_closed=fail_closed,
+            default_timeout=args.admission_default_timeout,
+            decision_cache_size=args.admission_decision_cache)
+        ns_label = NamespaceLabelHandler(tuple(args.exempt_namespace))
+    if "mutate" in serve:
+        from ..mutation import MutationSystem
+
+        mutation_system = MutationSystem(
+            max_iterations=args.mutation_max_iterations)
+        mutation = MutationHandler(
+            mutation_system, kube=None,
+            fail_closed=(fail_closed if args.mutation_fail_closed
+                         == "unset"
+                         else args.mutation_fail_closed == "true"),
+            batch_max_wait=args.mutation_batch_max_wait,
+            max_queue=args.admission_max_queue,
+            default_timeout=args.admission_default_timeout)
+    sink = LibrarySink(client, mutation_system)
+    engine = BackplaneEngine(
+        args.socket, validation=validation, ns_label=ns_label,
+        mutation=mutation,
+        default_timeout=args.admission_default_timeout,
+        engine_id=args.engine_id,
+        library_sink=sink,
+        stats_source=metrics.engine_stats_snapshot)
+    # refuse admission until the supervisor's first full sync lands:
+    # the frontends' router fails those requests over to synced engines
+    engine.ready_check = lambda: sink.synced
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    engine.start()
+    # long-lived-server GC tuning, same rationale as the frontends
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    print("READY", flush=True)
+    stop.wait()
+    engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

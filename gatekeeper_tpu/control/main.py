@@ -156,7 +156,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Unix socket path for the frontend<->engine "
                         "backplane (default: a per-process path under "
                         "the system temp dir); only used with "
-                        "--admission-workers > 1")
+                        "--admission-workers > 1 or --admission-engines "
+                        "> 1 (engine k > 0 listens on <socket>.<k>)")
+    p.add_argument("--admission-engines", type=int, default=1,
+                   help="admission ENGINE processes, one per chip: this "
+                        "process stays engine 0; engines 1..N-1 are "
+                        "spawned children (gatekeeper_tpu.control."
+                        "engine), each pinning jax.devices()[k] and "
+                        "owning its own Client/MicroBatcher behind its "
+                        "own backplane socket. Frontends route reviews "
+                        "by least-load (request-hash fallback) across "
+                        "all engines and fail over mid-burst when one "
+                        "dies, so admission_rps scales with chips. "
+                        "Library mutations fan out to every engine "
+                        "(each bumps its own decision-cache generation); "
+                        "--admission-max-queue is divided across "
+                        "engines so the shed bound stays global. "
+                        "0 = one engine per visible device. Values > 1 "
+                        "imply the backplane even with "
+                        "--admission-workers 1")
     p.add_argument("--admission-decision-cache", type=int, default=4096,
                    help="entries in the generation-keyed admission "
                         "decision cache (identical retries and object "
@@ -372,6 +390,7 @@ class Runtime:
         # the engine
         self.backplane = None
         self.frontends = None
+        self.engines = None  # N-engine plane: supervisor of engines 1..N-1
         self.validation_handler = None
         self.mutation_handler = None
         if "webhook" in operations or "mutation-webhook" in operations:
@@ -425,9 +444,18 @@ class Runtime:
                     log.warning("cert bootstrap failed; serving plaintext",
                                 details=str(e))
             workers = getattr(args, "admission_workers", 1) or 1
-            if workers > 1:
+            engines = getattr(args, "admission_engines", 1)
+            if engines == 0:
+                # auto: one engine per visible chip
+                try:
+                    import jax
+                    engines = max(1, len(jax.devices()))
+                except Exception:
+                    engines = 1
+            if engines > 1 or workers > 1:
                 from .backplane import (
                     BackplaneEngine,
+                    EngineSupervisor,
                     FrontendSupervisor,
                     default_socket_path,
                 )
@@ -439,12 +467,88 @@ class Runtime:
                     serve += ["admit", "admitlabel"]
                 if mutation is not None:
                     serve += ["mutate"]
+                # N-engine plane: this process is engine 0; engines
+                # 1..N-1 are child processes, each pinned to its own
+                # chip with its own Client/MicroBatcher/socket. The
+                # queue bound is divided so it stays GLOBAL: N engines
+                # each bounding max_queue/N in-flight admissions.
+                if engines > 1:
+                    try:
+                        import jax
+                        n_dev = len(jax.devices())
+                    except Exception:
+                        n_dev = 0
+                    if n_dev and engines > n_dev:
+                        # device pinning wraps modulo the device count:
+                        # over-provisioned engines time-share chips,
+                        # which degrades instead of scales — say so
+                        log.warning(
+                            "--admission-engines exceeds visible "
+                            "devices; engines will time-share chips",
+                            details={"engines": engines,
+                                     "devices": n_dev})
+                    share = max(1, max_queue // engines) if max_queue \
+                        else 0
+                    for handler in (validation, mutation):
+                        if handler is not None:
+                            handler.batcher.max_queue = share
+                    metrics.set_engine_id("0")
+                    spawn_args = ["--serve", ",".join(serve),
+                                  "--admission-max-queue", str(share),
+                                  "--admission-default-timeout",
+                                  str(default_timeout),
+                                  "--admission-decision-cache",
+                                  str(getattr(args,
+                                              "admission_decision_cache",
+                                              4096)),
+                                  "--log-level",
+                                  getattr(args, "log_level", "INFO"),
+                                  "--trace-sample-rate",
+                                  str(getattr(args, "trace_sample_rate",
+                                              0.01)),
+                                  "--trace-slow-threshold",
+                                  str(getattr(args,
+                                              "trace_slow_threshold",
+                                              1.0))]
+                    if args.log_denies:
+                        spawn_args += ["--log-denies"]
+                    if fail_closed:
+                        spawn_args += ["--fail-closed"]
+                    if mut_fail_closed is not None:
+                        spawn_args += ["--mutation-fail-closed",
+                                       "true" if mut_fail_closed
+                                       else "false"]
+                    spawn_args += ["--mutation-max-iterations",
+                                   str(getattr(args,
+                                               "mutation_max_iterations",
+                                               10))]
+                    for ns in args.exempt_namespace:
+                        spawn_args += ["--exempt-namespace", ns]
+                    self.engines = EngineSupervisor(
+                        range(1, engines),
+                        socket_for=lambda k, s=sock: f"{s}.{k}",
+                        spawn_args=spawn_args,
+                        snapshot_provider=self._engine_sync_snapshot)
+                    # every library mutation the controllers (or tests)
+                    # apply through THIS client fans out to the engine
+                    # children; each child's Client bumps its own
+                    # generation when the op lands, keeping decision-
+                    # cache keys coherent per engine
+                    self.opa.on_change = \
+                        lambda op, obj: self.engines.replicate(op, obj)
+                    if self.mutation_system is not None:
+                        self.mutation_system.on_change = \
+                            lambda op, obj: self.engines.replicate(op,
+                                                                   obj)
                 self.backplane = BackplaneEngine(
                     sock, validation=validation, ns_label=ns_label,
-                    mutation=mutation, default_timeout=default_timeout)
+                    mutation=mutation, default_timeout=default_timeout,
+                    engine_id="0")
                 self.backplane.configured_workers = workers
                 self.frontends = FrontendSupervisor(
-                    workers, sock, port=args.port,
+                    workers,
+                    [sock] + [f"{sock}.{k}" for k in range(1, engines)],
+                    port=args.port,
                     certfile=certfile, keyfile=keyfile,
                     serve=tuple(serve), fail_closed=fail_closed,
                     mutation_fail_closed=mut_fail_closed,
@@ -572,6 +676,22 @@ class Runtime:
                         self.statestore, "rows",
                         driver.encoded_rows_restore, blob=True),
                     name="rows-restore", daemon=True).start()
+
+    # ------------------------------------------------- N-engine plane
+
+    def _engine_sync_snapshot(self) -> dict:
+        """The full-library sync op the EngineSupervisor sends a fresh
+        (or healed) engine child: templates/constraints, the synced
+        inventory tree, and mutator sources. The child replays it
+        through its own Client, so its decision-cache generation
+        reflects the library it actually evaluates."""
+        snap = {"library": self.opa.snapshot_library()}
+        driver = getattr(self.opa, "driver", None)
+        if hasattr(driver, "inventory_snapshot"):
+            snap["data"] = driver.inventory_snapshot()
+        if self.mutation_system is not None:
+            snap["mutators"] = self.mutation_system.sources()
+        return snap
 
     # ---------------------------------------------------- debug endpoints
 
@@ -721,6 +841,17 @@ class Runtime:
                                               self.backplane.alive)
                     self.health.add_readiness("admission-frontends",
                                               self.frontends.alive)
+                if self.engines is not None:
+                    # DELIBERATELY not all-engines-alive: one dead
+                    # engine child is a degraded-but-serving state —
+                    # frontends fail its requests over to the survivors
+                    # and the supervisor respawns it. Pulling the pod
+                    # from the Service for that would turn a partial
+                    # capacity dip into a full endpoint outage.
+                    # Readiness only requires the supervisor itself to
+                    # still be monitoring/respawning.
+                    self.health.add_readiness(
+                        "engine-supervisor", self.engines.monitoring)
                 # liveness watchdogs: a wedged micro-batch pipeline
                 # (dead flusher, hung evaluation with a growing queue)
                 # fails /healthz so k8s restarts the pod — the
@@ -774,8 +905,13 @@ class Runtime:
         if self.webhook:
             self.webhook.start()
         if self.backplane is not None:
-            # engine first: frontends connect eagerly on boot
+            # engines first: frontends connect eagerly on boot
             self.backplane.start()
+            if self.engines is not None:
+                self.engines.start()
+                metrics.report_admission_engines(
+                    1 + len(self.engines.engine_ids),
+                    1 + self.engines.alive_count())
             self.frontends.start()
             metrics.report_admission_workers(
                 self.backplane.configured_workers,
@@ -804,8 +940,10 @@ class Runtime:
         if self.backplane is not None:
             # frontends FIRST: each stops accepting and finishes its
             # in-flight HTTP requests (verdicts still flow over the
-            # backplane), THEN the engine drains the shared batcher
+            # backplane), THEN the engines drain their batchers
             self.frontends.stop()
+            if self.engines is not None:
+                self.engines.stop()
             self.backplane.stop()
         if self.audit:
             self.audit.stop()

@@ -118,6 +118,74 @@ class Registry:
             m.sums[key] += sum_
             m.counts[key] += count
 
+    # ----------------------------------------------------------- snapshot
+
+    def snapshot(self, names: tuple) -> dict:
+        """JSON-safe totals of the named metrics, for the N-engine
+        plane's stats relay: an engine child answers the primary's poll
+        with this, the primary diffs against the previous poll and
+        merges the deltas into ITS registry — so /metrics on the
+        primary aggregates shed counts, decisions, cache outcomes, and
+        per-engine stage histograms across every engine process."""
+        out = {}
+        with self._lock:
+            metrics = [self._metrics[n] for n in names
+                       if n in self._metrics]
+        for m in metrics:
+            with m.lock:
+                ent = {"kind": m.kind, "help": m.help,
+                       "labels": list(m.label_names)}
+                if m.kind in ("counter", "gauge"):
+                    ent["values"] = [[list(k), v]
+                                     for k, v in m.values.items()]
+                else:
+                    ent["buckets"] = list(m.buckets)
+                    ent["hist"] = [
+                        [list(k), list(m.bucket_counts[k]),
+                         m.sums[k], m.counts[k]]
+                        for k in m.bucket_counts]
+                out[m.name] = ent
+        return out
+
+    def merge_snapshot_delta(self, cur: dict, prev: dict) -> None:
+        """Merge (cur - prev) of a Registry.snapshot() into this
+        registry. A restarted engine's counters reset to zero — any
+        negative delta treats cur as the whole delta (counts since the
+        restart are new work, not a rewind)."""
+        prev = prev or {}
+        for name, ent in cur.items():
+            labels = tuple(ent.get("labels") or ())
+            pent = prev.get(name) or {}
+            if ent["kind"] in ("counter", "gauge"):
+                pvals = {tuple(k): v
+                         for k, v in (pent.get("values") or [])}
+                for k, v in ent.get("values") or []:
+                    kt = tuple(k)
+                    d = v - pvals.get(kt, 0)
+                    if d < 0:
+                        d = v
+                    if d:
+                        self.counter_add(name, ent.get("help", ""), d,
+                                         **dict(zip(labels, kt)))
+            else:
+                phist = {tuple(k): (c, s, n)
+                         for k, c, s, n in (pent.get("hist") or [])}
+                buckets = tuple(ent.get("buckets") or ())
+                for k, counts, sum_, n in ent.get("hist") or []:
+                    kt = tuple(k)
+                    pc, ps, pn = phist.get(kt, ([0] * len(counts),
+                                                0.0, 0))
+                    dn = n - pn
+                    if dn < 0:  # engine restarted
+                        dc, ds, dn = list(counts), sum_, n
+                    else:
+                        dc = [c - p for c, p in zip(counts, pc)]
+                        ds = sum_ - ps
+                    if dn:
+                        self.observe_bucketed(
+                            name, ent.get("help", ""), buckets, dc,
+                            ds, dn, **dict(zip(labels, kt)))
+
     # ------------------------------------------------------------- render
 
     def render(self) -> str:
@@ -348,12 +416,37 @@ def report_audit_last_run(ts: Optional[float] = None) -> None:
                        ts if ts is not None else time.time())
 
 
+# which engine process this registry lives in ("0" = the primary /
+# in-process engine; "1".. = spawned engine children; "" = a process
+# that serves no admission engine). Stamped into the per-engine stage
+# and request metrics so an N-engine plane decomposes per chip.
+_ENGINE_ID = ""
+
+
+def set_engine_id(engine_id: str) -> None:
+    global _ENGINE_ID
+    _ENGINE_ID = str(engine_id)
+
+
+def engine_id() -> str:
+    return _ENGINE_ID
+
+
 def report_request(admission_status: str, seconds: float) -> None:
     REGISTRY.counter_add("request_count", "Count of admission requests",
                          admission_status=admission_status)
     REGISTRY.observe("request_duration_seconds",
                      "Latency of admission requests", seconds,
                      admission_status=admission_status)
+    if _ENGINE_ID:
+        # per-engine decomposition of the same counter: the aggregate
+        # stays label-compatible with every dashboard built on it while
+        # the N-engine plane remains attributable per chip
+        REGISTRY.counter_add("gatekeeper_tpu_engine_requests_total",
+                             "Admission requests decided, by owning "
+                             "engine process",
+                             admission_status=admission_status,
+                             engine=_ENGINE_ID)
 
 
 def report_batch_timeout(n: int = 1) -> None:
@@ -395,6 +488,49 @@ def report_admission_workers(configured: int, connected: int) -> None:
     REGISTRY.gauge_set("gatekeeper_tpu_admission_workers",
                        "Admission frontend worker processes",
                        connected, state="connected")
+
+
+def report_admission_engines(configured: int, alive: int) -> None:
+    """N-engine plane topology gauge: --admission-engines as configured
+    and the number of engine processes currently alive (the in-process
+    engine counts; a crashed child dips this until its respawn)."""
+    REGISTRY.gauge_set("gatekeeper_tpu_admission_engines",
+                       "Admission engine processes (one per chip)",
+                       configured, state="configured")
+    REGISTRY.gauge_set("gatekeeper_tpu_admission_engines",
+                       "Admission engine processes (one per chip)",
+                       alive, state="alive")
+
+
+# counters/histograms an engine child relays to the primary over the
+# backplane M frame (all monotonic — the delta merge assumes it), so
+# shed accounting, decision counts, cache outcomes, and per-engine
+# stage histograms stay GLOBAL on the primary's /metrics across every
+# engine process
+ENGINE_RELAY_METRICS = (
+    "request_count",
+    "request_duration_seconds",
+    "admission_requests_shed_total",
+    "admission_batch_timeouts",
+    "gatekeeper_tpu_admission_decision_cache_total",
+    "gatekeeper_tpu_engine_requests_total",
+    "gatekeeper_tpu_stage_duration_seconds",
+    "gatekeeper_tpu_traces_total",
+    # frontends ship S-frame deltas to whichever engine answers; a
+    # child that received them relays the merged result up
+    "gatekeeper_tpu_backplane_forward_duration_seconds",
+    "gatekeeper_tpu_backplane_errors_total",
+)
+
+
+def engine_stats_snapshot() -> dict:
+    """What an engine child answers the primary's M-frame poll with."""
+    return REGISTRY.snapshot(ENGINE_RELAY_METRICS)
+
+
+def merge_engine_stats(cur: dict, prev: dict) -> None:
+    """Primary-side merge of one engine child's polled totals."""
+    REGISTRY.merge_snapshot_delta(cur, prev)
 
 
 # frontends bucket their forward latencies locally with these bounds and
@@ -625,17 +761,29 @@ _STAGE_HELP = ("Latency of one named pipeline stage of a SAMPLED "
                "plane), from the request-scoped trace layer")
 
 
-def report_stage(plane: str, stage: str, seconds: float) -> None:
+def _stage_engine(plane: str, engine) -> str:
+    """Admission-plane stages carry the recording engine process's id
+    (multi-engine planes decompose per chip); audit-plane phases are
+    plane-global (their per-shard twin is the audit_shard histogram)."""
+    if engine is not None:
+        return str(engine)
+    return _ENGINE_ID if plane == "admission" else ""
+
+
+def report_stage(plane: str, stage: str, seconds: float,
+                 engine: Optional[str] = None) -> None:
     """One span of a sampled trace: the per-stage latency histogram
     that decomposes an admission p99 (or an audit sweep duration) into
     its pipeline stages."""
     REGISTRY.observe("gatekeeper_tpu_stage_duration_seconds",
                      _STAGE_HELP, seconds, buckets=STAGE_BUCKETS,
-                     plane=plane, stage=stage)
+                     plane=plane, stage=stage,
+                     engine=_stage_engine(plane, engine))
 
 
 def report_stage_bucketed(plane: str, stage: str, bucket_counts: list,
-                          sum_: float, count: int) -> None:
+                          sum_: float, count: int,
+                          engine: Optional[str] = None) -> None:
     """Merge a frontend's pre-aggregated stage-histogram delta (the
     frontends time their own stages — frontend_parse, the backplane
     forward — and ship them over the S frame like the forward-latency
@@ -643,7 +791,20 @@ def report_stage_bucketed(plane: str, stage: str, bucket_counts: list,
     more than the stages being measured)."""
     REGISTRY.observe_bucketed("gatekeeper_tpu_stage_duration_seconds",
                               _STAGE_HELP, STAGE_BUCKETS, bucket_counts,
-                              sum_, count, plane=plane, stage=stage)
+                              sum_, count, plane=plane, stage=stage,
+                              engine=_stage_engine(plane, engine))
+
+
+def report_audit_shard(stage: str, shard: int, seconds: float) -> None:
+    """Per-SHARD audit stage timing from the mesh slab loop: how one
+    data shard's violation materialization (or its share of the sweep)
+    costs, so a skewed shard — one device's slab carrying all the
+    violating rows — is visible instead of averaged away."""
+    REGISTRY.observe("gatekeeper_tpu_audit_shard_duration_seconds",
+                     "Per-data-shard latency of one audit pipeline "
+                     "stage in the mesh slab loop",
+                     seconds, buckets=STAGE_BUCKETS,
+                     stage=stage, shard=str(shard))
 
 
 def report_trace(plane: str) -> None:

@@ -188,9 +188,21 @@ def enable_compile_cache() -> None:
 
 
 class TpuDriver(RegoDriver):
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, device=None):
         super().__init__()
         enable_compile_cache()
+        # per-engine device pinning (the N-engine admission plane: one
+        # engine process per chip): evaluation and device_put target
+        # THIS device, and the audit mesh is disabled — a pinned engine
+        # owns exactly one chip. `device` is a jax.Device or an int
+        # index into jax.devices().
+        self._device = None
+        if device is not None:
+            import jax as _jax
+
+            devs = _jax.devices()
+            self._device = (devs[int(device) % len(devs)]
+                            if isinstance(device, int) else device)
         self.strtab = StringTable()
         self.match_tables = MatchTables(self.strtab)
         self.derived_tables = DerivedTables(self.strtab)
@@ -259,6 +271,17 @@ class TpuDriver(RegoDriver):
 
         self.async_warm = _os.environ.get(
             "GATEKEEPER_TPU_ASYNC_COMPILE", "1") != "0"
+        # mesh path tuning: the review-count floor below which a sweep
+        # stays single-device, and an optional LOCAL slab-size override
+        # for the double-buffered mesh slab loop (None = auto-sized in
+        # fires_pairs_mesh_dispatch)
+        self.MESH_MIN_REVIEWS = int(_os.environ.get(
+            "GATEKEEPER_TPU_MESH_MIN_REVIEWS", self.MESH_MIN_REVIEWS))
+        slab_env = _os.environ.get("GATEKEEPER_TPU_MESH_SLAB", "")
+        self.mesh_slab_local: Optional[int] = \
+            int(slab_env) if slab_env else None
+        self.sweep_chunk = int(_os.environ.get(
+            "GATEKEEPER_TPU_SWEEP_CHUNK", "8192"))
         self._warm_done: set = set()
         self._warm_inflight: dict = {}           # sig -> done Event
         self._warm_fail: dict = {}               # sig -> failure count
@@ -300,6 +323,8 @@ class TpuDriver(RegoDriver):
     def _build_mesh(self, mesh):
         import os
 
+        if self._device is not None:
+            return None  # a pinned engine owns exactly one chip
         if mesh is not None:
             return mesh
         cfg = os.environ.get("GATEKEEPER_TPU_MESH", "auto").lower()
@@ -795,12 +820,14 @@ class TpuDriver(RegoDriver):
 
         cache = self._dev_cache
 
+        device = self._device
+
         def put(arr):
             key = id(arr)
             hit = cache.get(key)
             if hit is not None and hit[0]() is arr:
                 return hit[1]
-            d = jax.device_put(arr)
+            d = jax.device_put(arr, device)
             try:
                 ref = weakref.ref(arr, lambda _r, k=key: cache.pop(k, None))
             except TypeError:
@@ -810,13 +837,21 @@ class TpuDriver(RegoDriver):
 
         return jax.tree_util.tree_map(put, tree)
 
+    # mesh placement cache bound: entries weak-evict with their host
+    # arrays, but a churn-heavy long-lived audit can cycle through many
+    # LIVE host arrays (per-kind feature trees, padded vocab copies),
+    # growing device-placement entries without bound — LRU-evict past
+    # this many leaves (each eviction only drops a resident sharded
+    # buffer; the next sweep re-distributes that leaf)
+    DEV_MESH_CACHE_MAX = 512
+
     def _dev_mesh(self, tree, data_leading: bool):
         """Mesh placement twin of _dev: leaves are device_put with a
         NamedSharding — leading axis split over "data" for feature
         tensors, fully replicated for params/tables — and cached weakly
-        by host-array identity, so steady-state mesh audits re-dispatch
-        over resident sharded buffers instead of re-distributing every
-        sweep."""
+        by host-array identity (LRU-bounded by DEV_MESH_CACHE_MAX), so
+        steady-state mesh audits re-dispatch over resident sharded
+        buffers instead of re-distributing every sweep."""
         import weakref
 
         import jax
@@ -829,6 +864,10 @@ class TpuDriver(RegoDriver):
             key = (id(arr), data_leading)
             hit = cache.get(key)
             if hit is not None and hit[0]() is arr:
+                # LRU: refresh recency (dicts keep insertion order only,
+                # so a hit must re-insert to move to the back)
+                del cache[key]
+                cache[key] = hit
                 return hit[1]
             if data_leading and getattr(arr, "ndim", 0) >= 1:
                 spec = P("data", *([None] * (arr.ndim - 1)))
@@ -840,6 +879,8 @@ class TpuDriver(RegoDriver):
             except TypeError:
                 return d
             cache[key] = (ref, d)
+            while len(cache) > self.DEV_MESH_CACHE_MAX:
+                cache.pop(next(iter(cache)), None)
             return d
 
         return jax.tree_util.tree_map(put, tree)
@@ -993,11 +1034,12 @@ class TpuDriver(RegoDriver):
                 tuple(getattr(table, "shape", ())), shapes(derived))
 
     def _dispatch_handle(self, ct, feats, enc, table, derived, n_true,
-                         use_mesh, chunk=8192):
+                         use_mesh, chunk=None):
+        chunk = chunk or self.sweep_chunk
         if use_mesh:
             return ct.fires_pairs_mesh_dispatch(
                 feats, enc, table, self._mesh, derived, chunk=chunk,
-                n_true=n_true)
+                n_true=n_true, slab=self.mesh_slab_local)
         return ct.fires_pairs_dispatch(feats, enc, table, derived,
                                        chunk=chunk,
                                        slab=self._sweep_slab(n_true, chunk),
@@ -1138,16 +1180,29 @@ class TpuDriver(RegoDriver):
         # trace's device_sweep / materialize phases (a context manager
         # per slab would mis-nest across the interleaving)
         t_dev = t_mat = 0.0
+        # mesh handles label blocks with their data-shard index: the
+        # per-shard materialize histograms ride that, and — since the
+        # SLAB loop's blocks are not globally row-major — results are
+        # reassembled by each block's first global row (disjoint
+        # contiguous ranges per block, sorted within)
+        labeled = getattr(handle, "pairs_labeled", None)
+        blocks: list = []
         try:
-            it = iter(handle.pairs())
+            it = iter(labeled()) if labeled is not None \
+                else iter(handle.pairs())
             while True:
                 t0 = _time.time()
                 try:
-                    rows, cols = next(it)
+                    item = next(it)
                 except StopIteration:
                     t_dev += _time.time() - t0
                     break
                 t_dev += _time.time() - t0
+                shard = None
+                if labeled is not None:
+                    shard, rows, cols = item
+                else:
+                    rows, cols = item
                 if first_sync:
                     # DISPATCH->first-result latency, sampled only for
                     # the audit's first consumed kind (later kinds'
@@ -1164,10 +1219,23 @@ class TpuDriver(RegoDriver):
                 rows, cols = _expand_parameterless(rows, cols, c_dev,
                                                    len(cons))
                 keep = mask[cand[rows], cols]
-                out.extend(self.materialize_pairs(
+                res = self.materialize_pairs(
                     target, cons, cand_reviews, rows[keep], cols[keep],
-                    inventory))
-                t_mat += _time.time() - t0
+                    inventory)
+                dt = _time.time() - t0
+                t_mat += dt
+                if shard is None:
+                    out.extend(res)
+                else:
+                    blocks.append((int(rows[0]) if len(rows) else -1,
+                                   res))
+                    if res or dt > 0.001:
+                        from ..control.metrics import report_audit_shard
+                        report_audit_shard("materialize", shard, dt)
+            if blocks:
+                blocks.sort(key=lambda b: b[0])
+                for _r0, res in blocks:
+                    out.extend(res)
         except DriverError:
             raise
         except Exception as e:

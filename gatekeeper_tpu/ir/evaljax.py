@@ -675,6 +675,12 @@ class _MeshPairs:
         self._args = args  # (feats, params, table, derived, n_valid, c)
 
     def pairs(self):
+        for _shard, rows, cols in self.pairs_labeled():
+            yield rows, cols
+
+    def pairs_labeled(self):
+        """(shard, rows, cols) per data shard — the shard index feeds
+        the per-shard audit stage histograms."""
         ct = self._ct
         feats, params, table, derived, n_valid, c = self._args
         rcap = self._rcap
@@ -696,7 +702,89 @@ class _MeshPairs:
                                 if counts.max(initial=0) > 1 else 256)
         for k in range(n_shards):
             block = arr[k * (rcap + 1): (k + 1) * (rcap + 1)]
-            yield _decode_row_blocks(block, int(block[0, 0]), c)
+            rows, cols = _decode_row_blocks(block, int(block[0, 0]), c)
+            yield k, rows, cols
+
+
+class _MeshSlabPairs:
+    """Pending DOUBLE-BUFFERED mesh slab sweeps.
+
+    The monolithic mesh dispatch (_MeshPairs) syncs the whole sweep in
+    one fetch, so at 1M+ objects the host sits idle through the entire
+    device pass and the mesh sits idle through the entire
+    materialization tail. Slabbing the LOCAL row axis fixes both: each
+    slab is one SPMD dispatch covering every shard's next `lslab` local
+    rows, at most WINDOW slabs are in flight, and the only
+    jax.block_until_ready sits at the slab boundary — while the host
+    materializes slab k's firing pairs, the mesh is already sweeping
+    slab k+1. Yield order is (slab, shard): blocks are NOT globally
+    row-major (shard d's slab-s rows are d*n_loc + [s*lslab, ...)), so
+    order-sensitive consumers reassemble by each block's first global
+    row (the driver's audit consume does)."""
+
+    WINDOW = 2  # double-buffered: one slab syncing, one in flight
+
+    def __init__(self, ct, mesh, chunk, lslab, n_slabs, rcap, args):
+        self._ct = ct
+        self._mesh = mesh
+        self._chunk = chunk
+        self._lslab = lslab
+        self._n_slabs = n_slabs
+        # (feats, params, table, derived, n_valid, c)
+        self._args = args
+        fn = ct._mesh_slab_pairs_jit(mesh, chunk, lslab, rcap)
+        # prime the pipeline NOW (dispatch is async): the audit's
+        # cross-kind window consumes handles long after construction
+        self._pend = [
+            (s, rcap, fn(args[0], args[1], args[2], args[3],
+                         np.int32(s * lslab), args[4]))
+            for s in range(min(self.WINDOW, n_slabs))]
+        self._next = len(self._pend)
+
+    def pairs(self):
+        for _shard, rows, cols in self.pairs_labeled():
+            yield rows, cols
+
+    def pairs_labeled(self):
+        ct = self._ct
+        feats, params, table, derived, n_valid, c = self._args
+        lslab = self._lslab
+        while self._pend:
+            s, rcap, dev = self._pend.pop(0)
+            if self._next < self._n_slabs:
+                # keep the window full BEFORE blocking: the refill slab
+                # overlaps this slab's fetch + materialization
+                fn = ct._mesh_slab_pairs_jit(self._mesh, self._chunk,
+                                             lslab, ct._rows_cap_mesh)
+                self._pend.append(
+                    (self._next, ct._rows_cap_mesh,
+                     fn(feats, params, table, derived,
+                        np.int32(self._next * lslab), n_valid)))
+                self._next += 1
+            jax.block_until_ready(dev)  # the slab boundary: the ONLY
+            # sync point in the loop
+            arr = np.asarray(dev)
+            n_shards = arr.shape[0] // (rcap + 1)
+            counts = arr[:: rcap + 1, 0].astype(np.int64)
+            while counts.max(initial=0) > rcap:
+                # a shard overflowed its gather capacity: re-run THIS
+                # slab at the next power of two (rare; ratcheted below)
+                rcap = max(rcap,
+                           1 << (int(counts.max()) - 1).bit_length())
+                fn = ct._mesh_slab_pairs_jit(self._mesh, self._chunk,
+                                             lslab, rcap)
+                arr = np.asarray(fn(feats, params, table, derived,
+                                    np.int32(s * lslab), n_valid))
+                counts = arr[:: rcap + 1, 0].astype(np.int64)
+            ct._rows_cap_mesh = max(
+                ct._rows_cap_mesh, 256,
+                (1 << (int(counts.max()) - 1).bit_length())
+                if counts.max(initial=0) > 1 else 256)
+            for k in range(n_shards):
+                block = arr[k * (rcap + 1): (k + 1) * (rcap + 1)]
+                rows, cols = _decode_row_blocks(block, int(block[0, 0]),
+                                                c)
+                yield k, rows, cols
 
 
 class CompiledTemplate:
@@ -1030,17 +1118,116 @@ class CompiledTemplate:
         self._pairs_cache[key] = fn
         return fn
 
+    def _mesh_slab_pairs_jit(self, mesh, chunk: int, lslab: int,
+                             rcap: int):
+        """One fused SPMD program per (mesh, chunk, lslab, rcap): the
+        slab twin of _mesh_pairs_jit — each device dynamic-slices its
+        next `lslab` LOCAL rows at a traced `start` (so every slab of
+        a sweep reuses ONE compiled program), sweeps/bit-packs them,
+        and gathers its local firing rows at capacity rcap, with
+        global row indices stamped from axis_index. Out spec P("data")
+        concatenates per-shard [rcap+1, W+1] blocks: one dispatch +
+        one fetch per slab for the whole mesh."""
+        key = ("mesh-slab", id(mesh), chunk, lslab, rcap)
+        fn = self._pairs_cache.get(key)
+        if fn is not None:
+            return fn
+        from jax.sharding import PartitionSpec as P
+
+        def local(feats_l, params, table, derived, start, n_valid):
+            leaf = next(iter(next(iter(feats_l.values())).values()))
+            n_loc = leaf.shape[0]  # static: N // data axis size
+            cs = jnp.minimum(start, n_loc - lslab)
+            sl = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, cs, lslab,
+                                                       axis=0),
+                feats_l)
+            chunked = jax.tree_util.tree_map(
+                lambda a: a.reshape((-1, chunk) + a.shape[1:]), sl)
+
+            def body(ch):
+                fires = self._eval(ch, params, table, derived)
+                c = fires.shape[-1]
+                w = (c + 31) // 32
+                pad = w * 32 - c
+                if pad:
+                    fires = jnp.pad(fires, ((0, 0), (0, pad)))
+                bits = fires.reshape(fires.shape[0], w, 32)
+                weights = (jnp.uint32(1) << jnp.arange(32,
+                                                       dtype=jnp.uint32))
+                return jnp.sum(jnp.where(bits, weights, jnp.uint32(0)),
+                               axis=-1, dtype=jnp.uint32)
+
+            packed = jax.lax.map(body, chunked)
+            packed = packed.reshape((lslab,) + packed.shape[2:])
+            w = packed.shape[1]
+            idx = jax.lax.axis_index("data")
+            row0 = idx * n_loc
+            loc_rows = cs + jnp.arange(lslab, dtype=jnp.int32)
+            rows_global = row0 + loc_rows
+            # mask extraction padding (>= n_valid) AND the clamp
+            # overlap (< start): overlap rows were already emitted by
+            # the previous slab
+            valid = (rows_global < n_valid) & (loc_rows >= start)
+            packed = jnp.where(valid[:, None], packed, jnp.uint32(0))
+            per_row = jnp.sum(jax.lax.population_count(packed), axis=1,
+                              dtype=jnp.int32)
+            row_any = per_row > 0
+            rcount = jnp.sum(row_any, dtype=jnp.int32)
+            rows_idx = jnp.nonzero(row_any, size=rcap,
+                                   fill_value=lslab)[0]
+            sel = jnp.where(rows_idx < lslab, rows_idx, 0)
+            sub = packed[sel]
+            sub = jnp.where((rows_idx < lslab)[:, None], sub,
+                            jnp.uint32(0))
+            gr = jnp.where(rows_idx < lslab, row0 + cs + rows_idx,
+                           jnp.int32(0)).astype(jnp.uint32)
+            body2 = jnp.concatenate([gr[:, None], sub], axis=1)
+            header = jnp.zeros((1, w + 1), jnp.uint32)
+            header = header.at[0, 0].set(rcount.astype(jnp.uint32))
+            return jnp.concatenate([header, body2], axis=0)
+
+        def run(feats, params, table, derived, start, n_valid):
+            fspec = jax.tree_util.tree_map(
+                lambda a: P("data", *([None] * (a.ndim - 1))), feats)
+            rep = lambda tree: jax.tree_util.tree_map(
+                lambda a: P(*([None] * a.ndim)), tree)
+            return _shard_map_wrap(
+                local, mesh=mesh,
+                in_specs=(fspec, rep(params), rep(table), rep(derived),
+                          P(), P()),
+                out_specs=P("data", None),
+            )(feats, params, table, derived, start, n_valid)
+
+        fn = jax.jit(run)
+        self._pairs_cache[key] = fn
+        return fn
+
+    # the mesh slab loop engages once each shard holds at least this
+    # many multiples of the chunk (below it, one dispatch is cheaper
+    # than the per-slab fetch round-trips); slabs aim for ~MESH_SLABS
+    # per sweep
+    MESH_SLAB_MIN_CHUNKS = 8
+    MESH_SLABS = 8
+
     def fires_pairs_mesh_dispatch(self, feats: dict, params: dict,
                                   match_table: np.ndarray, mesh,
                                   derived: Optional[dict] = None,
                                   chunk: int = 8192,
-                                  n_true: Optional[int] = None):
+                                  n_true: Optional[int] = None,
+                                  slab: Optional[int] = None):
         """Mesh-sharded form of fires_pairs_dispatch: dispatch the SPMD
-        sweep NOW (async), return a handle whose .pairs() syncs one
-        fetch and yields per-shard (rows, cols) in global row-major
-        order. Requires the feature N axis divisible by the mesh's
-        "data" axis size (callers pad to a power-of-two bucket and gate
-        on divisibility)."""
+        sweep NOW (async), return a handle whose .pairs() syncs and
+        yields per-shard (rows, cols). Requires the feature N axis
+        divisible by the mesh's "data" axis size (callers pad to a
+        power-of-two bucket and gate on divisibility).
+
+        Large sweeps take the double-buffered SLAB loop (_MeshSlabPairs:
+        per-shard materialization overlaps the device sweep of the next
+        slab, jax.block_until_ready only at slab boundaries); small
+        sweeps keep the single monolithic dispatch. `slab` overrides the
+        LOCAL (per-shard) slab size — must divide the per-shard row
+        count and be a multiple of the chunk."""
         derived = derived or {}
         n_feat = (next(iter(next(iter(feats.values())).values())).shape[0]
                   if feats else 0)
@@ -1054,6 +1241,21 @@ class CompiledTemplate:
             raise ValueError(f"n_loc={n_loc} not divisible by "
                              f"chunk={chunk_eff}")
         c = _param_c(params)
+        lslab = slab
+        if lslab is None and \
+                n_loc >= self.MESH_SLAB_MIN_CHUNKS * chunk_eff:
+            # power-of-two extraction buckets make this exact: aim for
+            # MESH_SLABS slabs, never below one chunk each
+            lslab = max(chunk_eff, n_loc // self.MESH_SLABS)
+        if lslab is not None and lslab < n_loc:
+            if n_loc % lslab or lslab % chunk_eff:
+                raise ValueError(
+                    f"slab={lslab} must divide n_loc={n_loc} and be a "
+                    f"multiple of chunk={chunk_eff}")
+            return _MeshSlabPairs(
+                self, mesh, chunk_eff, lslab, n_loc // lslab,
+                self._rows_cap_mesh,
+                (feats, params, match_table, derived, np.int32(n), c))
         rcap = self._rows_cap_mesh
         fn = self._mesh_pairs_jit(mesh, chunk_eff, rcap)
         dev = fn(feats, params, match_table, derived, np.int32(n))

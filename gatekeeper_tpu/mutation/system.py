@@ -100,6 +100,22 @@ class MutationSystem:
         # path and must not re-sort the library each call. Treated as
         # immutable by readers.
         self._active_list: list[Mutator] = []
+        # mutator-change observer (N-engine replication hook): called
+        # after an EFFECTIVE upsert/remove with (op, plain object) —
+        # semantic-equal dedupes do not notify
+        self.on_change = None
+
+    def _notify(self, op: str, obj) -> None:
+        cb = self.on_change
+        if cb is None or obj is None:
+            return
+        try:
+            cb(op, obj)
+        except Exception:
+            import logging
+
+            logging.getLogger("gatekeeper_tpu.mutation").warning(
+                "mutator change notification failed", exc_info=True)
 
     # ------------------------------------------------------------ cache
 
@@ -113,7 +129,9 @@ class MutationSystem:
             if prev is not None and semantic_equal(prev.obj, mutator.obj):
                 return prev, set()
             self._mutators[mutator.id] = mutator
-            return mutator, self._recompute_conflicts()
+            changed = self._recompute_conflicts()
+        self._notify("upsert_mutator", obj)
+        return mutator, changed
 
     def remove(self, mid: tuple) -> set:
         """Drop a mutator by (kind, name); returns changed-quarantine
@@ -121,7 +139,10 @@ class MutationSystem:
         with self._lock:
             if self._mutators.pop(tuple(mid), None) is None:
                 return set()
-            return self._recompute_conflicts()
+            changed = self._recompute_conflicts()
+        self._notify("remove_mutator", {"kind": mid[0],
+                                        "metadata": {"name": mid[1]}})
+        return changed
 
     def get(self, mid: tuple) -> Optional[Mutator]:
         with self._lock:

@@ -493,7 +493,7 @@ def test_audit_sweep_trace_phases_and_histograms():
     assert {"full_resync", "incremental"} <= statuses
     text = gm.REGISTRY.render()
     assert 'gatekeeper_tpu_stage_duration_seconds_count' \
-        '{plane="audit",stage="evaluate"}' in text
+        '{engine="",plane="audit",stage="evaluate"}' in text
 
 
 def test_failed_sweep_still_records_error_trace():

@@ -395,6 +395,75 @@ def test_sharded_inventory_join_membership():
     assert want.any() and not want.all(), "non-vacuous membership split"
 
 
+def test_driver_mesh_slab_loop_equals_mono():
+    """The double-buffered mesh SLAB loop (per-shard materialization
+    overlapping the next slab's device sweep) must produce exactly the
+    monolithic mesh dispatch's results, in the same global row-major
+    order, across multiple slabs per shard — including a gather
+    capacity overflow inside one slab."""
+    from gatekeeper_tpu import policies
+    from gatekeeper_tpu.ir.evaljax import _MeshSlabPairs
+
+    N = 16384
+    dm = _mesh_driver()
+    dm.sweep_chunk = 256
+    dm.mesh_slab_local = 512  # n_loc = 2048 -> 4 slabs per shard
+    cm = Backend(dm).new_client([K8sValidationTarget()])
+    _labels_workload(cm, N)
+    handles = []
+    orig = dm._dispatch_handle
+
+    def spy(*a, **k):
+        h = orig(*a, **k)
+        handles.append(h)
+        return h
+
+    dm._dispatch_handle = spy
+    got = cm.audit().results()
+    dm._dispatch_handle = orig
+    assert dm.last_audit_path == "mesh(data=8)", dm.last_audit_path
+    assert any(isinstance(h, _MeshSlabPairs) for h in handles), \
+        "audit did not take the slab loop"
+
+    ds = TpuDriver()
+    ds._mesh = None
+    ds._dev_batch_lat_s = 1e-4
+    cs = Backend(ds).new_client([K8sValidationTarget()])
+    _labels_workload(cs, N)
+    want = cs.audit().results()
+    # exact order parity, not just set equality: the slab loop's blocks
+    # interleave shards, and the consume loop must reassemble global
+    # row-major order
+    assert [(r.msg, (r.resource or {}).get("metadata", {}).get("name"))
+            for r in got] == \
+        [(r.msg, (r.resource or {}).get("metadata", {}).get("name"))
+         for r in want]
+    assert len(got) == N - (N + 2) // 3, "non-vacuous"
+
+
+def test_mesh_slab_dispatch_direct_overflow_and_order():
+    """fires_pairs_mesh_dispatch with a forced small slab: every object
+    firing overflows the initial 256-per-shard gather capacity inside
+    each slab; the retry must lose no rows and the capacity must
+    ratchet."""
+    driver, ct, feats, params, table, derived, reviews, cons = \
+        build_eval_setup(n_objects=4096, n_constraints=1,
+                         violate_frac=1.0)
+    mesh = make_mesh(devices=jax.devices()[:8], data=8, model=1)
+    n_feat = next(iter(next(iter(feats.values())).values())).shape[0]
+    assert n_feat % 8 == 0
+    ct._rows_cap_mesh = 8  # force the per-slab overflow retry
+    handle = ct.fires_pairs_mesh_dispatch(
+        feats, params, table, mesh, derived, chunk=128,
+        n_true=len(reviews), slab=128)  # n_loc=512 -> 4 slabs
+    rows = np.concatenate([r for r, _c in handle.pairs()])
+    expected = ct.fires(feats, params, table, derived)[: len(reviews)]
+    want_rows = np.flatnonzero(expected.any(axis=1))
+    assert sorted(rows.tolist()) == want_rows.tolist()
+    assert len(want_rows) > 64, "non-vacuous overflow workload"
+    assert ct._rows_cap_mesh > 8, "gather capacity did not ratchet"
+
+
 def test_review_batch_sparse_mesh_equals_interpreter():
     """Discovery-mode audits stage the whole cluster through
     review_batch: at audit scale it must route through the sparse
@@ -432,3 +501,99 @@ def test_review_batch_sparse_mesh_equals_interpreter():
         [sorted(r.msg for r in per) for per in want]
     n_fired = sum(1 for per in got if per)
     assert n_fired == N - (N + 2) // 3, "non-vacuous"
+
+
+# ------------------------------------------------- mesh edge conditions
+
+
+def test_pad_batch_non_divisible_counts():
+    """pad_batch must zero-pad every [N, ...] leaf up to the next
+    multiple of the data axis and report the TRUE row count."""
+    feats = {"slot": {"a": np.arange(10, dtype=np.int32),
+                      "b": np.ones((10, 3), dtype=np.float32)}}
+    out, n_true = pad_batch(feats, 8)
+    assert n_true == 10
+    assert out["slot"]["a"].shape == (16,)
+    assert out["slot"]["b"].shape == (16, 3)
+    assert (out["slot"]["a"][:10] == np.arange(10)).all()
+    assert (out["slot"]["a"][10:] == 0).all()
+    assert (out["slot"]["b"][10:] == 0).all()
+    # already divisible: returned arrays are unpadded
+    out2, n2 = pad_batch(feats, 5)
+    assert n2 == 10 and out2["slot"]["a"].shape == (10,)
+
+
+def test_build_mesh_rounds_down_to_power_of_two(monkeypatch, caplog):
+    """6 visible devices must shard over 4 (with a warning), not
+    silently never take the mesh path — the divisibility gate checks
+    power-of-two extraction buckets against the data axis."""
+    monkeypatch.setenv("GATEKEEPER_TPU_MESH", "6")
+    import logging
+
+    with caplog.at_level(logging.WARNING,
+                         logger="gatekeeper_tpu.ir.driver"):
+        drv = TpuDriver()
+    assert drv._mesh is not None
+    assert dict(drv._mesh.shape) == {"data": 4, "model": 1}
+    assert any("rounded down" in r.message for r in caplog.records)
+
+
+def test_build_mesh_off_and_capped(monkeypatch):
+    monkeypatch.setenv("GATEKEEPER_TPU_MESH", "off")
+    assert TpuDriver()._mesh is None
+    monkeypatch.setenv("GATEKEEPER_TPU_MESH", "2")
+    assert dict(TpuDriver()._mesh.shape) == {"data": 2, "model": 1}
+    monkeypatch.setenv("GATEKEEPER_TPU_MESH", "1")
+    assert TpuDriver()._mesh is None  # one device is not a mesh
+
+
+def test_shard_and_replicate_specs_on_host_mesh():
+    """Placement spec correctness on the 8-device host-platform mesh:
+    features split on "data" along the leading axis, params replicated
+    by default (sharded over "model" when asked), scalars replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(devices=jax.devices()[:8], data=4, model=2)
+    feats = {"s": {"a": np.zeros((16, 5), np.int32),
+                   "v": np.zeros(16, np.int32)}}
+    params = {"s": {"p": np.zeros((8, 3), np.int32)}}
+    sf = shard_features(feats, mesh)
+    assert sf["s"]["a"].sharding.spec == P("data", None)
+    assert sf["s"]["v"].sharding.spec == P("data")
+    sp = shard_params(params, mesh)
+    assert sp["s"]["p"].sharding.spec == P(None, None)
+    sp_c = shard_params(params, mesh, shard_c=True)
+    assert sp_c["s"]["p"].sharding.spec == P("model", None)
+    from gatekeeper_tpu.parallel.mesh import replicate
+
+    r = replicate(np.float32(3.0), mesh)
+    assert r.sharding.spec == P()
+    # the placements actually address every device in the mesh
+    assert len(sf["s"]["a"].sharding.device_set) == 8
+
+
+def test_dev_mesh_cache_lru_bounded():
+    """TpuDriver._dev_mesh_cache must not grow without bound on a
+    churn-heavy audit: live host arrays past DEV_MESH_CACHE_MAX are
+    LRU-evicted, and a hit refreshes recency."""
+    drv = _mesh_driver()
+    drv.DEV_MESH_CACHE_MAX = 8
+    keep = [np.full((16,), i, np.int32) for i in range(12)]  # pin alive
+    first = keep[0]
+    drv._dev_mesh({"s": {"a": first}}, data_leading=True)
+    assert (id(first), True) in drv._dev_mesh_cache
+    for a in keep[1:8]:
+        drv._dev_mesh({"s": {"a": a}}, data_leading=True)
+        # touch the first entry so it stays most-recent
+        drv._dev_mesh({"s": {"a": first}}, data_leading=True)
+    assert len(drv._dev_mesh_cache) == 8
+    for a in keep[8:]:
+        drv._dev_mesh({"s": {"a": a}}, data_leading=True)
+    assert len(drv._dev_mesh_cache) == drv.DEV_MESH_CACHE_MAX
+    # the repeatedly-touched entry survived; the single-use early ones
+    # were evicted oldest-first
+    assert (id(first), True) in drv._dev_mesh_cache
+    assert (id(keep[1]), True) not in drv._dev_mesh_cache
+    # a hit on a surviving entry still returns the resident buffer
+    again = drv._dev_mesh({"s": {"a": first}}, data_leading=True)
+    assert np.asarray(again["s"]["a"]).tolist() == first.tolist()
