@@ -192,6 +192,17 @@ def main() -> None:
     base_evals_per_sec = (len(sample_reviews) * len(sample_cons)) / base_s
     base_full_audit_s = evals / base_evals_per_sec
 
+    # ---- cold vs warm restart: the tentpole's tracked number ----------
+    # fresh subprocesses against one initially-empty compile-cache/AOT
+    # dir pair: run 1 pays every XLA compile, run 2 boots like a
+    # restarted pod with the populated cache volume (deserialize-and-go)
+    import bench_configs
+
+    try:
+        coldwarm = bench_configs.coldwarm_probe("4")
+    except Exception as e:  # never lose the headline to the probe
+        coldwarm = {"error": str(e)[:200]}
+
     # ---- configs #1/#2/#3/#5/#6, driver-captured ----------------------
     import subprocess
 
@@ -245,6 +256,17 @@ def main() -> None:
         "materialize_s": round(mat_s, 3),
         "evals_per_sec_per_chip": round(evals_per_sec),
         "first_audit_s": round(first_audit_s, 2),
+        # cold restart (no cache volume) vs warm restart (populated XLA
+        # cache + AOT serialized-program store) first audit, plus where
+        # each run's device programs came from (aot/cache/fresh)
+        "cold_first_audit_s": coldwarm.get("cold_first_audit_s"),
+        "warm_first_audit_s": coldwarm.get("warm_first_audit_s"),
+        "cold_compile_sources": coldwarm.get("cold_compile_sources"),
+        "warm_compile_sources": coldwarm.get("warm_compile_sources"),
+        # a failed probe must be distinguishable from a missing number:
+        # carry the captured reason instead of four silent nulls
+        "coldwarm_error": coldwarm.get("error")
+        or coldwarm.get("cold_error") or coldwarm.get("warm_error"),
         "delta_audit_s": round(delta_audit_s, 4),
         "audit_path": audit_path,
         "device_programs": driver.warm_status(),

@@ -324,6 +324,10 @@ def config3():
     audit_s, first, nres = steady_audit(client)
     compiled = drv.compiled_kinds() if hasattr(drv, "compiled_kinds") else []
     device = [k for k in compiled if drv.compiled_for(k) is not None]
+    # the tentpole's tracked number: cold restart (no cache volume) vs
+    # warm restart (populated XLA cache + AOT program store) first
+    # audit, each in a fresh subprocess
+    coldwarm = coldwarm_probe("3")
     print(json.dumps({
         "config": 3, "metric": "audit_wall_clock_s",
         "value": round(audit_s, 3),
@@ -332,7 +336,111 @@ def config3():
                 f"steady state)",
         "first_audit_s": round(first, 2), "violations": nres,
         "device_compiled_kinds": len(device),
+        **coldwarm,
     }))
+
+
+# ------------------------------------------------ cold vs warm first audit
+
+
+def _coldwarm_child(workload: str) -> None:
+    """Child process for the cold-vs-warm first-audit probe: build the
+    named workload, run ONE audit, print first-audit wall clock + the
+    compile source counts (aot=deserialized executable, cache=
+    persistent-XLA-cache compile, fresh=cold compile). The parent
+    controls cold vs warm purely through the env cache dirs
+    (JAX_COMPILATION_CACHE_DIR + GATEKEEPER_TPU_AOT_DIR): an empty dir
+    is a cold boot, a populated one is exactly how a restarted pod with
+    a cache volume boots."""
+    from gatekeeper_tpu.ir import aot
+
+    drv, client = new_client()
+    if workload == "3":
+        from gatekeeper_tpu import policies
+
+        n = int(50_000 * SCALE)
+        for name in policies.names():
+            if name.startswith("pod-security-policy/"):
+                client.add_template(policies.load(name))
+        for kind, cname, params in PSP_CONSTRAINTS:
+            client.add_constraint({
+                "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": kind, "metadata": {"name": cname},
+                "spec": ({"parameters": params} if params else {}),
+            })
+        for o in synth_pods_psp(n):
+            client.add_data(o)
+    else:  # "4": the bench.py headline workload
+        from gatekeeper_tpu.parallel.workload import (
+            REQUIRED_LABELS_TEMPLATE,
+            synth_constraints,
+            synth_objects,
+        )
+
+        n = int(int(os.environ.get("BENCH_OBJECTS", 100_000)) * SCALE)
+        ncons = int(os.environ.get("BENCH_CONSTRAINTS", 500))
+        client.add_template(REQUIRED_LABELS_TEMPLATE)
+        for c in synth_constraints(ncons, seed=1):
+            client.add_constraint(c)
+        for o in synth_objects(n, violate_frac=0.01, seed=0):
+            client.add_data(o)
+    if drv.aot.programs_count():
+        # warm boot: give the ingest-time background prewarm a beat to
+        # deserialize + adopt the stored sweep signatures (a cold boot
+        # has nothing to load and proceeds immediately)
+        time.sleep(1.0)
+    t0 = time.time()
+    resp = client.audit()
+    first = time.time() - t0
+    # drain background compiles so this run's store is fully populated
+    # before the parent launches the warm run against it
+    t0w = time.time()
+    while drv.warm_status()["compiling"] and time.time() - t0w < 600:
+        time.sleep(0.2)
+    print(json.dumps({"first_audit_s": round(first, 3),
+                      "violations": len(resp.results()),
+                      "compile_sources": dict(aot.COMPILE_COUNTS)}))
+
+
+def coldwarm_probe(workload: str) -> dict:
+    """Cold-vs-warm first-audit measurement (the tentpole's tracked
+    number): run the workload child twice in fresh subprocesses against
+    the same initially-empty compile-cache + AOT dirs. Run 1 pays every
+    XLA compile (cold restart with no cache volume); run 2 boots the
+    way a restarted pod with the populated volume does — deserialize
+    and go."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="gk-coldwarm-")
+    out: dict = {}
+    try:
+        env = dict(os.environ)
+        env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(tmp, "xla")
+        env["GATEKEEPER_TPU_AOT_DIR"] = os.path.join(tmp, "aot")
+        for run in ("cold", "warm"):
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--coldwarm-child", workload],
+                    capture_output=True, text=True, env=env,
+                    timeout=int(os.environ.get("BENCH_COLDWARM_TIMEOUT",
+                                               1800)))
+            except subprocess.TimeoutExpired:
+                out[f"{run}_error"] = "timeout"
+                break
+            lines = [ln for ln in r.stdout.splitlines()
+                     if ln.startswith("{")]
+            if not lines:
+                out[f"{run}_error"] = (r.stderr or "")[-300:]
+                break
+            d = json.loads(lines[-1])
+            out[f"{run}_first_audit_s"] = d["first_audit_s"]
+            out[f"{run}_compile_sources"] = d["compile_sources"]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
 
 
 # --------------------------------------------------------------- config 6
@@ -1493,6 +1601,9 @@ def main() -> None:
         return
     if sys.argv[1:2] == ["--mesh-audit"]:
         _mesh_audit_child(int(sys.argv[2]), int(sys.argv[3]))
+        return
+    if sys.argv[1:2] == ["--coldwarm-child"]:
+        _coldwarm_child(sys.argv[2])
         return
     run([int(a) for a in sys.argv[1:]] or [1, 2, 3, 5, 6, 7])
 

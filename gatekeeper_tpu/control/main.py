@@ -212,6 +212,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "snapshotting; a corrupt or stale snapshot "
                         "falls back to the cold start path, never a "
                         "crash loop")
+    p.add_argument("--compile-cache-dir", default="",
+                   help="persistent XLA compilation cache directory "
+                        "(equivalent to GATEKEEPER_TPU_COMPILE_CACHE; an "
+                        "explicit JAX_COMPILATION_CACHE_DIR env var still "
+                        "wins). Point it at a volume so restarts skip "
+                        "XLA compiler time; pair with --state-dir for "
+                        "the full AOT deserialize-and-go warm boot")
+    p.add_argument("--aot-dir", default="",
+                   help="AOT serialized-program store directory "
+                        "(ir/aot.py): compiled device executables are "
+                        "persisted here and warm boots deserialize them "
+                        "instead of recompiling. Defaults to "
+                        "<state-dir>/aot when --state-dir is set; empty "
+                        "with no --state-dir disables the store (the "
+                        "compile cache above still applies)")
     p.add_argument("--snapshot-interval", type=float, default=60.0,
                    help="seconds between periodic state snapshots "
                         "(also taken on SIGTERM drain; SIGHUP forces "
@@ -344,7 +359,20 @@ class Runtime:
             self.kube_gated = GuardedKube(
                 self.kube, self.write_breaker, budget,
                 write_gate=lambda: self.elector.is_leader)
-        driver = TpuDriver()
+        # cold-start elimination: the compile-cache flag feeds
+        # enable_compile_cache (driver construction) through the env
+        # hook, and the AOT serialized-program store colocates with the
+        # state snapshots (<state-dir>/aot) so ONE volume carries the
+        # whole deserialize-and-go warm boot
+        import os as _os
+        cc_dir = getattr(args, "compile_cache_dir", "") or ""
+        if cc_dir:
+            _os.environ["GATEKEEPER_TPU_COMPILE_CACHE"] = cc_dir
+        aot_dir = getattr(args, "aot_dir", "") or ""
+        state_dir = getattr(args, "state_dir", "") or ""
+        if not aot_dir and state_dir:
+            aot_dir = _os.path.join(state_dir, "aot")
+        driver = TpuDriver(aot_dir=aot_dir or None)
         self.opa = Backend(driver).new_client([K8sValidationTarget()])
         self.mutation_system = None
         if "mutation-webhook" in operations:
@@ -965,7 +993,95 @@ class Runtime:
         log.info("gatekeeper-tpu stopped")
 
 
+def warm_cache_main(argv=None) -> int:
+    """`gatekeeper-tpu warm-cache`: prepack the compile caches.
+
+    Restores the library/vocab/inventory snapshots from a state dir and
+    runs one full audit with INLINE compilation, so every device program
+    the restored workload needs lands in the persistent XLA cache and
+    the AOT serialized-program store (<state-dir>/aot). Run it at image
+    build time or from an initContainer against the state volume: the
+    serving pod that follows deserializes instead of compiling —
+    single-digit-second first audit. Prints one JSON summary line."""
+    import json
+    import os
+
+    p = argparse.ArgumentParser(
+        prog="gatekeeper-tpu warm-cache",
+        description="pre-compile + serialize device programs for a "
+                    "snapshotted workload (bake warm caches into "
+                    "images/volumes)")
+    p.add_argument("--state-dir", required=True,
+                   help="state dir holding the snapshots to prepack "
+                        "for; the AOT store is written to "
+                        "<state-dir>/aot unless --aot-dir overrides")
+    p.add_argument("--aot-dir", default="")
+    p.add_argument("--compile-cache-dir", default="",
+                   help="persistent XLA cache dir to populate "
+                        "(GATEKEEPER_TPU_COMPILE_CACHE equivalent)")
+    p.add_argument("--enabled", default="true",
+                   help="false = exit 0 without prepacking (lets the "
+                        "chart's prewarm initContainer stay templated "
+                        "unconditionally and gate on the value)")
+    p.add_argument("--log-level", default="INFO")
+    args = p.parse_args(argv)
+    glog.setup(args.log_level)
+    if str(args.enabled).strip().lower() in ("false", "0", "no", "off"):
+        print(json.dumps({"skipped": "prewarm disabled"}))
+        return 0
+    if args.compile_cache_dir:
+        os.environ["GATEKEEPER_TPU_COMPILE_CACHE"] = args.compile_cache_dir
+    from .statestore import StateStore, restore_section
+    store = StateStore(args.state_dir)
+    driver = TpuDriver(aot_dir=args.aot_dir or store.aot_dir())
+    # this run IS the compile pass: no background warm, no host
+    # fallback — trace/lower/compile inline and persist everything,
+    # minting durable (serializable) executables even when the XLA
+    # cache answers the compile
+    driver.async_warm = False
+    driver.aot.force_durable = True
+    client = Backend(driver).new_client([K8sValidationTarget()])
+    restored = {}
+    if hasattr(driver, "vocab_restore"):
+        restored["vocab"] = restore_section(store, "vocab",
+                                            driver.vocab_restore)
+    restored["library"] = restore_section(
+        store, "library", lambda snap: client.restore_library(snap))
+    objects = 0
+
+    def apply_inventory(snap):
+        nonlocal objects
+        if hasattr(driver, "inventory_restore"):
+            objects = driver.inventory_restore(snap.get("tree") or {})
+
+    restored["inventory"] = restore_section(store, "inventory",
+                                            apply_inventory, blob=True)
+    violations = None
+    audit_s = None
+    if objects:
+        t0 = time.time()
+        violations = len(client.audit().results())
+        audit_s = round(time.time() - t0, 2)
+    else:
+        log.warning("no inventory snapshot to sweep; only ingestion-"
+                    "time programs were prepacked — run against a "
+                    "state dir with snapshots for full coverage")
+    summary = {
+        "restored": restored, "objects": objects,
+        "violations": violations, "audit_s": audit_s,
+        "aot": driver.aot.stats_snapshot(),
+        "programs_stored": driver.aot.programs_count(),
+        "compile_cache_enabled": driver.compile_cache_enabled,
+    }
+    print(json.dumps(summary))
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv[:1] == ["warm-cache"]:
+        return warm_cache_main(argv[1:])
     args = build_parser().parse_args(argv)
     glog.setup(args.log_level)
     runtime = Runtime(args)

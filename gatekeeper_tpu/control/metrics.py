@@ -676,6 +676,40 @@ def report_device_programs(warm: int, compiling: int) -> None:
                        compiling)
 
 
+def report_compile(source: str, outcome: str, seconds: float) -> None:
+    """One device-program acquisition: source "aot" (deserialized from
+    the AOT program store), "cache" (lower+compile answered by the
+    persistent XLA cache), or "fresh" (cold XLA compile)."""
+    REGISTRY.counter_add("gatekeeper_tpu_compile_total",
+                         "Device program acquisitions by source "
+                         "(aot=deserialized executable, cache=persistent-"
+                         "XLA-cache compile, fresh=cold compile) and "
+                         "outcome", source=source, outcome=outcome)
+    if outcome == "ok":
+        REGISTRY.observe("gatekeeper_tpu_compile_seconds",
+                         "Seconds spent acquiring device programs "
+                         "(AOT deserialize or lower+compile)", seconds,
+                         source=source)
+
+
+def report_compile_cache(enabled: bool) -> None:
+    REGISTRY.gauge_set("gatekeeper_tpu_compile_cache_enabled",
+                       "1 when the persistent XLA compilation cache is "
+                       "active; 0 means every restart recompiles (check "
+                       "the cache dir volume/permissions)",
+                       1.0 if enabled else 0.0)
+
+
+def report_aot_store(enabled: bool, programs: int = 0) -> None:
+    REGISTRY.gauge_set("gatekeeper_tpu_aot_store_enabled",
+                       "1 when the AOT serialized-program store is "
+                       "active (warm boots deserialize device programs "
+                       "instead of recompiling)", 1.0 if enabled else 0.0)
+    REGISTRY.gauge_set("gatekeeper_tpu_aot_store_programs",
+                       "Serialized device programs in the AOT store",
+                       programs)
+
+
 def report_audit_sweep(path: str) -> None:
     """One audit sweep took `path`: "incremental" (delta-applied encoded
     inventory), "full_resync" (the periodic from-scratch re-encode

@@ -97,6 +97,14 @@ class StateStore:
     def path(self, section: str) -> str:
         return os.path.join(self.dir, f"{section}.snapshot.json")
 
+    def aot_dir(self) -> str:
+        """Where the AOT serialized-program store (ir/aot.py) lives:
+        colocated under the state dir so one volume carries both the
+        warm-restart snapshots and the warm-boot device programs (the
+        full deserialize-and-go restart path). The store itself manages
+        the per-(backend, device-count, jax-version) subdirs."""
+        return os.path.join(self.dir, "aot")
+
     def blob_path(self, section: str) -> str:
         return os.path.join(self.dir, f"{section}.snapshot.blob")
 

@@ -141,21 +141,55 @@ def _expand_parameterless(rows, cols, c_dev: int, n_cons: int):
     return rows, cols
 
 
+def _pad_cbucket(enc: dict, c: int) -> dict:
+    """Pad encoded parameter tensors along the constraint axis to its
+    power-of-two bucket, replicating the LAST real constraint into the
+    padding columns (their verdicts are sliced off on device via n_cons
+    — see evaljax fires_*). The C axis then only changes shape when a
+    bucket boundary is crossed, so adding or removing one constraint to
+    a library re-hits every cached/AOT device program instead of
+    triggering a fresh XLA compile mid-serving (the same trick the
+    vocab capacity and extraction axes already use)."""
+    from .features import _bucket
+
+    cap = _bucket(c)
+    if cap == c or not enc:
+        return enc
+    pad = cap - c
+    return {slot: {nm: np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1),
+                              mode="edge")
+                   for nm, a in arrs.items()}
+            for slot, arrs in enc.items()}
+
+
 class _ServeHostThisRound(Exception):
     """Internal: a large review batch should evaluate on the host path
     this round (its device program is still warming in the background);
     NOT a demotion."""
 
 
-def enable_compile_cache() -> None:
+# log the unusable-cache warning once per process, not once per driver
+_cache_warned = False
+
+
+def enable_compile_cache() -> bool:
     """Point JAX at a persistent compilation cache (idempotent). A cold
     audit pays ~20-40s of XLA compiles; with the cache, every later
     process on the same machine skips them. Production entrypoints and
-    benchmarks both get this by constructing a TpuDriver."""
+    benchmarks both get this by constructing a TpuDriver.
+
+    Returns whether the cache is active. Failure (unwritable volume,
+    read-only image, env skew) degrades to recompile-every-boot — it is
+    logged at WARNING with the attempted dir and exported as the
+    `gatekeeper_tpu_compile_cache_enabled` gauge so the operator can
+    see it, but never breaks serving."""
+    global _cache_warned
     import os
 
     import jax
 
+    path = None
+    ok = False
     try:
         # threshold knobs apply wherever the cache lives (respecting an
         # explicit env override of the compile-time floor)
@@ -168,29 +202,46 @@ def enable_compile_cache() -> None:
             # the operator chose the location. JAX only reads this env
             # var at import time — a sitecustomize jax preimport makes
             # later os.environ writes silently no-ops — so re-apply it
+            path = env_dir
             if jax.config.jax_compilation_cache_dir != env_dir:
                 os.makedirs(env_dir, exist_ok=True)
                 jax.config.update("jax_compilation_cache_dir", env_dir)
-            return
-        path = os.environ.get("GATEKEEPER_TPU_COMPILE_CACHE")
-        if not path:
-            # per-platform default: a CPU process reloading AOT results
-            # compiled for the TPU host (or vice versa) warns about
-            # machine mismatches and risks SIGILL on feature-gated code.
-            # (An operator-named dir is used exactly as given.)
-            path = os.path.join(os.path.expanduser("~"), ".cache",
-                                "gatekeeper_tpu_xla",
-                                jax.default_backend())
-        os.makedirs(path, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", path)
-    except Exception:  # pragma: no cover - cache is best-effort
+            ok = True
+        else:
+            path = os.environ.get("GATEKEEPER_TPU_COMPILE_CACHE")
+            if not path:
+                # per-platform default: a CPU process reloading AOT
+                # results compiled for the TPU host (or vice versa)
+                # warns about machine mismatches and risks SIGILL on
+                # feature-gated code. (An operator-named dir is used
+                # exactly as given.)
+                path = os.path.join(os.path.expanduser("~"), ".cache",
+                                    "gatekeeper_tpu_xla",
+                                    jax.default_backend())
+            os.makedirs(path, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", path)
+            ok = True
+    except Exception as e:
+        if not _cache_warned:
+            _cache_warned = True
+            log.warning(
+                "persistent XLA compile cache unavailable at %s — every "
+                "process restart will pay full recompiles (fix the "
+                "volume/permissions or point JAX_COMPILATION_CACHE_DIR "
+                "elsewhere): %s: %s", path, type(e).__name__, e)
+    try:
+        from ..control.metrics import report_compile_cache
+
+        report_compile_cache(ok)
+    except Exception:  # metrics backend optional in embedders
         pass
+    return ok
 
 
 class TpuDriver(RegoDriver):
-    def __init__(self, mesh=None, device=None):
+    def __init__(self, mesh=None, device=None, aot_dir=None):
         super().__init__()
-        enable_compile_cache()
+        self.compile_cache_enabled = enable_compile_cache()
         # per-engine device pinning (the N-engine admission plane: one
         # engine process per chip): evaluation and device_put target
         # THIS device, and the audit mesh is disabled — a pinned engine
@@ -284,6 +335,13 @@ class TpuDriver(RegoDriver):
             "GATEKEEPER_TPU_SWEEP_CHUNK", "8192"))
         self._warm_done: set = set()
         self._warm_inflight: dict = {}           # sig -> done Event
+        # sigs adopted from the AOT store's manifest (not yet executed
+        # in THIS process): their first dispatch runs under the
+        # no-inline-compile guard — if the backing executable turns out
+        # not to deserialize after all (save was refused, store GC'd),
+        # the sig is un-adopted and the normal host-fallback/background
+        # warm path serves, instead of an inline XLA stall
+        self._warm_restored: set = set()
         self._warm_fail: dict = {}               # sig -> failure count
         self._warm_lock = threading.Lock()       # guards the warm sets
         self._warm_sem = threading.Semaphore(1)  # one compile at a time
@@ -319,6 +377,23 @@ class TpuDriver(RegoDriver):
         # the delta cache, or the interpreter fallback
         self._eval_counts: dict[tuple, int] = {}
         self._eval_counts_lock = threading.Lock()
+        # AOT program store (ir/aot.py): serialized compiled executables
+        # + warm sweep signatures, persisted under the statestore's
+        # state dir (<state-dir>/aot) so a warm boot deserializes the
+        # exact device programs instead of recompiling them.
+        # GATEKEEPER_TPU_AOT_DIR overrides for bench/test processes.
+        from .aot import AotStore
+
+        self.aot = AotStore()
+        aot_dir = aot_dir or _os.environ.get("GATEKEEPER_TPU_AOT_DIR", "")
+        if aot_dir:
+            self.aot.set_dir(aot_dir)
+        # constraint-count (C-axis) power-of-two bucketing: library
+        # edits that stay inside a bucket re-hit every cached device
+        # program (GATEKEEPER_TPU_CBUCKET=0 restores exact-C shapes
+        # for differential comparisons)
+        self.cbucket = _os.environ.get(
+            "GATEKEEPER_TPU_CBUCKET", "1") != "0"
 
     def _build_mesh(self, mesh):
         import os
@@ -390,6 +465,13 @@ class TpuDriver(RegoDriver):
                 self._join_progs[kind] = compile_join(module, kind)
             except Uncompilable:
                 pass
+        # off-path compilation starts at INGESTION: build the device
+        # evaluator now (cheap host work on the ingesting thread — the
+        # intern table is not thread-safe, so resolve_consts must not
+        # run from a background thread) and deserialize any AOT-stored
+        # executables for it in the background, so the first sweep at a
+        # remembered shape dispatches with zero on-path compilation
+        self._enqueue_prewarm(kind)
 
     def delete_modules(self, prefix: str) -> int:
         n = super().delete_modules(prefix)
@@ -417,9 +499,76 @@ class TpuDriver(RegoDriver):
         the tensor shapes match a previous generation's signature."""
         with self._warm_lock:
             self._warm_done = {s for s in self._warm_done
-                               if s[0] != kind}
+                               if self._sig_kind(s) != kind}
+            self._warm_restored = {s for s in self._warm_restored
+                                   if self._sig_kind(s) != kind}
             self._warm_fail = {s: c for s, c in self._warm_fail.items()
-                               if s[0] != kind}
+                               if self._sig_kind(s) != kind}
+
+    @staticmethod
+    def _sig_kind(sig: tuple):
+        """The kind a sweep signature belongs to (dense-batch sigs are
+        prefixed with "dense"; see _sweep_sig)."""
+        return sig[1] if sig and sig[0] == "dense" else sig[0]
+
+    def _enqueue_prewarm(self, kind: str) -> None:
+        """Ingest-time off-path compile: build the device evaluator for
+        `kind` inline (host-only work — program compile, const
+        resolution), then deserialize its AOT-stored executables and
+        mark the store's remembered sweep signatures warm on a
+        background thread. After this, a warm boot's first sweep at a
+        remembered shape dispatches straight onto the device — no
+        lowering, no XLA, no host-fallback round."""
+        if not self.async_warm:
+            return  # deterministic-dispatch mode (tests) stays lazy
+        try:
+            ct = self.compiled_for(kind)
+            jc = self.join_for(kind) if ct is None else None
+        except Exception:  # lazy path will surface/demote properly
+            return
+        if (ct is None and jc is None) or not self.aot.enabled:
+            return
+
+        def run():
+            try:
+                if ct is not None:
+                    loaded = ct.preload_aot(self._mesh)
+                    n = sum(loaded.values())
+                    if n:
+                        self._mark_stored_sigs_warm(ct.fingerprint,
+                                                    loaded)
+                        log.info(
+                            "%d AOT device programs for %s deserialized "
+                            "at ingestion (warm sweep shapes dispatch "
+                            "with zero compilation)", n, kind)
+                elif jc is not None:
+                    jc.preload_aot()
+            except Exception as e:  # prewarm is best-effort
+                log.debug("AOT prewarm for %s failed: %s", kind, e)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"aot-prewarm-{kind}").start()
+
+    def _mark_stored_sigs_warm(self, fingerprint: str,
+                               loaded: dict) -> None:
+        """Adopt the store's remembered sweep signatures as warm. Mesh
+        signatures are only adopted when mesh programs actually
+        deserialized (a topology drift would otherwise send the first
+        audit into an inline compile)."""
+        mesh_ok = bool(loaded.get("mesh") or loaded.get("mesh-slab"))
+        sigs = self.aot.sigs_for(fingerprint)
+        with self._warm_lock:
+            for sig in sigs:
+                use_mesh = (sig[2] if sig and sig[0] == "dense"
+                            else (sig[1] if len(sig) > 1 else False))
+                if use_mesh is True and not mesh_ok:
+                    continue
+                self._warm_done.add(sig)
+                # adoption is optimistic: the sig's exact executable may
+                # not have persisted (save refused, store GC'd), so its
+                # first dispatch runs no-inline-compile guarded and
+                # un-adopts on a miss rather than stalling on XLA
+                self._warm_restored.add(sig)
 
     def compiled_for(self, kind: str) -> Optional[CompiledTemplate]:
         """Lazily wrap the Program in a device evaluator, registering its
@@ -458,7 +607,8 @@ class TpuDriver(RegoDriver):
                 pat_i = int(op.rsplit(":", 1)[1])
                 self.match_tables.register_op(
                     op, interp_pred(module, fn_name, pat_i))
-            ct = CompiledTemplate(prog, self.strtab, self.match_tables)
+            ct = CompiledTemplate(prog, self.strtab, self.match_tables,
+                                  aot=self.aot, kind=kind)
             self._derived_cols[kind] = cols
         except Exception as e:
             self._demote(kind, "lowering", e)
@@ -656,6 +806,11 @@ class TpuDriver(RegoDriver):
                 "state": state,
                 "quarantine": quarantined.get(kind),
                 "eval_counts": evals,
+                # per-kind compile provenance: recent device-program
+                # acquisitions with source (aot=deserialized, cache=
+                # persistent-XLA-cache, fresh=cold compile), seconds,
+                # and the (static-config, shape-bucket) key
+                "compile": self.aot.events_for(kind),
                 "hlo_dump": ("gatekeeper_tpu.utils.profiling."
                              f"compiled_hlo(driver.compiled_for({kind!r})"
                              ", ...) renders the device program; set "
@@ -680,7 +835,8 @@ class TpuDriver(RegoDriver):
         if prog is not None:
             from .join import JoinCompiled
             try:
-                jc = JoinCompiled(prog, self.strtab)
+                jc = JoinCompiled(prog, self.strtab, aot=self.aot,
+                                  kind=kind)
             except Exception as e:
                 self._demote(kind, "join-lowering", e)
                 jc = None
@@ -1033,25 +1189,68 @@ class TpuDriver(RegoDriver):
         return (kind, use_mesh, slab, shapes(feats), shapes(enc),
                 tuple(getattr(table, "shape", ())), shapes(derived))
 
+    def _unadopt(self, sig) -> None:
+        """A warm-boot-adopted sweep signature turned out not to be
+        backed by a deserializable executable: forget the adoption so
+        the normal cold-sig machinery (background warm + host fallback,
+        or block-when-cheaper) takes over."""
+        with self._warm_lock:
+            self._warm_done.discard(sig)
+            self._warm_restored.discard(sig)
+
+    def _dispatch_guarded(self, sig, ct, feats, enc, table, derived,
+                          n_true, use_mesh, n_cons):
+        """_dispatch_handle, but when `sig` was adopted from the AOT
+        store (never executed in THIS process) the dispatch runs under
+        the no-inline-compile guard: a store miss returns None (caller
+        re-gates the sig as cold) instead of stalling the serving
+        thread on XLA. Small-N lazy paths that defer their jit call to
+        consume time are outside the guard — they are below the device
+        cost threshold in practice and bounded to one chunk."""
+        from . import aot as aot_mod
+
+        with self._warm_lock:
+            restored = sig in self._warm_restored
+        if not restored:
+            return self._dispatch_handle(ct, feats, enc, table, derived,
+                                         n_true, use_mesh, n_cons=n_cons)
+        try:
+            with aot_mod.no_inline_compile():
+                h = self._dispatch_handle(ct, feats, enc, table,
+                                          derived, n_true, use_mesh,
+                                          n_cons=n_cons)
+        except aot_mod.WouldCompile:
+            log.info("adopted sweep signature for %s not backed by a "
+                     "stored executable after all; re-warming it off "
+                     "the serving path", self._sig_kind(sig))
+            self._unadopt(sig)
+            return None
+        with self._warm_lock:
+            self._warm_restored.discard(sig)
+        return h
+
     def _dispatch_handle(self, ct, feats, enc, table, derived, n_true,
-                         use_mesh, chunk=None):
+                         use_mesh, chunk=None, n_cons=None):
         chunk = chunk or self.sweep_chunk
         if use_mesh:
             return ct.fires_pairs_mesh_dispatch(
                 feats, enc, table, self._mesh, derived, chunk=chunk,
-                n_true=n_true, slab=self.mesh_slab_local)
+                n_true=n_true, slab=self.mesh_slab_local, n_cons=n_cons)
         return ct.fires_pairs_dispatch(feats, enc, table, derived,
                                        chunk=chunk,
                                        slab=self._sweep_slab(n_true, chunk),
-                                       n_true=n_true)
+                                       n_true=n_true, n_cons=n_cons)
 
-    def _spawn_warm(self, sig, kind, ct, feats, enc, table, derived,
-                    n_true, use_mesh):
-        """Run the device sweep once in the background so its jit caches
-        populate off the serving path; results are discarded (the
-        foreground already answered from the host path this round).
-        Returns the completion Event (callers whose host alternative is
-        worse than the compile may choose to wait on it)."""
+    def _spawn_warm(self, sig, kind, run_fn, fingerprint=None,
+                    what=""):
+        """Run one cold device program (`run_fn`: a full sweep/batch
+        evaluation thunk) in the background so its jit caches populate
+        off the serving path; results are discarded (the foreground
+        already answered from the host path this round). On success the
+        sweep signature is persisted to the AOT store, so a future warm
+        boot marks this shape warm BEFORE its first sweep. Returns the
+        completion Event (callers whose host alternative is worse than
+        the compile may choose to wait on it)."""
         with self._warm_lock:
             ev = self._warm_inflight.get(sig)
             if ev is not None or sig in self._warm_done:
@@ -1065,16 +1264,15 @@ class TpuDriver(RegoDriver):
             t0 = _time.time()
             try:
                 with self._warm_sem:
-                    handle = self._dispatch_handle(ct, feats, enc, table,
-                                                   derived, n_true,
-                                                   use_mesh)
-                    for _ in handle.pairs():
-                        pass
+                    run_fn()
                 with self._warm_lock:
                     self._warm_done.add(sig)
+                if fingerprint:
+                    self.aot.record_sig(fingerprint, sig)
                 log.info("device program for %s warm after %.1fs "
-                         "(mesh=%s); next audit hot-swaps off the host "
-                         "path", kind, _time.time() - t0, use_mesh)
+                         "(%s); next audit hot-swaps off the host "
+                         "path", kind, _time.time() - t0,
+                         what or "sweep")
             except Exception as e:
                 # do NOT demote from here: the warm sweep runs
                 # concurrently with foreground device work, so a
@@ -1104,10 +1302,14 @@ class TpuDriver(RegoDriver):
 
     def warm_status(self) -> dict:
         """Observability: how many device programs are warm/in-flight
-        (bench.py reports it alongside which path served)."""
+        (bench.py reports it alongside which path served), plus the AOT
+        program store's acquisition stats (aot/cache/fresh counts and
+        seconds)."""
         with self._warm_lock:
-            return {"warm": len(self._warm_done),
-                    "compiling": len(self._warm_inflight)}
+            out = {"warm": len(self._warm_done),
+                   "compiling": len(self._warm_inflight)}
+        out["aot"] = self.aot.stats_snapshot()
+        return out
 
     def _audit_dispatch(self, target, kind, ct, cons, reviews, lookup_ns,
                         sig_cache):
@@ -1139,21 +1341,46 @@ class TpuDriver(RegoDriver):
                     ct, kind, cand_reviews, cons, feat_key, cand=cand,
                     target=target, mesh=use_mesh)
             c_dev = _param_c(enc)
+            n_cons = len(cons)
+            sig = self._sweep_sig(kind, feats, enc, table, derived,
+                                  len(cand_reviews), use_mesh)
+            def warm_run():
+                h = self._dispatch_handle(ct, feats, enc, table,
+                                          derived, len(cand_reviews),
+                                          use_mesh, n_cons=n_cons)
+                for _ in h.pairs():
+                    pass
             if self.async_warm:
-                sig = self._sweep_sig(kind, feats, enc, table, derived,
-                                      len(cand_reviews), use_mesh)
                 # host fallback only when it is actually cheaper than
                 # waiting out the compile: at audit scale (e.g. 50M
                 # masked pairs) minutes of interpretation would be far
                 # worse than blocking ~10-90s once
-                if not self._warm_gate(sig, kind, ct, feats, enc, table,
-                                       derived, len(cand_reviews),
-                                       use_mesh, int(mask.sum())):
+                if not self._warm_gate(sig, kind, warm_run,
+                                       int(mask.sum()),
+                                       fingerprint=ct.fingerprint,
+                                       what=f"mesh={use_mesh}"):
                     return None  # host path serves this audit
             import time as _time
 
-            handle = self._dispatch_handle(ct, feats, enc, table, derived,
-                                           len(cand_reviews), use_mesh)
+            handle = self._dispatch_guarded(sig, ct, feats, enc, table,
+                                            derived, len(cand_reviews),
+                                            use_mesh, n_cons)
+            if handle is None:
+                # the adopted signature didn't hold: re-gate it as cold
+                # (background warm + host fallback, or block-when-
+                # cheaper per the cost model)
+                if self.async_warm and not self._warm_gate(
+                        sig, kind, warm_run, int(mask.sum()),
+                        fingerprint=ct.fingerprint,
+                        what=f"mesh={use_mesh}"):
+                    return None
+                handle = self._dispatch_handle(
+                    ct, feats, enc, table, derived, len(cand_reviews),
+                    use_mesh, n_cons=n_cons)
+            # the program(s) for this shape are compiled/deserialized by
+            # now (dispatch traces them): remember the signature so a
+            # restarted process marks it warm before its first sweep
+            self.aot.record_sig(ct.fingerprint, sig)
             if use_mesh:
                 self._audit_used_mesh = True
             self.note_eval(kind, "device")
@@ -1518,7 +1745,58 @@ class TpuDriver(RegoDriver):
                                                         cons, feat_key)
         # chunked: keeps [N, axes..., C] intermediates bounded on large
         # audits; falls through to a single dispatch for small batches
-        fires = ct.fires_chunked(feats, enc, table, derived)
+        fires = ct.fires_chunked(feats, enc, table, derived,
+                                 n_cons=len(cons))
+        return fires[: len(reviews)]
+
+    def _eval_compiled_gated(self, ct: CompiledTemplate, kind: str,
+                             reviews: list[dict],
+                             cons: list[dict]) -> np.ndarray:
+        """eval_compiled with the off-path compile gate: a dense batch
+        shape whose device program has never executed serves from the
+        host THIS round (raises _ServeHostThisRound) while a background
+        thread warms it — an admission request must never block on an
+        XLA compile, however small."""
+        faults.fire("eval.device", kind=kind)
+        feats, enc, table, derived = self._prepare_eval(ct, kind, reviews,
+                                                        cons, None)
+        if self.async_warm:
+            sig = ("dense",) + self._sweep_sig(
+                kind, feats, enc, table, derived, len(reviews), False)
+
+            def warm_run():
+                ct.fires_chunked(feats, enc, table, derived,
+                                 n_cons=len(cons))
+            with self._warm_lock:
+                warm = sig in self._warm_done
+                restored = sig in self._warm_restored
+            if not warm:
+                self._spawn_warm(sig, kind, warm_run,
+                                 fingerprint=ct.fingerprint,
+                                 what="dense batch")
+                raise _ServeHostThisRound()
+            if restored:
+                # adopted from the AOT store, never executed here: run
+                # no-inline-compile guarded — if the backing executable
+                # is missing after all, serve host and warm off-path
+                # rather than stall this admission batch on XLA
+                from . import aot as aot_mod
+                try:
+                    with aot_mod.no_inline_compile():
+                        fires = ct.fires_chunked(feats, enc, table,
+                                                 derived,
+                                                 n_cons=len(cons))
+                except aot_mod.WouldCompile:
+                    self._unadopt(sig)
+                    self._spawn_warm(sig, kind, warm_run,
+                                     fingerprint=ct.fingerprint,
+                                     what="dense batch")
+                    raise _ServeHostThisRound()
+                with self._warm_lock:
+                    self._warm_restored.discard(sig)
+                return fires[: len(reviews)]
+        fires = ct.fires_chunked(feats, enc, table, derived,
+                                 n_cons=len(cons))
         return fires[: len(reviews)]
 
     def eval_compiled_pairs(self, ct: CompiledTemplate, kind: str,
@@ -1532,7 +1810,8 @@ class TpuDriver(RegoDriver):
                                                         cand=cand,
                                                         target=target)
         rows, cols = ct.fires_pairs(feats, enc, table, derived,
-                                    n_true=len(reviews))
+                                    n_true=len(reviews),
+                                    n_cons=len(cons))
         return _expand_parameterless(rows, cols, _param_c(enc), len(cons))
 
     def eval_compiled_pairs_slabbed(self, ct: CompiledTemplate, kind: str,
@@ -1555,7 +1834,8 @@ class TpuDriver(RegoDriver):
         slab = max(chunk * 4, ((half + chunk - 1) // chunk) * chunk)
         for rows, cols in ct.fires_pairs_slabbed(feats, enc, table, derived,
                                                  chunk=chunk, slab=slab,
-                                                 n_true=len(reviews)):
+                                                 n_true=len(reviews),
+                                                 n_cons=len(cons)):
             yield _expand_parameterless(rows, cols, c_dev, len(cons))
 
     def _prepare_eval(self, ct: CompiledTemplate, kind: str,
@@ -1575,6 +1855,12 @@ class TpuDriver(RegoDriver):
                 param_dicts.append(p if p is not None else {})
             enc = encode_params(ct.program, param_dicts, self.strtab,
                                 self.match_tables)
+            if self.cbucket:
+                # C-axis bucketing: pad the constraint dim to its
+                # power-of-two bucket so a within-bucket library edit
+                # re-hits every cached/AOT device program (consumers
+                # slice back to the true C via n_cons)
+                enc = _pad_cbucket(enc, len(cons))
             kind_cache.clear()
             kind_cache[params_key] = enc
         feats = None
@@ -1801,8 +2087,8 @@ class TpuDriver(RegoDriver):
     # cluster through review_batch, the same scale as cached audits
     SPARSE_BATCH_MIN = 4096
 
-    def _warm_gate(self, sig, kind, ct, feats, enc, table, derived,
-                   n_true, use_mesh, n_masked_pairs) -> bool:
+    def _warm_gate(self, sig, kind, run_fn, n_masked_pairs,
+                   fingerprint=None, what="") -> bool:
         """Shared block-when-cheaper policy for a cold sweep shape:
         kick the background warm and return False (serve host) when the
         host alternative is tolerable, else wait the compile out and
@@ -1811,8 +2097,8 @@ class TpuDriver(RegoDriver):
         with self._warm_lock:
             if sig in self._warm_done:
                 return True
-        ev = self._spawn_warm(sig, kind, ct, feats, enc, table, derived,
-                              n_true, use_mesh)
+        ev = self._spawn_warm(sig, kind, run_fn, fingerprint=fingerprint,
+                              what=what)
         if n_masked_pairs / self._host_pair_rate <= \
                 self.ASYNC_WARM_MAX_HOST_S:
             return False
@@ -1833,18 +2119,39 @@ class TpuDriver(RegoDriver):
         use_mesh = self._mesh_shardable(len(cand_reviews))
         feats, enc, table, derived = self._prepare_eval(
             ct, kind, cand_reviews, cons, feat_key=None, mesh=use_mesh)
+        n_cons = len(cons)
+        sig = self._sweep_sig(kind, feats, enc, table, derived,
+                              len(cand_reviews), use_mesh)
+        def warm_run():
+            h = self._dispatch_handle(ct, feats, enc, table, derived,
+                                      len(cand_reviews), use_mesh,
+                                      n_cons=n_cons)
+            for _ in h.pairs():
+                pass
         if self.async_warm:
-            sig = self._sweep_sig(kind, feats, enc, table, derived,
-                                  len(cand_reviews), use_mesh)
-            if not self._warm_gate(sig, kind, ct, feats, enc, table,
-                                   derived, len(cand_reviews), use_mesh,
-                                   int(mask.sum())):
+            if not self._warm_gate(sig, kind, warm_run, int(mask.sum()),
+                                   fingerprint=ct.fingerprint,
+                                   what=f"batch mesh={use_mesh}"):
                 raise _ServeHostThisRound()
         # latency EMA measured from DISPATCH (post-warm): folding a
         # compile wait into it would steer batches to the host for ages
         t0 = _time.time()
-        handle = self._dispatch_handle(ct, feats, enc, table, derived,
-                                       len(cand_reviews), use_mesh)
+        handle = self._dispatch_guarded(sig, ct, feats, enc, table,
+                                        derived, len(cand_reviews),
+                                        use_mesh, n_cons)
+        if handle is None:
+            # the adopted signature didn't hold: this is a cold shape —
+            # serve host while it warms in the background (an admission
+            # batch must never block on XLA)
+            if self.async_warm:
+                self._spawn_warm(sig, kind, warm_run,
+                                 fingerprint=ct.fingerprint,
+                                 what=f"batch mesh={use_mesh}")
+                raise _ServeHostThisRound()
+            handle = self._dispatch_handle(ct, feats, enc, table,
+                                           derived, len(cand_reviews),
+                                           use_mesh, n_cons=n_cons)
+        self.aot.record_sig(ct.fingerprint, sig)
         c_dev = _param_c(enc)
         pairs = []
         first = True
@@ -1961,8 +2268,9 @@ class TpuDriver(RegoDriver):
                             ct, kind, cand, cand_reviews, cons, mask)
                     else:
                         t0 = _time.time()
-                        fires = self.eval_compiled(ct, kind,
-                                                   cand_reviews, cons)
+                        fires = self._eval_compiled_gated(ct, kind,
+                                                          cand_reviews,
+                                                          cons)
                         self._observe("_dev_batch_lat_s",
                                       _time.time() - t0)
                         hits = np.logical_and(fires, mask[cand])

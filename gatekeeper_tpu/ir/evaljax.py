@@ -595,25 +595,31 @@ class _EagerPairs:
     dense-small / parameter-only paths stay lazy (they are
     latency-trivial)."""
 
-    def __init__(self, ct, feats, params, table, derived, chunk, n_true):
+    def __init__(self, ct, feats, params, table, derived, chunk, n_true,
+                 n_cons=None):
         self._ct = ct
-        self._args = (feats, params, table, derived, chunk, n_true)
+        self._args = (feats, params, table, derived, chunk, n_true,
+                      n_cons)
         self._st = None
         if feats:
             n_feat = next(iter(next(iter(
                 feats.values())).values())).shape[0]
             n = n_feat if n_true is None else min(n_feat, n_true)
             if n_feat > chunk:
+                c = _param_c(params)
+                if n_cons is not None:
+                    c = min(c, n_cons)
                 self._st = ct._pairs_dispatch_mono(
-                    feats, params, table, derived, chunk, n)
+                    feats, params, table, derived, chunk, n, c)
 
     def pairs(self):
         if self._st is not None:
             yield self._ct._pairs_consume_mono(self._st)
             return
-        feats, params, table, derived, chunk, n_true = self._args
+        feats, params, table, derived, chunk, n_true, n_cons = self._args
         yield self._ct.fires_pairs(feats, params, table, derived,
-                                   chunk=chunk, n_true=n_true)
+                                   chunk=chunk, n_true=n_true,
+                                   n_cons=n_cons)
 
 
 class _SlabPairs:
@@ -791,19 +797,75 @@ class CompiledTemplate:
     """Device-evaluable filter for one template."""
 
     def __init__(self, program: Program, table: StringTable,
-                 match: MatchTables):
+                 match: MatchTables, aot=None, kind: str = ""):
         self.table = table
         self.match = match
         self.program = resolve_consts(program, table, match)
         self.plans = [_ClausePlan(self.program, c)
                       for c in self.program.clauses]
-        self._fn = jax.jit(self._eval)
+        # AOT program store (ir/aot.py): every jit below is wrapped so
+        # compiled executables persist across processes and a warm boot
+        # deserializes instead of recompiling. The fingerprint is over
+        # the RESOLVED program — interned ids are embedded in the
+        # constants, so vocab skew changes it and safely misses.
+        from .aot import AotStore, program_fingerprint
+
+        self.kind = kind
+        self.aot = aot if aot is not None else AotStore()
+        self.fingerprint = program_fingerprint(self.program, kind)
+        self._fn = self._ajit("eval", (), self._eval)
         self._scan_cache: dict[int, Any] = {}
         self._pairs_cache: dict[tuple, Any] = {}
         # remembered firing-row gather capacity (see _gather_rows)
         self._rows_cap = 256
         # per-shard capacity for the mesh sweep (fires_pairs_mesh_dispatch)
         self._rows_cap_mesh = 256
+
+    def _ajit(self, tag: str, static: tuple, fn):
+        from .aot import AotJit
+
+        return AotJit(fn, store=self.aot, fingerprint=self.fingerprint,
+                      tag=tag, static=static, kind=self.kind)
+
+    def preload_aot(self, mesh=None) -> dict:
+        """Ingest-time background prewarm: deserialize every stored
+        executable recorded for this program's fingerprint into the
+        live jit wrappers, so the first sweep/batch at a remembered
+        shape dispatches with ZERO lowering or compilation on-path.
+        Mesh-program entries need the live mesh (skipped without one,
+        or when the topology drifted). Returns programs loaded, by
+        tag."""
+        loaded: dict[str, int] = {}
+        if not self.aot.enabled:
+            return loaded
+        for ent in self.aot.entries_for(self.fingerprint):
+            tag, static = ent["tag"], ent["static"]
+            try:
+                if tag == "eval":
+                    w = self._fn
+                elif tag == "scan":
+                    w = self._scan_jit(*static)
+                elif tag == "slab":
+                    w = self._slab_pairs_jit(*static)
+                elif tag == "rows":
+                    w = self._rows_jit(*static)
+                elif tag in ("mesh", "mesh-slab"):
+                    if mesh is None or \
+                            tuple(sorted(mesh.shape.items())) != static[-1]:
+                        continue
+                    if tag == "mesh":
+                        w = self._mesh_pairs_jit(mesh, *static[:-1])
+                    else:
+                        w = self._mesh_slab_pairs_jit(mesh, *static[:-1])
+                else:
+                    continue
+                key = self.aot.entry_key(self.fingerprint, tag, static,
+                                         ent["asig"])
+                if w.preload(ent["asig"], key):
+                    loaded[tag] = loaded.get(tag, 0) + 1
+            except Exception:  # pragma: no cover - prewarm best-effort
+                continue
+        return loaded
 
     def _eval(self, feats, params, table, derived):
         out = None
@@ -821,19 +883,29 @@ class CompiledTemplate:
     def fires_chunked(self, feats: dict, params: dict,
                       match_table: np.ndarray,
                       derived: Optional[dict] = None,
-                      chunk: int = 8192) -> np.ndarray:
+                      chunk: int = 8192,
+                      n_cons: Optional[int] = None) -> np.ndarray:
         """Chunk the N axis so [N, C, K...] intermediates stay bounded.
 
         Single dispatch: inputs live on device whole, the chunk loop is a
         lax.map inside the jitted fn (no per-chunk host→device transfers —
-        they dominate when the chip is reached over a network tunnel)."""
+        they dominate when the chip is reached over a network tunnel).
+
+        n_cons bounds the valid constraint columns: the C axis may carry
+        power-of-two bucket padding (driver._prepare_eval) so constraint
+        add/remove inside a bucket re-hits the cached program; padded
+        columns replicate the last real constraint and are sliced off
+        here."""
         derived = derived or {}
+        c = _param_c(params)
+        if n_cons is not None:
+            c = min(c, n_cons)
         if not feats:
             # parameter-only program: no object slots to chunk over
-            return self.fires(feats, params, match_table, derived)
+            return self.fires(feats, params, match_table, derived)[:, :c]
         n = next(iter(next(iter(feats.values())).values())).shape[0]
         if n <= chunk:
-            return self.fires(feats, params, match_table, derived)
+            return self.fires(feats, params, match_table, derived)[:, :c]
         if n % chunk:
             pad_n = ((n + chunk - 1) // chunk) * chunk
             feats = jax.tree_util.tree_map(
@@ -841,7 +913,7 @@ class CompiledTemplate:
                                   (a.ndim - 1)), feats)
         out = self._fn_scan(feats, params, match_table, derived, chunk)
         # slice the bit-unpack padding back to the true C
-        return np.asarray(out)[:n, :_param_c(params)]
+        return np.asarray(out)[:n, :c]
 
     def _fn_scan(self, feats, params, match_table, derived, chunk: int):
         """Verdicts return bit-packed over C (32x smaller device→host
@@ -855,6 +927,9 @@ class CompiledTemplate:
     def _packed_device(self, feats, params, match_table, derived,
                        chunk: int):
         """Bit-packed verdicts [Npad, W] uint32, left on device."""
+        return self._scan_jit(chunk)(feats, params, match_table, derived)
+
+    def _scan_jit(self, chunk: int):
         fn = self._scan_cache.get(chunk)
         if fn is None:
             def run(feats, params, table, derived):
@@ -877,9 +952,9 @@ class CompiledTemplate:
                         dtype=jnp.uint32)
                 outs = jax.lax.map(body, chunked)
                 return outs.reshape((-1,) + outs.shape[2:])
-            fn = jax.jit(run)
+            fn = self._ajit("scan", (chunk,), run)
             self._scan_cache[chunk] = fn
-        return fn(feats, params, match_table, derived)
+        return fn
 
     # ------------------------------------------------------ sparse verdicts
 
@@ -887,7 +962,8 @@ class CompiledTemplate:
                     match_table: np.ndarray,
                     derived: Optional[dict] = None,
                     chunk: int = 8192,
-                    n_true: Optional[int] = None
+                    n_true: Optional[int] = None,
+                    n_cons: Optional[int] = None
                     ) -> tuple[np.ndarray, np.ndarray]:
         """-> (rows, cols): row-major-ordered firing (object, constraint)
         index pairs.
@@ -905,24 +981,27 @@ class CompiledTemplate:
         clauses, so they are masked out ON DEVICE before the count, or
         they would flood the gather capacity)."""
         derived = derived or {}
+        c = _param_c(params)
+        if n_cons is not None:
+            c = min(c, n_cons)
         if not feats:
             fires = self.fires(feats, params, match_table, derived)
-            rows, cols = np.nonzero(fires)
+            rows, cols = np.nonzero(fires[:, :c])
             return rows.astype(np.int64), cols.astype(np.int64)
         n = next(iter(next(iter(feats.values())).values())).shape[0]
         if n_true is not None:
             n = min(n, n_true)
-        c = _param_c(params)
         if next(iter(next(iter(feats.values())).values())).shape[0] <= chunk:
             fires = self.fires(feats, params, match_table, derived)
             rows, cols = np.nonzero(fires[:n, :c])
             return rows.astype(np.int64), cols.astype(np.int64)
         st = self._pairs_dispatch_mono(feats, params, match_table, derived,
-                                       chunk, n)
+                                       chunk, n, c)
         return self._pairs_consume_mono(st)
 
     def _pairs_dispatch_mono(self, feats, params, match_table, derived,
-                             chunk: int, n: int):
+                             chunk: int, n: int,
+                             c: Optional[int] = None):
         """ASYNC dispatch of the monolithic packed sweep + row gather;
         _pairs_consume_mono syncs (with the capacity-retry loop)."""
         n_feat = next(iter(next(iter(feats.values())).values())).shape[0]
@@ -935,7 +1014,8 @@ class CompiledTemplate:
                                      chunk)
         rcap = self._rows_cap
         dev = self._gather_rows(packed, n, rcap)
-        return (packed, n, rcap, dev, _param_c(params))
+        return (packed, n, rcap, dev,
+                c if c is not None else _param_c(params))
 
     def _pairs_consume_mono(self, st):
         packed, n, rcap, dev, c = st
@@ -1008,7 +1088,7 @@ class CompiledTemplate:
             header = header.at[0, 0].set(rcount.astype(jnp.uint32))
             return jnp.concatenate([header, body2], axis=0)
 
-        fn = jax.jit(run)
+        fn = self._ajit("slab", (chunk, slab, rcap), run)
         self._pairs_cache[key] = fn
         return fn
 
@@ -1017,7 +1097,8 @@ class CompiledTemplate:
                              derived: Optional[dict] = None,
                              chunk: int = 8192,
                              slab: int = 32768,
-                             n_true: Optional[int] = None):
+                             n_true: Optional[int] = None,
+                             n_cons: Optional[int] = None):
         """Dispatch every slab kernel NOW (async); the returned handle's
         .pairs() iterator syncs and decodes slab-by-slab. Callers can
         dispatch MANY templates' sweeps before consuming any — the audit
@@ -1031,8 +1112,10 @@ class CompiledTemplate:
             n = min(n, n_true)
         if not feats or n <= slab or n_feat < slab:
             return _EagerPairs(self, feats, params, match_table, derived,
-                               chunk, n_true)
+                               chunk, n_true, n_cons)
         c = _param_c(params)
+        if n_cons is not None:
+            c = min(c, n_cons)
         n_slabs = (n + slab - 1) // slab
         rcap = self._rows_cap
         fn = self._slab_pairs_jit(chunk, slab, rcap)
@@ -1114,7 +1197,8 @@ class CompiledTemplate:
                 out_specs=P("data", None),
             )(feats, params, table, derived, n_valid)
 
-        fn = jax.jit(run)
+        fn = self._ajit(
+            "mesh", (chunk, rcap, tuple(sorted(mesh.shape.items()))), run)
         self._pairs_cache[key] = fn
         return fn
 
@@ -1199,7 +1283,9 @@ class CompiledTemplate:
                 out_specs=P("data", None),
             )(feats, params, table, derived, start, n_valid)
 
-        fn = jax.jit(run)
+        fn = self._ajit(
+            "mesh-slab",
+            (chunk, lslab, rcap, tuple(sorted(mesh.shape.items()))), run)
         self._pairs_cache[key] = fn
         return fn
 
@@ -1215,7 +1301,8 @@ class CompiledTemplate:
                                   derived: Optional[dict] = None,
                                   chunk: int = 8192,
                                   n_true: Optional[int] = None,
-                                  slab: Optional[int] = None):
+                                  slab: Optional[int] = None,
+                                  n_cons: Optional[int] = None):
         """Mesh-sharded form of fires_pairs_dispatch: dispatch the SPMD
         sweep NOW (async), return a handle whose .pairs() syncs and
         yields per-shard (rows, cols). Requires the feature N axis
@@ -1241,6 +1328,8 @@ class CompiledTemplate:
             raise ValueError(f"n_loc={n_loc} not divisible by "
                              f"chunk={chunk_eff}")
         c = _param_c(params)
+        if n_cons is not None:
+            c = min(c, n_cons)
         lslab = slab
         if lslab is None and \
                 n_loc >= self.MESH_SLAB_MIN_CHUNKS * chunk_eff:
@@ -1268,12 +1357,13 @@ class CompiledTemplate:
                             derived: Optional[dict] = None,
                             chunk: int = 8192,
                             slab: int = 32768,
-                            n_true: Optional[int] = None):
+                            n_true: Optional[int] = None,
+                            n_cons: Optional[int] = None):
         """Yield row-major (rows, cols) firing pairs per N-axis slab.
         See fires_pairs_dispatch; this is dispatch + immediate consume."""
         yield from self.fires_pairs_dispatch(
             feats, params, match_table, derived, chunk=chunk, slab=slab,
-            n_true=n_true).pairs()
+            n_true=n_true, n_cons=n_cons).pairs()
 
     def _gather_rows(self, packed, n: int, rcap: int):
         """Device firing-ROW gather: one [rcap+1, W+1] uint32 block —
@@ -1287,6 +1377,9 @@ class CompiledTemplate:
         roundtrip, so scalar-count-then-data would double the cost).
         Rows >= n are extraction padding, masked before counting. Host
         decodes with _decode_row_blocks (vectorized numpy)."""
+        return self._rows_jit(rcap)(packed, np.int32(n))
+
+    def _rows_jit(self, rcap: int):
         fn = self._pairs_cache.get(("rows", rcap))
         if fn is None:
             def run(packed, n):
@@ -1308,6 +1401,6 @@ class CompiledTemplate:
                 header = jnp.zeros((1, w + 1), jnp.uint32)
                 header = header.at[0, 0].set(rcount.astype(jnp.uint32))
                 return jnp.concatenate([header, body], axis=0)
-            fn = jax.jit(run)
+            fn = self._ajit("rows", (rcap,), run)
             self._pairs_cache[("rows", rcap)] = fn
-        return fn(packed, n)
+        return fn

@@ -601,12 +601,20 @@ def _canon_sid(strtab, v) -> int:
 class JoinCompiled:
     """Driver-facing evaluator for one join template."""
 
-    def __init__(self, prog: JoinProgram, strtab):
+    def __init__(self, prog: JoinProgram, strtab, aot=None,
+                 kind: str = ""):
         from ..rego.codegen import compile_module
         from ..rego.interp import Interpreter
+        from .aot import program_fingerprint
 
         self.prog = prog
         self.strtab = strtab
+        # AOT program store (ir/aot.py): the join membership program
+        # persists across restarts like the template sweep programs do
+        self.aot = aot
+        self.kind = kind
+        self.fingerprint = program_fingerprint(prog.module,
+                                               "join:" + kind)
         self._pkg = tuple(prog.module.package)
         self._interp = Interpreter({"join": prog.module})
         self._rev_fns = []
@@ -808,7 +816,11 @@ class JoinCompiled:
             self._dev_rev_cache[ci] = (kb_bytes, ik_bytes, rev_args)
         args = inv_args + rev_args
 
+        return np.asarray(self._jit_wrapper()(*args))
+
+    def _jit_wrapper(self):
         if self._jit is None:
+            import jax
             import jax.numpy as jnp
 
             def run(u_p, cnt_p, sik_p, karr, iks):
@@ -818,5 +830,32 @@ class JoinCompiled:
                 fire = found & ((cnt_p[pos] >= 2)
                                 | (sik_p[pos] != iks[:, None]))
                 return jnp.any(fire, axis=1)
-            self._jit = jax.jit(run)
-        return np.asarray(self._jit(*args))
+            if self.aot is not None:
+                from .aot import AotJit
+
+                self._jit = AotJit(run, store=self.aot,
+                                   fingerprint=self.fingerprint,
+                                   tag="join", kind=self.kind)
+            else:
+                self._jit = jax.jit(run)
+        return self._jit
+
+    def preload_aot(self) -> dict:
+        """Deserialize stored join executables for this program's
+        fingerprint (ingest-time background prewarm; see
+        CompiledTemplate.preload_aot). Returns programs loaded by tag."""
+        loaded: dict[str, int] = {}
+        if self.aot is None or not self.aot.enabled:
+            return loaded
+        w = self._jit_wrapper()
+        for ent in self.aot.entries_for(self.fingerprint):
+            if ent["tag"] != "join":
+                continue
+            try:
+                key = self.aot.entry_key(self.fingerprint, "join",
+                                         ent["static"], ent["asig"])
+                if w.preload(ent["asig"], key):
+                    loaded["join"] = loaded.get("join", 0) + 1
+            except Exception:  # pragma: no cover - prewarm best-effort
+                continue
+        return loaded

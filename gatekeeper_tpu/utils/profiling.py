@@ -24,7 +24,13 @@ def compiled_hlo(ct, feats, params, table, derived=None,
     args = (feats, params, table, derived or {})
     if stage == "jaxpr":
         return str(jax.make_jaxpr(ct._eval)(*args))
-    lowered = jax.jit(ct._eval).lower(*args)
+    # lower through the template's own jit wrapper when it has one (an
+    # AotJit — ir/aot.py — exposes .lower), so the rendered program is
+    # the exact one the AOT store persists/serves; plain jax.jit is the
+    # fallback for bare evaluators
+    fn = getattr(ct, "_fn", None)
+    lowered = (fn.lower(*args) if fn is not None and hasattr(fn, "lower")
+               else jax.jit(ct._eval).lower(*args))
     if stage == "optimized":
         return lowered.compile().as_text()
     return lowered.as_text()
