@@ -214,7 +214,7 @@ def main() -> None:
         proc = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(
                 os.path.abspath(__file__)), "bench_configs.py"),
-             "1", "2", "3", "5", "6", "7", "9", "10"],
+             "1", "2", "3", "5", "6", "7", "9", "10", "11"],
             capture_output=True, text=True, env=env,
             timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 2700)))
         for line in proc.stdout.splitlines():
@@ -283,6 +283,16 @@ def main() -> None:
         # time-to-ready vs the cold full list/encode boot
         "warm_boot_s": (configs.get("9") or {}).get("value"),
         "cold_boot_s": (configs.get("9") or {}).get("cold_boot_s"),
+        # streaming-audit headline (config 11): violation detection
+        # latency (watch event -> constraint-status write) p50/p99
+        # under churn, its speedup over the interval polling line, and
+        # the warm what-if preview sweep over a 100k+-object inventory
+        "violation_detection_ms":
+            (configs.get("11") or {}).get("violation_detection_ms"),
+        "detection_speedup_p99":
+            (configs.get("11") or {}).get("detection_speedup_p99"),
+        "whatif_preview_s":
+            (configs.get("11") or {}).get("whatif_preview_s"),
         # multichip headline (config 10): default mesh-sharded audit at
         # 1M+ objects vs the forced single-device path
         "mesh_audit_s": (configs.get("10") or {}).get("value"),

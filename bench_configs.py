@@ -59,6 +59,27 @@ def new_client(driver=None):
     return driver, Backend(driver).new_client([K8sValidationTarget()])
 
 
+def compiled_coverage(drv, client) -> dict:
+    """Per-library device coverage (ROADMAP item 4's tracked number):
+    the fraction of ingested template kinds served by a device program
+    (dense CompiledTemplate or inventory-join) rather than interpreter
+    fallback — a compiler regression that silently demotes a kind shows
+    up here as a fraction drop, not just a latency creep."""
+    kinds = client.template_kinds()
+    device = [k for k in kinds
+              if (hasattr(drv, "compiled_for")
+                  and drv.compiled_for(k) is not None)
+              or (hasattr(drv, "join_for")
+                  and drv.join_for(k) is not None)]
+    return {
+        "device_compiled_kinds": len(device),
+        "total_kinds": len(kinds),
+        "device_compiled_fraction":
+            round(len(device) / max(1, len(kinds)), 3),
+        "interpreter_kinds": sorted(set(kinds) - set(device)),
+    }
+
+
 def steady_audit(client, iters=3):
     t0 = time.time()
     resp = client.audit()
@@ -195,7 +216,7 @@ def config2():
     from gatekeeper_tpu import policies
 
     n = int(10_000 * SCALE)
-    _, client = new_client()
+    drv, client = new_client()
     for name in policies.names():
         if name.startswith("general/"):
             client.add_template(policies.load(name))
@@ -214,6 +235,7 @@ def config2():
         "unit": f"s (full general library, {len(GENERAL_CONSTRAINTS)} "
                 f"constraints x {n} mixed objects, steady state)",
         "first_audit_s": round(first, 2), "violations": nres,
+        **compiled_coverage(drv, client),
     }))
 
 
@@ -322,8 +344,6 @@ def config3():
     for o in synth_pods_psp(n):
         client.add_data(o)
     audit_s, first, nres = steady_audit(client)
-    compiled = drv.compiled_kinds() if hasattr(drv, "compiled_kinds") else []
-    device = [k for k in compiled if drv.compiled_for(k) is not None]
     # the tentpole's tracked number: cold restart (no cache volume) vs
     # warm restart (populated XLA cache + AOT program store) first
     # audit, each in a fresh subprocess
@@ -335,7 +355,7 @@ def config3():
                 f"{len(PSP_CONSTRAINTS)} constraints x {n} pods, "
                 f"steady state)",
         "first_audit_s": round(first, 2), "violations": nres,
-        "device_compiled_kinds": len(device),
+        **compiled_coverage(drv, client),
         **coldwarm,
     }))
 
@@ -1055,6 +1075,32 @@ def _run_sweep(port, rates, n_procs, duration, here):
     return sweep, sustained
 
 
+def c5_skip_record(counts: list, cores: int, forced: bool,
+                   env_key: str, what: str):
+    """Why a config-5 subprocess sweep will not run on this host, as an
+    explicit {"skipped": reason} record — or None to run it. Every
+    skip path MUST produce a record: a silent [] in the headline JSON
+    is indistinguishable from "measured and got nothing" (exactly what
+    hid the single-core gap in BENCH_r05)."""
+    if not counts:
+        return {"skipped": f"{env_key} is empty"}
+    if cores < 2 and not forced:
+        return {"skipped": f"{cores} host core(s): {what} would "
+                           f"time-share one core (set {env_key} to "
+                           "force)"}
+    return None
+
+
+def sweep_or_skip(entries: list, what: str) -> list:
+    """Backstop for the headline JSON: a sweep list that somehow ended
+    up empty ships an explicit record instead of a bare []."""
+    if not entries:
+        entries.append({"skipped": f"{what} produced no entries "
+                                   "(unexpected: no skip record was "
+                                   "recorded either)"})
+    return entries
+
+
 def config5():
     """Streaming admission (BASELINE config #5) measured three ways:
     1. engine: pre-batched reviews through driver.review_batch — the
@@ -1234,8 +1280,13 @@ def config5():
     mw_sweep: list = []
     mw_sustained = None
     base = sustained["offered_rps"] if sustained else 500
-    if not worker_counts:
-        mw_sweep.append({"skipped": "BENCH_C5_WORKERS is empty"})
+    mw_skip = c5_skip_record(worker_counts, cores,
+                             "BENCH_C5_WORKERS" in os.environ,
+                             "BENCH_C5_WORKERS",
+                             "pre-forked frontend + engine + loadgen "
+                             "processes")
+    if mw_skip is not None:
+        mw_sweep.append(mw_skip)
     else:
         engine_procs: list = []
         try:
@@ -1283,13 +1334,12 @@ def config5():
         "BENCH_C5_ENGINES", "1,2").split(",") if c.strip()]
     me_sweep: list = []
     me_sustained = None
-    if not engine_counts:
-        me_sweep.append({"skipped": "BENCH_C5_ENGINES is empty"})
-    elif cores < 2 and "BENCH_C5_ENGINES" not in os.environ:
-        me_sweep.append({
-            "skipped": f"{cores} host core(s): N JAX engine processes "
-                       "would time-share one core (set BENCH_C5_ENGINES "
-                       "to force)"})
+    me_skip = c5_skip_record(engine_counts, cores,
+                             "BENCH_C5_ENGINES" in os.environ,
+                             "BENCH_C5_ENGINES",
+                             "N JAX engine processes")
+    if me_skip is not None:
+        me_sweep.append(me_skip)
     else:
         for n_engines in engine_counts:
             engine_procs = []
@@ -1365,11 +1415,13 @@ def config5():
                       "pre-forked frontends over the shared batching "
                       "backplane (--admission-workers)",
         "sweep": sweep,
-        "multi_worker_sweep": mw_sweep,
+        "multi_worker_sweep": sweep_or_skip(mw_sweep,
+                                            "multi_worker_sweep"),
         # K engine processes (the --admission-engines topology), 2
         # frontends routing least-load across all K sockets; entries
         # are per engine count, or one explicit skip record
-        "multi_engine_sweep": me_sweep,
+        "multi_engine_sweep": sweep_or_skip(me_sweep,
+                                            "multi_engine_sweep"),
     }))
 
 
@@ -1579,9 +1631,199 @@ def config8():
     }))
 
 
+# -------------------------------------------------------------- config 11
+
+
+def config11():
+    """Streaming audit + what-if preview (the PR-9 tentpole numbers).
+
+    Part 1 — violation DETECTION latency (watch event -> the constraint-
+    status write reflecting it) at config-6 churn scale (PSP library x
+    50k pods), measured two ways on the same warm pipeline:
+      interval: the reference line's polling sweep — events land at
+        uniform offsets across one --audit-interval window and are
+        detected by the sweep at the tick (latency ~ U(0, I) + sweep);
+      streaming: --stream-audit — the tracker's watch events debounce-
+        flush through the delta pipeline; p50/p99 from the
+        event-receipt -> status-write clock inside the flush.
+    The headline gate: streaming p99 beats the interval line's by >=10x.
+
+    Part 2 — `whatif_preview_s`: a candidate constraint swept against a
+    100k+-object encoded inventory via /v1/preview's engine. Cold call
+    serves host while XLA warms off-path; the headline is the WARM
+    sweep (< 1s gate)."""
+    import threading
+
+    from gatekeeper_tpu import policies
+    from gatekeeper_tpu.control.audit import AuditManager
+    from gatekeeper_tpu.control.kube import FakeKube
+
+    n = int(50_000 * SCALE)
+    interval_s = float(os.environ.get("BENCH_C11_INTERVAL", 10.0))
+    kube = FakeKube()
+    kube.register_kind(("", "v1", "Namespace"), namespaced=False)
+    kube.register_kind(("", "v1", "Pod"), namespaced=True)
+    pods = synth_pods_psp(n)
+    for i, pod in enumerate(pods):
+        pod["metadata"]["uid"] = f"c11-{i}"
+        kube.create(pod)
+    drv, client = new_client()
+    for name in policies.names():
+        if name.startswith("pod-security-policy/"):
+            client.add_template(policies.load(name))
+    for kind, cname, params in PSP_CONSTRAINTS:
+        con = {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+               "kind": kind, "metadata": {"name": cname},
+               "spec": ({"parameters": params} if params else {})}
+        client.add_constraint(con)
+        kube.apply(dict(con))
+
+    mgr = AuditManager(kube, client, incremental=True,
+                       interval=3600, stream_audit=True,
+                       stream_window_s=0.025)
+    t0 = time.time()
+    mgr.audit_once()  # builds the tracker + encodes the inventory
+    first = time.time() - t0
+    t0 = time.time()
+    while hasattr(drv, "warm_status") and \
+            drv.warm_status()["compiling"] and time.time() - t0 < 600:
+        time.sleep(0.2)
+    t0 = time.time()
+    mgr.audit_once()
+    sweep_s = time.time() - t0  # warm steady-state sweep
+
+    rng = random.Random(11)
+
+    def churn(round_, k):
+        """k pod replacements; ~half flip violation state (privileged
+        toggle), half are healthy label churn — the stream flush must
+        both rewrite statuses and confirm no-ops."""
+        import copy
+        for j, i in enumerate(rng.sample(range(n), k)):
+            pod = copy.deepcopy(pods[i])
+            if j % 2:
+                ctx = pod["spec"]["containers"][0]["securityContext"]
+                ctx["privileged"] = not ctx.get("privileged", False)
+            else:
+                pod["metadata"].setdefault("labels", {})["churn"] = \
+                    f"r{round_}-{i}"
+            kube.apply(pod)
+
+    # --- interval line: events at uniform offsets across one window,
+    # detected by the sweep at the tick (driven inline — this IS what
+    # the polling loop does, without burning a thread to wait on)
+    k_events = 60
+    offsets = sorted(rng.uniform(0.0, interval_s * 0.95)
+                     for _ in range(k_events))
+    t_window = time.time()
+    event_times = []
+    for j, off in enumerate(offsets):
+        time.sleep(max(0.0, t_window + off - time.time()))
+        churn(1000 + j, 1)
+        event_times.append(time.time())
+    time.sleep(max(0.0, t_window + interval_s - time.time()))
+    mgr.audit_once()  # the tick
+    t_done = time.time()
+    int_lat = sorted(t_done - te for te in event_times)
+    interval_ms = {
+        "p50": round(int_lat[len(int_lat) // 2] * 1e3, 1),
+        "p99": round(int_lat[int(len(int_lat) * 0.99)] * 1e3, 1),
+        "interval_s": interval_s,
+    }
+
+    # --- streaming line: the stream loop flushes dirty rows as the
+    # watch delivers them; latencies come from the flush's own
+    # event-receipt -> status-write clock
+    stream_lat: list = []
+    lat_lock = threading.Lock()
+
+    def on_flush(lat, writes):
+        with lat_lock:
+            stream_lat.extend(lat)
+
+    mgr.on_flush = on_flush
+    mgr.start()
+    t0 = time.time()
+    while mgr.tracker is not None and not mgr.tracker.track_event_times \
+            and time.time() - t0 < 10:
+        time.sleep(0.02)  # stream loop arming the tracker hooks
+    time.sleep(0.3)
+    rounds = 40
+    burst = max(1, int(n * 0.01) // rounds)  # ~1% churn total
+    for r in range(rounds):
+        churn(r, burst)
+        time.sleep(0.15)  # past the debounce window: distinct flushes
+    t0 = time.time()
+    while time.time() - t0 < 10:
+        with lat_lock:
+            if len(stream_lat) >= rounds * burst:
+                break
+        time.sleep(0.05)
+    mgr.stop()
+    with lat_lock:
+        s_lat = sorted(stream_lat)
+    if not s_lat:
+        s_lat = [float("nan")]
+    stream_ms = {
+        "p50": round(s_lat[len(s_lat) // 2] * 1e3, 1),
+        "p99": round(s_lat[int(len(s_lat) * 0.99)] * 1e3, 1),
+    }
+
+    # --- what-if preview over a 100k+-object encoded inventory -------
+    from gatekeeper_tpu.control.preview import PreviewEngine
+    from gatekeeper_tpu.parallel.workload import (
+        REQUIRED_LABELS_TEMPLATE, synth_objects)
+
+    n_pv = int(100_000 * SCALE)
+    drv2, client2 = new_client()
+    client2.add_template(REQUIRED_LABELS_TEMPLATE)
+    for o in synth_objects(n_pv, violate_frac=0.01, seed=0):
+        client2.add_data(o)
+    pv = PreviewEngine(client2)
+    candidate = {
+        "kind": "K8sRequiredLabels", "metadata": {"name": "whatif"},
+        "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Namespace"]}]},
+                 "parameters": {"labels": [{"key": "cost-center"}]}},
+    }
+    out = pv.preview({"constraint": candidate, "limit": 5})
+    cold_s = out["duration_s"]
+    t0 = time.time()
+    while out["path"] != "device" and time.time() - t0 < 300:
+        time.sleep(1.0)  # background XLA warm for the alias kind
+        out = pv.preview({"constraint": candidate, "limit": 5})
+    warm_s = float("inf")
+    for _ in range(3):
+        out = pv.preview({"constraint": candidate, "limit": 5})
+        warm_s = min(warm_s, out["duration_s"])
+
+    print(json.dumps({
+        "config": 11, "metric": "violation_detection_ms_p99",
+        "value": stream_ms["p99"],
+        "unit": f"ms (watch event -> constraint-status write, "
+                f"--stream-audit, PSP library x {n} pods, ~1% churn "
+                f"in {rounds} bursts)",
+        "violation_detection_ms": stream_ms,
+        "detection_events": len(s_lat),
+        "stream_stats": mgr.stream_stats,
+        "interval_detection_ms": interval_ms,
+        "detection_speedup_p99": (
+            round(int_lat[int(len(int_lat) * 0.99)] * 1e3
+                  / max(stream_ms["p99"], 1e-9), 1)),
+        "steady_sweep_s": round(sweep_s, 3),
+        "first_audit_s": round(first, 2),
+        "whatif_preview_s": round(warm_s, 4),
+        "whatif_preview_cold_s": round(cold_s, 4),
+        "preview_reviewed": out["reviewed"],
+        "preview_violations": out["violations"],
+        "preview_path": out["path"],
+    }))
+
+
 def run(which: list[int]) -> None:
     table = {1: config1, 2: config2, 3: config3, 5: config5, 6: config6,
-             7: config7, 8: config8, 9: config9, 10: config10}
+             7: config7, 8: config8, 9: config9, 10: config10,
+             11: config11}
     for c in which:
         if c not in table:
             sys.exit(f"unknown bench config {c}: choose from "

@@ -41,6 +41,11 @@ log = logger("audit")
 DEFAULT_AUDIT_INTERVAL = 60  # seconds (reference manager.go:36,41)
 DEFAULT_CONSTRAINT_VIOLATIONS_LIMIT = 20  # manager.go:37,42
 DEFAULT_FULL_RESYNC_EVERY = 20  # incremental sweeps per full re-encode
+# streaming audit (--stream-audit): debounce window after the first
+# buffered watch event before a flush, and the pending-event count that
+# flushes early (a burst must not wait out the window event by event)
+DEFAULT_STREAM_WINDOW_S = 0.025
+DEFAULT_STREAM_MAX_BATCH = 512
 MSG_SIZE_LIMIT = 256  # bytes (manager.go:35,437-439)
 CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
 
@@ -103,6 +108,15 @@ class InventoryTracker:
         self.opa = opa
         self._lock = threading.Lock()
         self._dirty: dict[tuple, tuple] = {}   # key -> (etype, obj)
+        # streaming audit: monotonic receipt time of the OLDEST pending
+        # event per dirty key (coalescing keeps the first — detection
+        # latency is measured from the earliest unserved change), and
+        # an observer fired (outside the lock) whenever a watch event
+        # lands so the stream loop can debounce-flush instead of
+        # polling. Both are no-ops until the stream loop arms them.
+        self._dirty_at: dict[tuple, float] = {}
+        self.track_event_times = False
+        self.on_event: Optional[Callable[[], None]] = None
         self._state: dict[tuple, tuple] = {}   # key -> (uid, rv)
         self._cancels: dict[GVK, Callable[[], None]] = {}
         self._poll: set[GVK] = set()   # watchless GVKs: re-list per sweep
@@ -194,6 +208,7 @@ class InventoryTracker:
                 return None
 
         rv_i = as_int(rv)
+        notify = None
         with self._lock:
             cur = self._dirty.get(key)
             if cur is not None and rv_i is not None:
@@ -212,6 +227,11 @@ class InventoryTracker:
                              and event.type != "DELETED")):
                     return
             self._dirty[key] = (event.type, obj)
+            if self.track_event_times:
+                # first-event time wins: a burst coalescing onto one key
+                # is still one detection, measured from its oldest event
+                self._dirty_at.setdefault(key, time.monotonic())
+                notify = self.on_event
             if rv_i is not None:
                 # stream position for watch resume: advance-only, so a
                 # stale replay cannot move the snapshot point backwards
@@ -220,6 +240,9 @@ class InventoryTracker:
                     self._rvs[tuple(gvk)] = str(rv_i)
             elif rv:
                 self._rvs[tuple(gvk)] = rv
+        if notify is not None:
+            # outside the lock: the stream loop's condvar takes its own
+            notify()
 
     def note_gap(self, gvk: GVK) -> None:
         """External gap signal (watch stream lost beyond the client's
@@ -260,6 +283,7 @@ class InventoryTracker:
             pend = [k for k in self._dirty if k[0] == gvk]
             for k in pend:
                 del self._dirty[k]
+                self._dirty_at.pop(k, None)
         for key in doomed:
             self._remove_key(key)
 
@@ -319,6 +343,12 @@ class InventoryTracker:
             for k, v in pre.items():
                 if self._dirty.get(k) is v:  # unchanged during the list
                     del self._dirty[k]
+                    # the receipt time goes with it: a later event for
+                    # this key must stamp its OWN time, not revive this
+                    # one via record_event's setdefault (a stale stamp
+                    # collapses the debounce window and fakes a huge
+                    # detection-latency tail sample)
+                    self._dirty_at.pop(k, None)
             for o in objs:
                 key = _obj_key(gvk, o)
                 seen.add(key)
@@ -413,9 +443,24 @@ class InventoryTracker:
                  details={"objects": len(state), "gvks": len(gvks)})
         return len(state)
 
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._dirty)
+
+    def oldest_pending_age(self) -> Optional[float]:
+        """Age (seconds) of the oldest buffered-but-unapplied event, or
+        None when nothing is pending. Only meaningful with
+        track_event_times on (the streaming flush deadline)."""
+        with self._lock:
+            if not self._dirty_at:
+                return None
+            return time.monotonic() - min(self._dirty_at.values())
+
     def apply_pending(self) -> dict:
         """Drain the dirty map into the client's synced inventory.
-        Returns {"dirty": applied-change count, "total": tracked size}."""
+        Returns {"dirty": applied-change count, "total": tracked size,
+        "event_ts": receipt times of the drained events (streaming mode
+        only — the detection-latency clock starts there)}."""
         with self._lock:
             polls = sorted(self._poll)
             gaps = sorted(self._gaps | self._poll)
@@ -437,6 +482,13 @@ class InventoryTracker:
         with self._lock:
             drained = self._dirty
             self._dirty = {}
+            # event receipt times ride out with the drain; anything
+            # without a live dirty entry (superseded by a relist, GVK
+            # dropped) is pruned so the map cannot leak
+            event_ts = [self._dirty_at.pop(k) for k in drained
+                        if k in self._dirty_at]
+            self._dirty_at = {k: t for k, t in self._dirty_at.items()
+                              if k in self._dirty}
         applied = 0
         for key, (etype, obj) in sorted(drained.items()):
             if etype == "DELETED":
@@ -464,7 +516,7 @@ class InventoryTracker:
             applied += 1
         with self._lock:
             total = len(self._state)
-        return {"dirty": applied, "total": total}
+        return {"dirty": applied, "total": total, "event_ts": event_ts}
 
     def full_resync(self, gvks: list[GVK]) -> dict:
         """From-scratch re-encode: re-list every auditable GVK (in the
@@ -529,6 +581,7 @@ class InventoryTracker:
                 for k, v in pre.items():
                     if self._dirty.get(k) is v:
                         del self._dirty[k]
+                        self._dirty_at.pop(k, None)
             for o in objs:
                 try:
                     self.opa.add_data(o)
@@ -572,7 +625,10 @@ class AuditManager:
                  incremental: bool = False,
                  full_resync_every: int = DEFAULT_FULL_RESYNC_EVERY,
                  write_breaker=None, leader_check=None,
-                 gc_stale_statuses: bool = True):
+                 gc_stale_statuses: bool = True,
+                 stream_audit: bool = False,
+                 stream_window_s: float = DEFAULT_STREAM_WINDOW_S,
+                 stream_max_batch: int = DEFAULT_STREAM_MAX_BATCH):
         self.kube = kube
         self.opa = opa
         self.interval = interval
@@ -604,6 +660,34 @@ class AuditManager:
         # liveness heartbeat: stamped every loop iteration; healthy()
         # flags a dead/stalled audit loop for the k8s liveness probe
         self.heartbeat = time.monotonic()
+        # streaming audit: evaluate dirty rows as watch events arrive
+        # (debounce window + max-batch) instead of waiting out the
+        # interval; the interval sweep stays as the reconciliation
+        # backstop. Requires incremental mode — the whole point is the
+        # persistent encoded inventory + results delta cache.
+        self.stream_audit = stream_audit and incremental
+        self.stream_window_s = max(0.0, stream_window_s)
+        self.stream_max_batch = max(1, stream_max_batch)
+        self._stream_thread: Optional[threading.Thread] = None
+        self._stream_cv = threading.Condition()
+        self._stream_signal = False
+        # one sweep at a time: the stream flush and the interval
+        # backstop share the evaluation pipeline and the status writers
+        self._sweep_lock = threading.Lock()
+        # rolling flush observability (bench + tests + /debug): counts
+        # by outcome and the most recent detection-latency samples
+        self.stream_stats = {"flushes": 0, "errors": 0, "skipped": 0,
+                             "events": 0}
+        # streaming status-write delta baseline: (kind, name) -> the
+        # serialized violation entries last PUBLISHED. A flush lists +
+        # compares only the kinds whose fingerprints moved, so per-event
+        # write cost is O(changed constraints) in API list calls, not
+        # O(all constraints) per flush. None = unknown (next flush does
+        # one full live compare); never advanced on deferred writes.
+        self._stream_fp: Optional[dict] = None
+        # observer hook: called after each ok flush with
+        # (detection_latencies_s, write_stats) — bench/tests attach here
+        self.on_flush: Optional[Callable[[list, dict], None]] = None
 
     # ------------------------------------------------------------- lifecycle
 
@@ -611,9 +695,16 @@ class AuditManager:
         self._thread = threading.Thread(target=self._loop, name="audit",
                                         daemon=True)
         self._thread.start()
+        if self.stream_audit:
+            self._stream_thread = threading.Thread(
+                target=self._stream_loop, name="audit-stream",
+                daemon=True)
+            self._stream_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        with self._stream_cv:
+            self._stream_cv.notify_all()
         if self.tracker is not None:
             self.tracker.stop()
 
@@ -629,9 +720,14 @@ class AuditManager:
                 # map must not grow unboundedly while following, and a
                 # promoted survivor should sweep over a current
                 # inventory, not a stale one.
-                if self.incremental and self.tracker is not None:
+                if self.incremental and self.tracker is not None \
+                        and not self.stream_audit:
+                    # with streaming on, the stream loop owns follower
+                    # drains (skipped flushes) — double-draining here
+                    # would race it for the same dirty entries
                     try:
-                        self.tracker.apply_pending()
+                        with self._sweep_lock:
+                            self.tracker.apply_pending()
                     except Exception as e:
                         log.error("follower inventory sync failed",
                                   details=str(e))
@@ -658,6 +754,170 @@ class AuditManager:
         if max_stall is None:
             max_stall = max(10 * self.interval, 300.0)
         return time.monotonic() - self.heartbeat <= max_stall
+
+    # ----------------------------------------------------- streaming audit
+
+    def _stream_loop(self) -> None:
+        """Event-driven violation detection: wake on the tracker's
+        watch-event notification, debounce for stream_window_s (a burst
+        coalesces into one flush; stream_max_batch pending events flush
+        early), evaluate ONLY the dirty rows through the delta pipeline,
+        and publish changed constraint statuses — event-to-status in
+        milliseconds instead of up to a full --audit-interval."""
+        # the tracker is built lazily by the first interval sweep (or a
+        # warm restore); arm its event hooks as soon as it exists
+        while not self._stop.is_set():
+            tracker = self.tracker
+            if tracker is not None:
+                break
+            self._stop.wait(0.05)
+        if self._stop.is_set():
+            return
+
+        def on_event():
+            with self._stream_cv:
+                self._stream_signal = True
+                self._stream_cv.notify()
+
+        tracker.track_event_times = True
+        tracker.on_event = on_event
+        log.info("streaming audit armed",
+                 details={"window_ms": round(self.stream_window_s * 1e3),
+                          "max_batch": self.stream_max_batch})
+        while not self._stop.is_set():
+            with self._stream_cv:
+                while not self._stream_signal and not self._stop.is_set():
+                    # periodic wake: events buffered while the flush ran
+                    # (their notify landed before the wait) must not sit
+                    # until the next fresh event
+                    self._stream_cv.wait(0.25)
+                    if self.tracker is not None and \
+                            self.tracker.pending_count():
+                        break
+                self._stream_signal = False
+            if self._stop.is_set():
+                return
+            # debounce: let the burst land, but flush early on a full
+            # batch and never hold an event past ~2 windows — if the
+            # oldest buffered event already aged (the wake-up lagged the
+            # event, e.g. a flush was in flight when it landed), the
+            # wait shrinks so oldest-age + wait <= 2 windows
+            age = tracker.oldest_pending_age() or 0.0
+            deadline = time.monotonic() + max(
+                0.0, min(self.stream_window_s,
+                         2 * self.stream_window_s - age))
+            while time.monotonic() < deadline and not self._stop.is_set():
+                if self.tracker.pending_count() >= self.stream_max_batch:
+                    break
+                self._stop.wait(min(0.005, self.stream_window_s or 0.005))
+            if self._stop.is_set():
+                return
+            try:
+                self._stream_flush()
+            except Exception as e:
+                # the interval backstop repairs whatever this flush
+                # missed; the error must still be visible
+                self.stream_stats["errors"] += 1
+                metrics.report_stream_flush("error")
+                log.error("stream flush failed; interval backstop will "
+                          "reconcile", details=str(e))
+
+    def _stream_flush(self) -> None:
+        tracker = self.tracker
+        if tracker is None or tracker.pending_count() == 0:
+            return
+        if self.leader_check is not None and not self.leader_check():
+            # follower: keep the inventory current (a promoted survivor
+            # must sweep over fresh rows) but never write statuses
+            with self._sweep_lock:
+                tracker.apply_pending()
+            self.stream_stats["skipped"] += 1
+            metrics.report_stream_flush("skipped")
+            return
+        with self._sweep_lock:
+            if self._sweeps == 0:
+                # cold bootstrap pending: the first interval sweep's
+                # full re-encode will cover these events
+                return
+            t0 = time.time()
+            stats = tracker.apply_pending()
+            event_ts = stats.pop("event_ts", None) or []
+            if stats["dirty"] == 0 and not event_ts:
+                return  # pure no-op events (rv echoes)
+            tr = gtrace.TRACER.start(gtrace.AUDIT)
+            try:
+                with tr.span("evaluate"):
+                    results = self.opa.audit().results()
+                by_constraint = self._group_by_constraint(results)
+                # delta against the last published fingerprints: only
+                # kinds whose violation sets moved get listed/compared
+                # this flush (unknown baseline = one full live pass)
+                cur_fp = {k: self._status_entries(v)
+                          for k, v in by_constraint.items()}
+                prev_fp = self._stream_fp
+                kinds = None
+                if prev_fp is not None:
+                    kinds = {key[0] for key in set(prev_fp) | set(cur_fp)
+                             if prev_fp.get(key) != cur_fp.get(key)}
+                with tr.span("status_writes"):
+                    if kinds is not None and not kinds:
+                        # nothing moved: the no-op verdict needs no
+                        # API traffic at all
+                        writes = {"status_writes": 0,
+                                  "status_skipped": len(cur_fp),
+                                  "status_deferred": False}
+                    else:
+                        writes = self._write_audit_results(
+                            by_constraint, kinds=kinds)
+                tr.set_status("stream")
+                tr.set_attr("dirty", stats["dirty"])
+            except BaseException as e:
+                tr.set_status("error")
+                tr.set_attr("error", str(e))
+                raise
+            finally:
+                tr.finish()
+            self.stream_stats["flushes"] += 1
+            self.stream_stats["events"] += len(event_ts)
+            self.last_results = results
+            metrics.report_audit_sweep("stream")
+            if writes.get("status_deferred"):
+                # breaker open: statuses did NOT publish — the flush is
+                # an error and these events record NO detection latency
+                # (a sub-second sample here would claim a detection that
+                # never reached status; the pending deltas re-issue on
+                # the first healthy sweep, counted as backstop drift).
+                # The fingerprint baseline does not advance either, so
+                # the next flush re-lists and re-issues these kinds.
+                self.stream_stats["errors"] += 1
+                metrics.report_stream_flush("error")
+                lat = []
+            else:
+                # the detection clock stops when the status writes that
+                # publish the verdicts have completed (or were
+                # confirmed no-ops — an unchanged violation set IS the
+                # verdict)
+                self._stream_fp = cur_fp
+                now = time.monotonic()
+                lat = [max(0.0, now - ts) for ts in event_ts]
+                for s in lat:
+                    metrics.report_violation_detection(s)
+                metrics.report_stream_flush("ok")
+            dt = time.time() - t0
+            if lat:
+                log.info("stream flush",
+                         details={"dirty": stats["dirty"],
+                                  "events": len(lat),
+                                  "violations": len(results),
+                                  "detect_p_max_ms":
+                                      round(max(lat) * 1e3, 1),
+                                  "flush_s": round(dt, 4), **writes})
+        cb = self.on_flush
+        if cb is not None:
+            try:
+                cb(lat, writes)
+            except Exception:
+                pass  # observer only; never fail the flush
 
     # --------------------------------------------------------- warm restart
 
@@ -705,7 +965,10 @@ class AuditManager:
         # delta_serve time into trace phases.
         tr = gtrace.TRACER.start(gtrace.AUDIT, force=True)
         try:
-            return self._audit_once_traced(tr, t0)
+            # serialized with the streaming flush: both drive the same
+            # delta pipeline and status writers
+            with self._sweep_lock:
+                return self._audit_once_traced(tr, t0)
         except BaseException as e:
             # a failing sweep must still land in the flight recorder —
             # the sweeps that error (API outage, eval blowup) are
@@ -755,11 +1018,40 @@ class AuditManager:
         # sweep rewrites every status, refreshing auditTimestamp). In
         # incremental mode, full-resync sweeps force every write so the
         # timestamp still refreshes every full_resync_every intervals
+        force_writes = (not self.incremental
+                        or sweep_stats.get("sweep") == "full_resync")
         with tr.span("status_writes"):
-            writes = self._write_audit_results(
-                by_constraint,
-                force=not self.incremental
-                or sweep_stats.get("sweep") == "full_resync")
+            writes = self._write_audit_results(by_constraint,
+                                               force=force_writes)
+        # a full interval sweep (re)establishes the streaming delta
+        # baseline — unless the breaker deferred the writes, in which
+        # case what is published remains unknown
+        if self.stream_audit:
+            self._stream_fp = None if writes.get("status_deferred") \
+                else {k: self._status_entries(v)
+                      for k, v in by_constraint.items()}
+        streaming = (self.stream_audit and self._stream_thread is not None
+                     and sweep_stats.get("sweep") == "incremental")
+        if streaming:
+            # backstop role: with the streaming path keeping statuses
+            # current, any non-forced write this interval sweep had to
+            # issue is drift the event pipeline missed (or an external
+            # clobber it repaired) — 0 in steady state
+            drift = writes.get("status_writes", 0)
+            metrics.report_backstop_drift(drift)
+            if drift:
+                writes["backstop_drift"] = drift
+                log.warning("interval backstop repaired constraint-"
+                            "status drift", details={"writes": drift})
+        event_ts = sweep_stats.pop("_event_ts", None) or []
+        if event_ts and self.stream_audit:
+            # events the BACKSTOP drained (the stream loop missed or
+            # raced them): their detection latency is real — it lands
+            # in the same histogram as the streaming path's, honestly
+            # fattening the tail it is supposed to beat
+            now = time.monotonic()
+            for ts in event_ts:
+                metrics.report_violation_detection(max(0.0, now - ts))
         dt = time.time() - t0
         metrics.report_audit_duration(dt)
         metrics.report_audit_last_run()
@@ -840,8 +1132,11 @@ class AuditManager:
             "dirty": stats["dirty"], "inventory": stats["total"],
             "sync_s": round(sync_s, 3), "vocab_grown": grown,
             # evaluation wall clock for the caller's phase attribution
-            # (popped before the stats reach the log line)
+            # (popped before the stats reach the log line), and the
+            # receipt times of any events this sweep drained (streaming
+            # mode: the backstop's detections are histogrammed too)
             "_eval_wall_s": ev_wall,
+            "_event_ts": stats.get("event_ts") or [],
         }
 
     def _audit_resources(self) -> list:
@@ -970,7 +1265,8 @@ class AuditManager:
         return grouped
 
     def _write_audit_results(self, by_constraint: dict[tuple, list],
-                             force: bool = False) -> dict:
+                             force: bool = False,
+                             kinds: Optional[set] = None) -> dict:
         """status.byPod[audit] style update with cap + truncation + retry
         (manager.go:428-574). Constraints with no violations this run get
         their violation list cleared — but a constraint whose CURRENT
@@ -993,6 +1289,12 @@ class AuditManager:
         target_kinds = set()
         for kind in self.opa.template_kinds():
             target_kinds.add(kind)
+        if kinds is not None:
+            # streaming flushes restrict the list+compare to the kinds
+            # whose violation fingerprints moved (the backstop sweep
+            # passes None and still covers everything, so external
+            # clobbers of untouched kinds heal there, as drift)
+            target_kinds &= kinds
         live_pods = self._live_pod_ids() if self.gc_stale_statuses else None
         written = skipped = pruned = 0
         for kind in sorted(target_kinds):

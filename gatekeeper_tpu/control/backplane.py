@@ -180,11 +180,17 @@ class BackplaneEngine:
                  mutation=None, max_workers: int = 128,
                  default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S,
                  engine_id: str = "0", library_sink=None,
-                 stats_source=None):
+                 stats_source=None, preview=None):
         self.socket_path = socket_path
         self.validation = validation
         self.ns_label = ns_label
         self.mutation = mutation
+        # what-if preview (control.preview.PreviewEngine): served on its
+        # OWN single-thread executor, never the shared admission pool —
+        # a multi-second inventory sweep must not occupy a thread an
+        # admission verdict is waiting for
+        self.preview = preview
+        self._preview_pool = None
         self.default_timeout = default_timeout
         self.engine_id = str(engine_id)
         # L-frame handler (engine children): applies one replicated
@@ -221,6 +227,9 @@ class BackplaneEngine:
         self._pool = ThreadPoolExecutor(
             max_workers=self._max_workers,
             thread_name_prefix="backplane-serve")
+        if self.preview is not None:
+            self._preview_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="preview-serve")
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
         self._listener.listen(64)
@@ -289,6 +298,8 @@ class BackplaneEngine:
                 handler.batcher.stop()
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._preview_pool is not None:
+            self._preview_pool.shutdown(wait=False, cancel_futures=True)
         with self._conns_lock:
             conns = list(self._conns.values())
             self._conns.clear()
@@ -382,7 +393,7 @@ class BackplaneEngine:
                         log.error("backplane inline serve error",
                                   details=str(e))
                         inline = (500, b"")
-                    if inline[0] != "eval":
+                    if inline[0] not in ("eval", "eval-preview"):
                         # a failed/partial send desyncs the stream:
                         # close and let the frontend reconnect
                         t_send = time.monotonic()
@@ -396,9 +407,15 @@ class BackplaneEngine:
                         continue
                     with self._inflight_lock:
                         self._inflight += 1
-                    self._pool.submit(self._serve, conn, wlock, rid,
-                                      timeout_s, deadline, path, body,
-                                      inline[1], tr, time.monotonic())
+                    # preview sweeps ride their own single-thread
+                    # executor: admission verdicts never queue behind a
+                    # multi-second inventory evaluation
+                    pool = (self._preview_pool
+                            if inline[0] == "eval-preview"
+                            else self._pool)
+                    pool.submit(self._serve, conn, wlock, rid,
+                                timeout_s, deadline, path, body,
+                                inline[1], tr, time.monotonic())
                 elif kind == b"H":
                     info = jsonio.loads(payload[1:]) or {}
                     worker = str(info.get("worker", "?"))
@@ -541,6 +558,9 @@ class BackplaneEngine:
         if route == "mutate":
             return ("eval", None) if self.mutation is not None \
                 else (404, b"")
+        if route == "preview":
+            return ("eval-preview", None) if self.preview is not None \
+                else (404, b"")
         return (404, b"")
 
     def _serve(self, conn: socket.socket, wlock: threading.Lock,
@@ -589,6 +609,8 @@ class BackplaneEngine:
         # folded and the deadline pinned at frame receipt)
         route = route_path(path)
         try:
+            if route == "preview" and self.preview is not None:
+                return self.preview.handle_http(body)
             if route == "admitlabel" and self.ns_label is not None:
                 out = self.ns_label.handle(review)
             elif route == "admit" and self.validation is not None:
@@ -894,6 +916,16 @@ class BackplaneRouter:
              deadline: float,
              trace_ctx: Optional[tuple] = None) -> tuple[int, bytes]:
         clients = self.clients
+        if path.startswith("/v1/preview"):
+            # previews pin to the PRIMARY (engine 0): it owns the live
+            # tracker-fed inventory; pinned engine children only hold
+            # sync-time snapshots. No failover — a preview is not an
+            # admission verdict, an error answer is fine.
+            status, out = clients[0].call(path, body, timeout_s,
+                                          deadline, trace_ctx=trace_ctx)
+            if status == STATUS_NOT_READY:
+                raise BackplaneError("engine awaiting library sync")
+            return status, out
         if len(clients) == 1:
             status, out = clients[0].call(path, body, timeout_s,
                                           deadline,
@@ -1050,7 +1082,11 @@ class FrontendServer:
         # own two stages as aggregated S-frame deltas.
         tid = gtrace.TRACER.sample_context(traceparent)
         timeout_s = parse_timeout_query(path.partition("?")[2]) or 0.0
-        if timeout_s > 0:
+        if route == "preview":
+            # a cold preview may legitimately wait out an XLA compile;
+            # its wait is its own, not an admission budget
+            deadline = time.monotonic() + (timeout_s or 300.0)
+        elif timeout_s > 0:
             deadline = request_deadline({"timeoutSeconds": timeout_s},
                                         self.default_timeout)
         else:
@@ -1077,7 +1113,11 @@ class FrontendServer:
             return status, payload, {"X-Trace-Id": tid}
         except BackplaneError as e:
             self.stats.error()
-            out = 200, self._stance_envelope(route, body, str(e))
+            if route == "preview":
+                # not an admission verdict: a plain error, no stance
+                out = 503, jsonio.dumps_bytes({"error": str(e)})
+            else:
+                out = 200, self._stance_envelope(route, body, str(e))
             # a stance answer still reports its trace id: the id is in
             # the caller's hands (and logs) even though the engine
             # never saw the request

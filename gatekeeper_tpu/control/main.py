@@ -105,6 +105,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "from scratch (self-healing backstop); 0 "
                         "disables the periodic re-encode (the first "
                         "sweep still encodes from scratch)")
+    p.add_argument("--stream-audit", nargs="?", const=True,
+                   default=False, type=_parse_bool,
+                   help="with --audit-incremental: evaluate dirty rows "
+                        "AS WATCH EVENTS ARRIVE (micro-batched by "
+                        "--stream-window-ms) and publish changed "
+                        "constraint statuses immediately — violation "
+                        "detection latency (event -> status, the "
+                        "gatekeeper_tpu_violation_detection_seconds "
+                        "histogram) drops from up to a full "
+                        "--audit-interval to milliseconds. The interval "
+                        "sweep is demoted to a reconciliation backstop "
+                        "that reports any drift it had to repair "
+                        "(gatekeeper_tpu_audit_backstop_drift_total)")
+    p.add_argument("--stream-window-ms", type=float, default=25.0,
+                   help="streaming-audit debounce: after the first "
+                        "buffered watch event, wait this long for the "
+                        "burst to coalesce before flushing (a full "
+                        "--stream-max-batch flushes early)")
+    p.add_argument("--stream-max-batch", type=int, default=512,
+                   help="streaming-audit early-flush threshold: pending "
+                        "dirty events at or beyond this count flush "
+                        "without waiting out the window")
+    p.add_argument("--preview-endpoint", nargs="?", const=True,
+                   default=True, type=_parse_bool,
+                   help="serve POST /v1/preview (what-if evaluation of "
+                        "a candidate ConstraintTemplate/Constraint over "
+                        "the full cached inventory, without enforcing "
+                        "it) on the webhook port; see also "
+                        "--preview-port for audit-only pods")
+    p.add_argument("--preview-port", type=int, default=0,
+                   help="ALSO serve /v1/preview on this dedicated "
+                        "plaintext port (audit pods have no webhook "
+                        "port but own the freshest inventory); 0 "
+                        "disables the dedicated listener")
     p.add_argument("--log-denies", action="store_true")
     p.add_argument("--fail-closed", nargs="?", const=True, default=False,
                    type=_parse_fail_closed,
@@ -410,7 +444,21 @@ class Runtime:
                                           DEFAULT_FULL_RESYNC_EVERY),
                 write_breaker=self.write_breaker,
                 leader_check=(None if self.elector is None
-                              else lambda: self.elector.is_leader))
+                              else lambda: self.elector.is_leader),
+                stream_audit=getattr(args, "stream_audit", False),
+                stream_window_s=getattr(args, "stream_window_ms",
+                                        25.0) / 1000.0,
+                stream_max_batch=getattr(args, "stream_max_batch", 512))
+        # what-if preview (POST /v1/preview + the dedicated
+        # --preview-port listener): candidate templates/constraints
+        # evaluated over this process's cached inventory, compiled
+        # out-of-band under alias kinds so the serving library is
+        # untouched
+        self.preview_engine = None
+        self.preview_server = None
+        if getattr(args, "preview_endpoint", True):
+            from .preview import PreviewEngine
+            self.preview_engine = PreviewEngine(self.opa)
         self.webhook = None
         self.cert_rotator = None
         # serving plane (--admission-workers > 1): pre-forked HTTP
@@ -495,6 +543,11 @@ class Runtime:
                     serve += ["admit", "admitlabel"]
                 if mutation is not None:
                     serve += ["mutate"]
+                if self.preview_engine is not None:
+                    # frontends forward /v1/preview over the backplane;
+                    # the router pins it to engine 0 (this process — the
+                    # one whose tracker feeds the live inventory)
+                    serve += ["preview"]
                 # N-engine plane: this process is engine 0; engines
                 # 1..N-1 are child processes, each pinned to its own
                 # chip with its own Client/MicroBatcher/socket. The
@@ -571,7 +624,7 @@ class Runtime:
                 self.backplane = BackplaneEngine(
                     sock, validation=validation, ns_label=ns_label,
                     mutation=mutation, default_timeout=default_timeout,
-                    engine_id="0")
+                    engine_id="0", preview=self.preview_engine)
                 self.backplane.configured_workers = workers
                 self.frontends = FrontendSupervisor(
                     workers,
@@ -588,7 +641,16 @@ class Runtime:
                     validation, ns_label, port=args.port,
                     certfile=certfile, keyfile=keyfile,
                     reuse_port=getattr(args, "webhook_reuse_port", False),
-                    mutation=mutation)
+                    mutation=mutation, preview=self.preview_engine)
+        preview_port = getattr(args, "preview_port", 0) or 0
+        if preview_port and self.preview_engine is not None:
+            # dedicated plaintext preview listener: audit-only pods
+            # have no webhook port but own the freshest tracker-fed
+            # inventory — a WebhookServer with only the preview engine
+            # attached 404s every admission route
+            self.preview_server = WebhookServer(
+                None, None, port=preview_port,
+                preview=self.preview_engine)
         self.upgrade = UpgradeManager(self.kube)
         self.metrics_server = None
         self.health = None
@@ -932,6 +994,8 @@ class Runtime:
             self.cert_rotator.start(watch_manager=self.manager.wm)
         if self.webhook:
             self.webhook.start()
+        if self.preview_server is not None:
+            self.preview_server.start()
         if self.backplane is not None:
             # engines first: frontends connect eagerly on boot
             self.backplane.start()
@@ -965,6 +1029,8 @@ class Runtime:
             self.elector.stop()
         if self.webhook:
             self.webhook.stop()
+        if self.preview_server is not None:
+            self.preview_server.stop(drain_timeout=1.0)
         if self.backplane is not None:
             # frontends FIRST: each stops accepting and finishes its
             # in-flight HTTP requests (verdicts still flow over the
@@ -1077,11 +1143,93 @@ def warm_cache_main(argv=None) -> int:
     return 0
 
 
+def preview_main(argv=None) -> int:
+    """`gatekeeper-tpu preview`: what-if a candidate policy.
+
+    POSTs a constraint (plus, optionally, a not-yet-installed
+    ConstraintTemplate) to a running instance's /v1/preview and prints
+    the violation counts + capped samples as JSON — the full cached
+    inventory is swept on-device without enforcing anything. Point it at
+    the webhook port (TLS, self-signed accepted) or an audit pod's
+    --preview-port plaintext listener."""
+    import json
+    import ssl
+    import urllib.request
+
+    p = argparse.ArgumentParser(
+        prog="gatekeeper-tpu preview",
+        description="evaluate a candidate ConstraintTemplate/Constraint "
+                    "against a running instance's cached inventory "
+                    "without enforcing it")
+    p.add_argument("--url", default="https://localhost:8443",
+                   help="base URL of a running gatekeeper-tpu (webhook "
+                        "port — TLS, self-signed accepted — or "
+                        "http://host:port for an audit pod's plaintext "
+                        "--preview-port)")
+    p.add_argument("--constraint", required=True,
+                   help="constraint manifest (YAML or JSON file; '-' "
+                        "for stdin)")
+    p.add_argument("--template", default="",
+                   help="candidate ConstraintTemplate manifest (YAML or "
+                        "JSON); omit to preview against the kind's "
+                        "already-ingested template")
+    p.add_argument("--limit", type=int, default=20,
+                   help="violation samples to return (cap 500)")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="request timeout (a cold preview may wait out "
+                        "one XLA compile)")
+    args = p.parse_args(argv)
+
+    def load_manifest(path: str) -> dict:
+        raw = sys.stdin.read() if path == "-" else open(path).read()
+        try:
+            import yaml
+            doc = yaml.safe_load(raw)
+        except ImportError:
+            doc = json.loads(raw)
+        if not isinstance(doc, dict):
+            raise SystemExit(f"{path}: expected one manifest object")
+        return doc
+
+    payload = {"constraint": load_manifest(args.constraint),
+               "limit": args.limit}
+    if args.template:
+        payload["template"] = load_manifest(args.template)
+    req = urllib.request.Request(
+        args.url.rstrip("/") + "/v1/preview",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    ctx = None
+    if args.url.startswith("https"):
+        # the webhook serves a self-signed rotating cert; the preview
+        # payload carries no secrets, so unverified TLS is the useful
+        # default for an operator poking from a laptop
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    try:
+        with urllib.request.urlopen(req, timeout=args.timeout,
+                                    context=ctx) as resp:
+            body, status = resp.read(), resp.status
+    except urllib.error.HTTPError as e:
+        body, status = e.read(), e.code
+    except OSError as e:
+        print(json.dumps({"error": str(e)}), file=sys.stderr)
+        return 2
+    try:
+        print(json.dumps(json.loads(body), indent=2))
+    except ValueError:
+        sys.stdout.write(body.decode("utf-8", "replace") + "\n")
+    return 0 if status == 200 else 1
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv[:1] == ["warm-cache"]:
         return warm_cache_main(argv[1:])
+    if argv[:1] == ["preview"]:
+        return preview_main(argv[1:])
     args = build_parser().parse_args(argv)
     glog.setup(args.log_level)
     runtime = Runtime(args)

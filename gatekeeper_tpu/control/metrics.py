@@ -738,6 +738,60 @@ def report_audit_dirty(dirty: int, total: int, vocab_grown: int = 0) -> None:
                        vocab_grown)
 
 
+# violation detection latency: watch-event receipt -> the status write
+# (or no-op confirmation) that published the verdict. Sub-second when
+# the streaming audit is on; ~audit-interval/2 + sweep time without it.
+DETECTION_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                     10.0, 30.0, 60.0, 300.0)
+
+
+def report_violation_detection(seconds: float, n: int = 1) -> None:
+    """One (or n batched) watch events fully detected: received,
+    evaluated against the encoded inventory, and reflected in
+    constraint status — the streaming audit's headline latency."""
+    for _ in range(n):
+        REGISTRY.observe("gatekeeper_tpu_violation_detection_seconds",
+                         "Latency from watch-event receipt to the "
+                         "constraint-status write reflecting it "
+                         "(event -> status)", seconds,
+                         buckets=DETECTION_BUCKETS)
+
+
+def report_stream_flush(outcome: str, n: int = 1) -> None:
+    """One streaming-audit flush by outcome: ok (evaluated + statuses
+    current), error (evaluation or write failed; the interval backstop
+    repairs), or skipped (follower replica drained without writing)."""
+    REGISTRY.counter_add("gatekeeper_tpu_stream_flushes_total",
+                         "Streaming-audit dirty-row flushes by outcome",
+                         n, outcome=outcome)
+
+
+def report_backstop_drift(writes: int) -> None:
+    """Status writes the interval reconciliation sweep had to issue
+    while the streaming path was supposed to keep statuses current —
+    each one is drift the event pipeline missed (or an external
+    clobber it repaired). Should stay 0 in steady state."""
+    if writes > 0:
+        REGISTRY.counter_add("gatekeeper_tpu_audit_backstop_drift_total",
+                             "Constraint-status drift repaired by the "
+                             "interval reconciliation sweep while "
+                             "streaming detection was active", writes)
+
+
+def report_preview(outcome: str, seconds: float) -> None:
+    """One what-if preview evaluation (candidate template/constraint
+    swept against the full encoded inventory) by outcome."""
+    REGISTRY.counter_add("gatekeeper_tpu_preview_requests_total",
+                         "What-if preview evaluations by outcome",
+                         outcome=outcome)
+    if outcome == "ok":
+        REGISTRY.observe("gatekeeper_tpu_preview_duration_seconds",
+                         "Wall clock of one what-if preview sweep over "
+                         "the cached inventory", seconds,
+                         buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                                  10.0, 30.0, 60.0, 300.0))
+
+
 def report_audit_status_writes(written: int, skipped: int) -> None:
     """Constraint-status write deltas: PATCHes issued vs skipped because
     the constraint's violation set was unchanged since the last write."""

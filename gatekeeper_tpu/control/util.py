@@ -11,7 +11,7 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-VALID_ENFORCEMENT_ACTIONS = ("deny", "dryrun")
+VALID_ENFORCEMENT_ACTIONS = ("deny", "dryrun", "warn")
 DEFAULT_ENFORCEMENT_ACTION = "deny"
 
 

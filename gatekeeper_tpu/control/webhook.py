@@ -92,6 +92,8 @@ def route_path(path: str) -> Optional[str]:
         return "admit"
     if path.startswith("/v1/mutate"):
         return "mutate"
+    if path.startswith("/v1/preview"):
+        return "preview"
     return None
 
 
@@ -730,6 +732,7 @@ class ValidationHandler:
             results = self.batcher.submit(gk_review, deadline=deadline,
                                           trace=trace)
         denies = []
+        warns = []
         for r in results:
             if self.log_denies:
                 log.info(
@@ -746,12 +749,20 @@ class ValidationHandler:
                 )
             if r.enforcement_action == "deny":
                 denies.append(r.msg)
+            elif r.enforcement_action == "warn":
+                # enforcementAction: warn (reference policy.go:194-217
+                # line): the verdict stays allowed and the violation
+                # rides the AdmissionReview warnings field, which
+                # kubectl surfaces as a client-side Warning header
+                warns.append(r.msg)
         if denies:
             response = {"allowed": False,
                         "status": {"code": 403,
                                    "reason": "; ".join(sorted(denies))}}
         else:
             response = {"allowed": True}
+        if warns:
+            response["warnings"] = sorted(warns)
         if cache_key is not None and (not self.log_denies or not results):
             # under --log-denies a cached answer must not swallow audit
             # log lines: only violation-FREE responses are cached (deny,
@@ -1138,14 +1149,19 @@ class WebhookServer:
                  port: int = 8443, certfile: Optional[str] = None,
                  keyfile: Optional[str] = None, addr: str = "",
                  reuse_port: bool = False,
-                 mutation: Optional[MutationHandler] = None):
+                 mutation: Optional[MutationHandler] = None,
+                 preview=None):
         """reuse_port: bind with SO_REUSEPORT so multiple serving
         PROCESSES share one port (the kernel load-balances accepts) —
         the single-process Python frontend is GIL-bound, and this is
-        how one node runs N webhook workers without a proxy."""
+        how one node runs N webhook workers without a proxy.
+
+        `preview` (a control.preview.PreviewEngine) serves the what-if
+        /v1/preview endpoint when given."""
         self.validation = validation
         self.ns_label = ns_label
         self.mutation = mutation
+        self.preview = preview
         self.http = FastHTTPServer((addr, port), self._dispatch,
                                    reuse_port=reuse_port,
                                    certfile=certfile, keyfile=keyfile)
@@ -1183,6 +1199,14 @@ class WebhookServer:
         # the trace kwarg rides only on sampled requests: unsampled
         # calls stay signature-identical for handler stubs/embedders
         kw = {"trace": tr} if tr.sampled else {}
+        if route == "preview" and self.preview is not None:
+            # not an AdmissionReview: the preview engine answers its own
+            # JSON (it may run for seconds — per-connection handler
+            # threads mean admission requests are not behind it)
+            status, payload = self.preview.handle_http(body)
+            tr.set_status("preview")
+            tr.finish()
+            return status, payload
         if route == "admitlabel" and self.ns_label is not None:
             out = self.ns_label.handle(review)
         elif route == "admit" and self.validation is not None:

@@ -2322,3 +2322,49 @@ class TpuDriver(RegoDriver):
             f"mesh(data={self._mesh.shape['data']})"
             if self._batch_used_mesh else "single")
         return out
+
+    # ---------------------------------------------------- what-if preview
+
+    def audit_kind(self, target: str, kind: str,
+                   cons: list[dict]) -> tuple[list, str]:
+        """Sweep ONE kind's constraints over the full cached inventory
+        — the what-if preview's evaluation core. `kind` is normally a
+        preview ALIAS (control/preview.py compiles the candidate
+        template under a content-hashed alias kind), so every per-kind
+        cache this rides — match mask, extracted feature rows, device
+        programs, delta patching — is isolated from (and shaped exactly
+        like) the serving library's. Reuses the audit dispatch/consume
+        pipeline: sparse firing-pair gather, mesh sharding when the
+        inventory is large enough, async warm with block-when-cheaper.
+        Returns (results, path) with path in device|join|interp|empty.
+
+        The device-latency EMA is NOT sampled here: preview sweeps may
+        carry one-off compiles and must not steer admission batches to
+        the host."""
+        self._lat_sampled = True
+        lookup_ns = self._namespace_lookup(target)
+        inventory = self._inventory_tree(target)
+        reviews = self._inventory_reviews(target)
+        sig_cache = self._audit_sig_cache(target)
+        if not reviews:
+            return [], "empty"
+        ct = self.compiled_for(kind)
+        if ct is not None:
+            st = self._audit_dispatch(target, kind, ct, cons, reviews,
+                                      lookup_ns, sig_cache)
+            if st is not None:
+                return (self._audit_consume(target, kind, st, cons,
+                                            reviews, lookup_ns,
+                                            inventory, sig_cache),
+                        "empty" if st[0] == "empty" else "device")
+            return (self._audit_interp(target, kind, cons, reviews,
+                                       lookup_ns, inventory, None,
+                                       sig_cache), "interp")
+        jc = self.join_for(kind)
+        if jc is not None:
+            return (self._audit_join(target, kind, jc, cons, reviews,
+                                     lookup_ns, inventory, None,
+                                     sig_cache), "join")
+        return (self._audit_interp(target, kind, cons, reviews,
+                                   lookup_ns, inventory, None,
+                                   sig_cache), "interp")

@@ -2,7 +2,8 @@
 
 No helm binary is baked into the image, so the test renders the chart
 with a minimal substituter covering exactly the template constructs the
-chart uses ({{ .Values.* }}, {{ toYaml .Values.x | indent N }}) and then
+chart uses ({{ .Values.* }}, {{ toYaml .Values.x | indent N }}, and
+non-nested {{- if .Values.x }}...{{- end }} truthy guards) and then
 runs the same structural checks CI applies to the flat manifest —
 rendered output and flat manifest must describe the same objects.
 """
@@ -35,8 +36,15 @@ def render(values: dict) -> str:
         text = yaml.safe_dump(node, default_flow_style=False).rstrip()
         return "\n".join(" " * ind + ln for ln in text.splitlines())
 
+    def sub_if(m):
+        return m.group(2) if lookup(m.group(1)) else ""
+
+    # non-nested truthy guards: the block renders iff the value is
+    # truthy (Helm semantics for the scalars this chart guards on)
+    out = re.sub(r"\{\{-\s*if\s+\.Values\.([\w.]+)\s*\}\}\n(.*?)"
+                 r"\{\{-\s*end\s*\}\}\n", sub_if, tpl, flags=re.S)
     out = re.sub(r"\{\{\s*toYaml\s+\.Values\.([\w.]+)\s*\|\s*indent\s+"
-                 r"(\d+)\s*\}\}", sub_toyaml, tpl)
+                 r"(\d+)\s*\}\}", sub_toyaml, out)
     out = re.sub(r"\{\{\s*\.Values\.([\w.]+)\s*\}\}", sub_value, out)
     assert "{{" not in out, "unrendered template construct"
     return out
@@ -91,3 +99,41 @@ def test_chart_webhook_fail_open_preserved():
     # fails closed (protects the exemption label itself)
     assert policies["validation.gatekeeper.sh"] == "Ignore"
     assert policies["check-ignore-label.gatekeeper.sh"] == "Fail"
+
+
+def test_chart_streaming_and_preview_values_reach_deployments():
+    vals = default_values()
+    vals["streamAudit"]["windowMs"] = 40
+    vals["preview"]["auditPort"] = 9444
+    docs = [d for d in yaml.safe_load_all(render(vals)) if d is not None]
+    deps = {d["metadata"]["name"]: d for d in docs
+            if d["kind"] == "Deployment"}
+    ac = deps["gatekeeper-audit"]["spec"]["template"]["spec"][
+        "containers"][0]
+    # streaming implies the incremental watch-fed inventory
+    assert "--audit-incremental=True" in ac["args"]
+    assert "--stream-audit=True" in ac["args"]
+    assert "--stream-window-ms=40" in ac["args"]
+    assert "--stream-max-batch=512" in ac["args"]
+    # the audit pod's dedicated preview listener + its containerPort
+    assert "--preview-endpoint=True" in ac["args"]
+    assert "--preview-port=9444" in ac["args"]
+    assert any(p.get("name") == "preview"
+               and p["containerPort"] == 9444 for p in ac["ports"])
+    wc = deps["gatekeeper-controller-manager"]["spec"]["template"][
+        "spec"]["containers"][0]
+    assert "--preview-endpoint=True" in wc["args"]
+    # the documented disable value must render a VALID Deployment:
+    # auditPort=0 must not emit containerPort: 0 (rejected by the API)
+    vals["preview"]["auditPort"] = 0
+    # disabling streaming must NOT drag the incremental inventory down
+    # with it — the knobs are independent (auditIncremental)
+    vals["streamAudit"]["enabled"] = False
+    docs = [d for d in yaml.safe_load_all(render(vals)) if d is not None]
+    ac = {d["metadata"]["name"]: d for d in docs
+          if d["kind"] == "Deployment"}["gatekeeper-audit"][
+        "spec"]["template"]["spec"]["containers"][0]
+    assert "--preview-port=0" in ac["args"]
+    assert all(p.get("name") != "preview" for p in ac["ports"])
+    assert "--stream-audit=False" in ac["args"]
+    assert "--audit-incremental=True" in ac["args"]

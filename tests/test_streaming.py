@@ -1,0 +1,624 @@
+"""Streaming audit + what-if preview (ISSUE 9 tentpole).
+
+Detection-latency contract: with --stream-audit, a watch event lands in
+constraint status within the debounce window plus one dirty-row flush —
+milliseconds — instead of waiting out the --audit-interval polling
+sweep. The interval sweep is demoted to a reconciliation backstop whose
+repairs are drift, reported as such.
+
+Preview contract: a candidate template/constraint swept under its
+content-hashed alias kind produces the SAME violation set on the device
+path as the pure-interpreter oracle, without touching the serving
+library; the endpoint answers caller errors as 400s.
+
+enforcementAction parity: deny denies, dryrun is invisible to the
+caller, warn rides the AdmissionReview warnings field and never flips
+`allowed`.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu.client import Backend
+from gatekeeper_tpu.control.audit import AuditManager
+from gatekeeper_tpu.control.kube import FakeKube
+from gatekeeper_tpu.control.metrics import REGISTRY
+from gatekeeper_tpu.control.preview import PreviewEngine, PreviewError
+from gatekeeper_tpu.control.webhook import (
+    MicroBatcher,
+    ValidationHandler,
+    WebhookServer,
+)
+from gatekeeper_tpu.ir import TpuDriver
+from gatekeeper_tpu.parallel.workload import REQUIRED_LABELS_TEMPLATE
+from gatekeeper_tpu.target import K8sValidationTarget
+
+CONSTRAINT_GVK = ("constraints.gatekeeper.sh", "v1beta1",
+                  "K8sRequiredLabels")
+TEAM_CONSTRAINT = {
+    "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+    "kind": "K8sRequiredLabels",
+    "metadata": {"name": "pods-need-team", "uid": "c-team"},
+    "spec": {
+        "match": {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]},
+        "parameters": {"labels": [{"key": "team"}]},
+    },
+}
+
+
+def _pod(name, labels, uid):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default",
+                         "uid": uid, "labels": dict(labels)}}
+
+
+def _cluster(n_pods=24):
+    kube = FakeKube()
+    kube.register_kind(("", "v1", "Namespace"), namespaced=False)
+    kube.register_kind(("", "v1", "Pod"), namespaced=True)
+    kube.create({"apiVersion": "v1", "kind": "Namespace",
+                 "metadata": {"name": "default", "uid": "ns-u0"}})
+    for i in range(n_pods):
+        kube.create(_pod(f"p-{i}", {"team": "core"}, f"u{i}"))
+    return kube
+
+
+def _streaming_manager(kube, window_s=0.02, leader_check=None):
+    client = Backend(TpuDriver()).new_client([K8sValidationTarget()])
+    client.add_template(REQUIRED_LABELS_TEMPLATE)
+    client.add_constraint(TEAM_CONSTRAINT)
+    kube.apply(dict(TEAM_CONSTRAINT))
+    mgr = AuditManager(kube, client, incremental=True, interval=3600,
+                       stream_audit=True, stream_window_s=window_s,
+                       leader_check=leader_check)
+    return client, mgr
+
+
+def _start_armed(mgr, timeout=10.0):
+    """Start the manager and wait until the stream loop has armed the
+    tracker's event hooks (the manager must have swept once so the
+    tracker exists)."""
+    mgr.start()
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        tr = mgr.tracker
+        if tr is not None and tr.track_event_times \
+                and tr.on_event is not None:
+            return
+        time.sleep(0.01)
+    raise AssertionError("stream loop never armed the tracker")
+
+
+def _counter(name: str) -> float:
+    m = re.search(rf"^{re.escape(name)} ([0-9.e+-]+)$",
+                  REGISTRY.render(), re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+def _flush_collector(mgr):
+    out, cv = [], threading.Condition()
+
+    def on_flush(lat, writes):
+        with cv:
+            out.append((list(lat), dict(writes)))
+            cv.notify_all()
+
+    mgr.on_flush = on_flush
+
+    def wait(n=1, timeout=10.0):
+        with cv:
+            cv.wait_for(lambda: len(out) >= n, timeout=timeout)
+            return list(out)
+
+    return wait
+
+
+# --------------------------------------------------------- streaming audit
+
+
+def test_churn_detected_under_window_budget():
+    """The headline contract: watch event -> constraint-status PATCH in
+    ~window + one dirty-row flush, not an --audit-interval."""
+    kube = _cluster()
+    client, mgr = _streaming_manager(kube, window_s=0.02)
+    mgr.audit_once()  # bootstrap: tracker + encoded inventory
+    assert mgr.audit_once() is not None  # steady (delta) sweep
+    wait = _flush_collector(mgr)
+    base_count = _counter(
+        "gatekeeper_tpu_violation_detection_seconds_count")
+    _start_armed(mgr)
+    try:
+        kube.apply(_pod("p-3", {}, "u3"))  # drop team -> NEW violation
+        flushes = wait(1)
+        assert flushes, "no stream flush within timeout"
+        lat, writes = flushes[0]
+        # the detection clock: event receipt -> status write completed.
+        # CI-generous bound, still ~30x under even a 60s interval/2.
+        assert lat and max(lat) < 2.0
+        assert writes["status_writes"] >= 1
+        stored = kube.get(CONSTRAINT_GVK, "pods-need-team")
+        assert any(v["name"] == "p-3"
+                   for v in stored["status"]["violations"])
+        assert stored["status"]["totalViolations"] == 1
+        # the latency landed in the headline histogram
+        assert _counter(
+            "gatekeeper_tpu_violation_detection_seconds_count") \
+            >= base_count + 1
+        assert mgr.stream_stats["flushes"] >= 1
+        assert mgr.stream_stats["errors"] == 0
+    finally:
+        mgr.stop()
+
+
+def test_healthy_churn_confirms_noop_without_writes():
+    """Same-verdict churn still flushes (the confirmation IS the
+    detection) but issues zero status PATCHes."""
+    kube = _cluster()
+    client, mgr = _streaming_manager(kube)
+    mgr.audit_once()
+    mgr.audit_once()
+    wait = _flush_collector(mgr)
+    _start_armed(mgr)
+    try:
+        kube.apply(_pod("p-5", {"team": "core", "extra": "x"}, "u5"))
+        flushes = wait(1)
+        assert flushes
+        lat, writes = flushes[0]
+        assert lat  # the event was still timed
+        assert writes["status_writes"] == 0
+    finally:
+        mgr.stop()
+
+
+def test_follower_drains_without_status_writes():
+    """A follower replica's stream loop keeps the inventory current (a
+    promoted survivor must sweep fresh rows) but never writes status."""
+    kube = _cluster()
+    client, mgr = _streaming_manager(kube, leader_check=lambda: False)
+    # bootstrap as leader, then follow
+    mgr.leader_check = None
+    mgr.audit_once()
+    mgr.leader_check = lambda: False
+    _start_armed(mgr)
+    try:
+        before = kube.get(CONSTRAINT_GVK, "pods-need-team")
+        kube.apply(_pod("p-7", {}, "u7"))
+        t0 = time.monotonic()
+        while mgr.stream_stats["skipped"] == 0 \
+                and time.monotonic() - t0 < 10:
+            time.sleep(0.01)
+        assert mgr.stream_stats["skipped"] >= 1
+        assert mgr.tracker.pending_count() == 0  # drained anyway
+        after = kube.get(CONSTRAINT_GVK, "pods-need-team")
+        assert after.get("status") == before.get("status")
+    finally:
+        mgr.stop()
+
+
+def test_backstop_sweep_repairs_and_reports_drift():
+    """With streaming keeping statuses current, any PATCH the interval
+    sweep has to issue is drift — here an external status clobber. The
+    sweep must repair it AND count it."""
+    kube = _cluster()
+    client, mgr = _streaming_manager(kube)
+    mgr.audit_once()
+    kube.apply(_pod("p-2", {}, "u2"))  # one standing violation
+    mgr.audit_once()
+    wait = _flush_collector(mgr)
+    _start_armed(mgr)
+    try:
+        # clobber the published status behind the manager's back
+        stored = kube.get(CONSTRAINT_GVK, "pods-need-team")
+        clobbered = json.loads(json.dumps(stored))
+        clobbered["status"]["violations"] = []
+        clobbered["status"]["totalViolations"] = 0
+        kube.apply(clobbered)
+        drift0 = _counter("gatekeeper_tpu_audit_backstop_drift_total")
+        mgr.audit_once()  # the reconciliation backstop
+        stored = kube.get(CONSTRAINT_GVK, "pods-need-team")
+        assert any(v["name"] == "p-2"
+                   for v in stored["status"]["violations"])
+        assert _counter("gatekeeper_tpu_audit_backstop_drift_total") \
+            >= drift0 + 1
+    finally:
+        mgr.stop()
+
+
+def test_stream_flush_error_is_counted_and_backstop_recovers():
+    kube = _cluster()
+    client, mgr = _streaming_manager(kube)
+    mgr.audit_once()
+    mgr.audit_once()
+    _start_armed(mgr)
+    try:
+        real_audit = client.audit
+        client.audit = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("injected eval failure"))
+        kube.apply(_pod("p-9", {}, "u9"))
+        t0 = time.monotonic()
+        while mgr.stream_stats["errors"] == 0 \
+                and time.monotonic() - t0 < 10:
+            time.sleep(0.01)
+        assert mgr.stream_stats["errors"] >= 1
+        client.audit = real_audit
+        mgr.audit_once()  # backstop reconciles what the flush missed
+        stored = kube.get(CONSTRAINT_GVK, "pods-need-team")
+        assert any(v["name"] == "p-9"
+                   for v in stored["status"]["violations"])
+    finally:
+        mgr.stop()
+
+
+def test_stream_flush_lists_only_changed_kinds():
+    """Per-flush status-write cost is O(changed constraints), not
+    O(all constraints): a no-op flush issues ZERO constraint list
+    calls and a real change lists only the kind whose violation set
+    moved (the backstop sweep still passes over everything)."""
+    kube = _cluster()
+    client, mgr = _streaming_manager(kube)
+    other = json.loads(json.dumps(REQUIRED_LABELS_TEMPLATE))
+    other["spec"]["crd"]["spec"]["names"]["kind"] = "K8sOtherLabels"
+    other["metadata"]["name"] = "k8sotherlabels"
+    client.add_template(other)
+    wait = _flush_collector(mgr)
+    _start_armed(mgr)
+    try:
+        mgr.audit_once()  # establishes the fingerprint baseline
+        lists = []
+        orig = kube.list
+
+        def spy(gvk, *a, **k):
+            if gvk[0] == "constraints.gatekeeper.sh":
+                lists.append(gvk[2])
+            return orig(gvk, *a, **k)
+
+        kube.list = spy
+        # label churn that stays compliant: no violation set moves
+        kube.apply(_pod("p-0", {"team": "core", "extra": "1"}, "u0"))
+        wait(1)
+        assert lists == [], lists
+        # a real violation moves exactly one kind
+        kube.apply(_pod("p-bad", {}, "u-bad"))
+        wait(2)
+        assert set(lists) == {"K8sRequiredLabels"}, lists
+        stored = kube.get(CONSTRAINT_GVK, "pods-need-team")
+        assert any(v["name"] == "p-bad"
+                   for v in stored["status"]["violations"])
+    finally:
+        kube.list = orig
+        mgr.stop()
+
+
+# ------------------------------------------------------- what-if preview
+
+
+def _mixed_client(n=3000):
+    import bench_configs
+
+    driver = TpuDriver()
+    client = Backend(driver).new_client([K8sValidationTarget()])
+    from gatekeeper_tpu import policies
+    for name in policies.names():
+        if name.startswith("general/"):
+            client.add_template(policies.load(name))
+    for o in bench_configs.synth_mixed_objects(n):
+        client.add_data(o)
+    return driver, client
+
+
+def test_preview_device_matches_interpreter_on_general_library():
+    """The differential: every general-library candidate swept through
+    audit_kind's DEVICE path must produce the interpreter oracle's
+    violation set exactly."""
+    import bench_configs
+
+    driver, client = _mixed_client()
+    driver._use_device_for_batch = lambda n: True  # force the device
+    pv = PreviewEngine(client)
+
+    def key(results):
+        return sorted(
+            (r.msg, (r.resource or {}).get("kind") or "",
+             ((r.resource or {}).get("metadata") or {})
+             .get("namespace") or "",
+             ((r.resource or {}).get("metadata") or {})
+             .get("name") or "")
+            for r in results)
+
+    checked = 0
+    for kind, cname, params in bench_configs.GENERAL_CONSTRAINTS:
+        con = {"kind": kind, "metadata": {"name": cname},
+               "spec": ({"parameters": params} if params else {})}
+        ent, _ = pv._ensure_template(None, kind)
+        alias_con = dict(con, kind=ent["alias"],
+                         apiVersion="constraints.gatekeeper.sh/v1beta1")
+        device, path = driver.audit_kind(
+            next(iter(client.targets)), ent["alias"], [alias_con])
+        oracle = pv._interp_eval(ent["alias"], [alias_con])
+        assert key(device) == key(oracle), \
+            f"{kind}: device/{path} diverges from interpreter"
+        checked += 1
+        # the public entry agrees on the count
+        out = pv.preview({"constraint": dict(
+            con, apiVersion="constraints.gatekeeper.sh/v1beta1")})
+        assert out["violations"] == len(oracle)
+    assert checked == len(bench_configs.GENERAL_CONSTRAINTS)
+
+
+def test_preview_isolates_serving_library():
+    """Compiling + sweeping a candidate must not bump the client
+    generation (decision-cache invalidation) or touch the serving
+    kind's caches."""
+    driver, client = _mixed_client(200)
+    pv = PreviewEngine(client)
+    gen0 = client.generation
+    kinds0 = set(client.template_kinds())
+    out = pv.preview({"constraint": {
+        "kind": "K8sRequiredLabels", "metadata": {"name": "w"},
+        "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Pod"]}]},
+                 "parameters": {"labels": [{"key": "owner"}]}}}})
+    assert out["reviewed"] > 0
+    assert client.generation == gen0
+    assert set(client.template_kinds()) == kinds0
+    # repeat previews of identical content hit the compiled alias
+    out2 = pv.preview({"constraint": {
+        "kind": "K8sRequiredLabels", "metadata": {"name": "w"},
+        "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Pod"]}]},
+                 "parameters": {"labels": [{"key": "owner"}]}}}})
+    assert out2["cold"] is False
+
+
+def test_preview_lru_eviction_recompiles_evicted_candidate():
+    """Pushing a candidate out of the compiled-alias LRU deletes its
+    modules; a later preview of the same content must recompile cold
+    and still produce the full violation set (previews serialize on
+    _eval_lock, so eviction can never race an in-flight sweep)."""
+    driver, client = _mixed_client(100)
+    pv = PreviewEngine(client)
+    pv.MAX_COMPILED = 1
+
+    def candidate(kind):
+        tpl = json.loads(json.dumps(REQUIRED_LABELS_TEMPLATE))
+        tpl["spec"]["crd"]["spec"]["names"]["kind"] = kind
+        tpl["metadata"]["name"] = kind.lower()
+        return {"template": tpl,
+                "constraint": {"kind": kind, "metadata": {"name": "w"},
+                               "spec": {"parameters": {"labels": [
+                                   {"key": "no-such-label"}]}}}}
+
+    first = pv.preview(candidate("K8sEvictA"))
+    assert first["cold"] is True and first["violations"] > 0
+    pv.preview(candidate("K8sEvictB"))  # evicts K8sEvictA
+    assert len(pv._compiled) == 1
+    again = pv.preview(candidate("K8sEvictA"))
+    assert again["cold"] is True  # recompiled, not a stale hit
+    assert again["violations"] == first["violations"]
+
+
+def test_preview_candidate_template_and_errors():
+    """A not-yet-installed template rides the request; caller mistakes
+    are PreviewErrors (HTTP 400), never 500s."""
+    driver, client = _mixed_client(100)
+    pv = PreviewEngine(client)
+    candidate = json.loads(json.dumps(REQUIRED_LABELS_TEMPLATE))
+    candidate["spec"]["crd"]["spec"]["names"]["kind"] = "K8sNovelKind"
+    candidate["metadata"]["name"] = "k8snovelkind"
+    out = pv.preview({
+        "template": candidate,
+        "constraint": {"kind": "K8sNovelKind",
+                       "metadata": {"name": "novel"},
+                       "spec": {"parameters": {"labels": [
+                           {"key": "nonexistent-label"}]}}}})
+    assert out["kind"] == "K8sNovelKind" and out["violations"] > 0
+    assert "K8sNovelKind" not in client.template_kinds()
+    with pytest.raises(PreviewError):
+        pv.preview({})  # no constraint
+    with pytest.raises(PreviewError):
+        pv.preview({"constraint": {"kind": "NoSuchTemplateKind",
+                                   "metadata": {"name": "x"}}})
+    with pytest.raises(PreviewError):
+        pv.preview({"constraint": {
+            "kind": "K8sRequiredLabels", "metadata": {"name": "x"},
+            "spec": {"enforcementAction": "bogus"}}})
+    # transport layer: 400 with an error body, 200 with a verdict
+    status, body = pv.handle_http(b"{not json")
+    assert status == 400
+    status, body = pv.handle_http(json.dumps({
+        "constraint": {"kind": "K8sRequiredLabels",
+                       "metadata": {"name": "w"},
+                       "spec": {"parameters": {"labels": [
+                           {"key": "team"}]}}}}).encode())
+    assert status == 200
+    assert json.loads(body)["reviewed"] >= 0
+
+
+def test_preview_served_on_dedicated_listener():
+    """The --preview-port topology: a WebhookServer with ONLY the
+    preview engine 404s admission routes and answers /v1/preview."""
+    import http.client
+
+    driver, client = _mixed_client(100)
+    server = WebhookServer(None, None, port=0,
+                           preview=PreviewEngine(client))
+    server.start()
+    try:
+        conn = http.client.HTTPConnection("localhost", server.port,
+                                          timeout=30)
+        conn.request("POST", "/v1/admit", body=b"{}")
+        resp = conn.getresponse()
+        assert resp.status == 404
+        resp.read()
+        conn.request("POST", "/v1/preview", body=json.dumps({
+            "constraint": {"kind": "K8sRequiredLabels",
+                           "metadata": {"name": "w"},
+                           "spec": {"parameters": {"labels": [
+                               {"key": "team"}]}}}}).encode())
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["reviewed"] >= 0
+        conn.close()
+    finally:
+        server.stop(drain_timeout=1.0)
+
+
+def test_preview_over_backplane_frontend():
+    """The --admission-workers topology: a frontend forwards
+    /v1/preview over the backplane; the engine serves it on the
+    dedicated single-thread preview executor while /v1/admit keeps its
+    own pool. Admission routes still answer alongside."""
+    import http.client
+
+    from gatekeeper_tpu.control.backplane import (
+        BackplaneClient,
+        BackplaneEngine,
+        FrontendServer,
+        default_socket_path,
+    )
+
+    driver, client = _mixed_client(100)
+    client.add_template(REQUIRED_LABELS_TEMPLATE)
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "must-team"},
+        "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Pod"]}]},
+                 "parameters": {"labels": [{"key": "team"}]}}})
+    validation = ValidationHandler(
+        client, kube=None, batcher=MicroBatcher(client, max_wait=0.001))
+    sock = default_socket_path() + ".pv"
+    engine = BackplaneEngine(sock, validation=validation,
+                             preview=PreviewEngine(client))
+    engine.start()
+    bc = BackplaneClient(sock, worker_id="test")
+    fe = FrontendServer(bc, port=0, addr="127.0.0.1",
+                        serve=("admit", "admitlabel", "preview"))
+    fe.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=60)
+        conn.request("POST", "/v1/preview", json.dumps({
+            "constraint": {"kind": "K8sRequiredLabels",
+                           "metadata": {"name": "w"},
+                           "spec": {"parameters": {"labels": [
+                               {"key": "owner"}]}}}}).encode())
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200, body
+        assert json.loads(body)["reviewed"] > 0
+        # admission still answers on the same connection
+        conn.request("POST", "/v1/admit", json.dumps(
+            _admission_review(_pod("p-a", {"team": "t"},
+                                   "uid-a"))).encode())
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert out["response"]["allowed"] is True
+        conn.close()
+    finally:
+        fe.stop(drain_timeout=1.0)
+        engine.stop(drain_timeout=1.0)
+
+
+# -------------------------------------------- enforcementAction parity
+
+
+def _admission_review(obj):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": "u-1",
+                        "kind": {"group": "", "version": "v1",
+                                 "kind": obj["kind"]},
+                        "operation": "CREATE",
+                        "name": obj["metadata"]["name"],
+                        "namespace": obj["metadata"].get("namespace"),
+                        "object": obj}}
+
+
+def _action_client(actions):
+    client = Backend(TpuDriver()).new_client([K8sValidationTarget()])
+    client.add_template(REQUIRED_LABELS_TEMPLATE)
+    for i, action in enumerate(actions):
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": "K8sRequiredLabels",
+            "metadata": {"name": f"labels-{action}-{i}"},
+            "spec": {"enforcementAction": action,
+                     "match": {"kinds": [{"apiGroups": [""],
+                                          "kinds": ["Pod"]}]},
+                     "parameters": {"labels": [{"key": action}]}},
+        })
+    return client
+
+
+@pytest.mark.parametrize("action,allowed,warned", [
+    ("deny", False, False),
+    ("dryrun", True, False),
+    ("warn", True, True),
+])
+def test_enforcement_action_parity(action, allowed, warned):
+    client = _action_client([action])
+    handler = ValidationHandler(client,
+                                batcher=MicroBatcher(client))
+    out = handler.handle(_admission_review(
+        _pod("p-x", {}, "uid-x")))
+    resp = out["response"]
+    assert resp["allowed"] is allowed
+    if warned:
+        assert resp["warnings"] and "warn" in resp["warnings"][0]
+    else:
+        assert "warnings" not in resp
+
+
+def test_warn_rides_alongside_deny_and_dryrun():
+    """A deny verdict still carries the warn constraint's warning; the
+    dryrun one stays invisible either way."""
+    client = _action_client(["deny", "dryrun", "warn"])
+    handler = ValidationHandler(client, batcher=MicroBatcher(client))
+    out = handler.handle(_admission_review(_pod("p-y", {}, "uid-y")))
+    resp = out["response"]
+    assert resp["allowed"] is False
+    assert len(resp["warnings"]) == 1
+    assert "warn" in resp["warnings"][0]
+    assert "dryrun" not in resp["status"]["reason"]
+    # satisfying the warn+deny labels clears both
+    ok = handler.handle(_admission_review(
+        _pod("p-z", {"deny": "1", "warn": "1"}, "uid-z")))
+    assert ok["response"]["allowed"] is True
+    assert "warnings" not in ok["response"]
+
+
+# ------------------------------------------------- bench skip records
+
+
+def test_config5_sweeps_always_carry_a_record():
+    import bench_configs as bc
+
+    # single-core host, not forced: an explicit skip reason
+    rec = bc.c5_skip_record([1, 2], cores=1, forced=False,
+                            env_key="BENCH_C5_WORKERS", what="frontends")
+    assert rec and "1 host core" in rec["skipped"]
+    # forced by env: runs even on one core
+    assert bc.c5_skip_record([1, 2], cores=1, forced=True,
+                             env_key="BENCH_C5_WORKERS",
+                             what="frontends") is None
+    # empty count list: explicit, names the env var
+    rec = bc.c5_skip_record([], cores=8, forced=True,
+                            env_key="BENCH_C5_WORKERS", what="frontends")
+    assert "BENCH_C5_WORKERS" in rec["skipped"]
+    # multi-core unforced: runs
+    assert bc.c5_skip_record([1], cores=8, forced=False,
+                             env_key="BENCH_C5_WORKERS",
+                             what="frontends") is None
+    # the headline backstop: an empty sweep list can never reach the
+    # JSON as a silent []
+    out = bc.sweep_or_skip([], "multi_worker_sweep")
+    assert out and "skipped" in out[0]
+    kept = [{"workers": 1}]
+    assert bc.sweep_or_skip(kept, "multi_worker_sweep") is kept
