@@ -214,7 +214,7 @@ def main() -> None:
         proc = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(
                 os.path.abspath(__file__)), "bench_configs.py"),
-             "1", "2", "3", "5", "6", "7", "9", "10", "11"],
+             "1", "2", "3", "5", "6", "7", "9", "10", "11", "12"],
             capture_output=True, text=True, env=env,
             timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 2700)))
         for line in proc.stdout.splitlines():
@@ -293,6 +293,14 @@ def main() -> None:
             (configs.get("11") or {}).get("detection_speedup_p99"),
         "whatif_preview_s":
             (configs.get("11") or {}).get("whatif_preview_s"),
+        # compiler-widening headline (config 12): the shipped general
+        # library's device-compiled fraction (1.0 = no kind audits at
+        # interpreter speed) and the best interpreter-vs-device audit
+        # speedup on the extended-form corpus the widening unlocked
+        "general_library_compiled_fraction":
+            (configs.get("12") or {}).get(
+                "general_library_compiled_fraction"),
+        "compile_widening_speedup": (configs.get("12") or {}).get("value"),
         # multichip headline (config 10): default mesh-sharded audit at
         # 1M+ objects vs the forced single-device path
         "mesh_audit_s": (configs.get("10") or {}).get("value"),

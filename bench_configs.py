@@ -33,6 +33,12 @@ One JSON line per config:
      mesh-sharded audit path vs the forced single-device path, each in
      a fresh subprocess (on a 1-device host the mesh run forces 8
      host-platform devices so the slab pipeline is exercised)
+  #12 compiler-widening speedup: per-kind steady audit latency on the
+     extended-form corpus (upstream-canonical Rego shapes that were
+     interpreter-bound before the PR 10 widening), interpreter driver
+     vs the newly device-compiled path, plus the shipped general
+     library's device coverage (general_library_compiled_fraction
+     must read 1.0)
 
 All audits run steady-state through client.audit() (warm caches), same
 contract as bench.py. Run: python bench_configs.py [1 2 3 5 6 7 8 9]
@@ -1820,10 +1826,209 @@ def config11():
     }))
 
 
+# ----------------------------------------- config 12: compiler widening
+
+
+def _xtemplate(kind: str, rego: str) -> dict:
+    return {"apiVersion": "templates.gatekeeper.sh/v1beta1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": kind.lower()},
+            "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                     "targets": [{"target": TARGET, "rego": rego}]}}
+
+
+# Upstream-canonical Rego forms the PR 10 compiler widening brought onto
+# the device path — before it, every one of these kinds audited on the
+# interpreter (the `Uncompilable` wall each one used to hit is noted).
+# Shared with tests/test_compile_coverage.py, which differential-tests
+# each against the interpreter driver.
+EXTENDED_FORM_TEMPLATES = [
+    # param key-set comprehension (was: "param key-set comprehension")
+    ("XRequiredLabelKeys", _xtemplate("XRequiredLabelKeys", """
+package xrequiredlabelkeys
+
+violation[{"msg": msg}] {
+  provided := {k | input.review.object.metadata.labels[k]}
+  required := {k | input.parameters.labels[k]}
+  missing := required - provided
+  count(missing) > 0
+  msg := sprintf("missing required label keys: %v", [missing])
+}
+"""), {"labels": {"owner": "", "app": "", "team": ""}}),
+    # non-var comprehension head + computed set membership
+    # (was: "unbound var c" / "unsupported set bracket")
+    ("XBannedImages", _xtemplate("XBannedImages", """
+package xbannedimages
+
+violation[{"msg": msg}] {
+  images := {c.image | c := input.review.object.spec.containers[_]}
+  images[input.parameters.banned]
+  msg := sprintf("banned image <%v> in use", [input.parameters.banned])
+}
+"""), {"banned": "docker.io/evil7:latest"}),
+    # multi-literal filter body over the generator element + lower()
+    # derived column (was: "unbound base var c" / "unsupported call lower")
+    ("XRootfulPrefixed", _xtemplate("XRootfulPrefixed", """
+package xrootfulprefixed
+
+violation[{"msg": msg}] {
+  bad := {c.name | c := input.review.object.spec.containers[_]; startswith(lower(c.image), input.parameters.prefix); not c.securityContext.runAsNonRoot}
+  count(bad) > 0
+  msg := sprintf("containers from <%v> must set runAsNonRoot: %v", [input.parameters.prefix, bad])
+}
+"""), {"prefix": "docker.io/"}),
+    # `some`-decls + 2-arg identical(obj, review) canonical join body
+    # (was: "join: some-decl")
+    ("XUniqueIngressHostCanon", _xtemplate("XUniqueIngressHostCanon", """
+package xuniqueingresshostcanon
+
+identical(obj, review) {
+  obj.metadata.namespace == review.object.metadata.namespace
+  obj.metadata.name == review.object.metadata.name
+}
+
+violation[{"msg": msg}] {
+  input.review.kind.kind == "Ingress"
+  re_match("^(extensions|networking.k8s.io)$", input.review.kind.group)
+  some ns, apiv, name
+  host := input.review.object.spec.rules[_].host
+  other := data.inventory.namespace[ns][apiv]["Ingress"][name]
+  re_match("^(extensions|networking.k8s.io)/.+$", apiv)
+  other.spec.rules[_].host == host
+  not identical(other, input.review)
+  msg := sprintf("ingress host conflicts with an existing ingress <%v>", [host])
+}
+"""), None),
+    # inline inventory generator + inline self-exclusion disequality
+    # (was: "join: generator must bind a var" / "unsupported mixed
+    # literal")
+    ("XUniqueSelectorInline", _xtemplate("XUniqueSelectorInline", """
+package xuniqueselectorinline
+
+violation[{"msg": msg}] {
+  input.review.kind.kind == "Service"
+  sel := input.review.object.spec.selector
+  data.inventory.namespace[ns][_]["Service"][name].spec.selector == sel
+  name != input.review.object.metadata.name
+  msg := sprintf("same selector as service <%v>", [name])
+}
+"""), None),
+]
+
+
+def config12():
+    """Per-kind audit latency, interpreter vs the newly device-compiled
+    path, for the extended-form corpus (kinds that PR 10's compiler
+    widening moved off the interpreter). Dense kinds run at config-6
+    inventory scale; the cross-object join kinds run both sides at a
+    reduced N the interpreter's O(N*M) rescan can finish at all —
+    speedups are apples-to-apples at each kind's own N. Also reports
+    the shipped general library's device coverage (the
+    `general_library_compiled_fraction` headline: must read 1.0)."""
+    from gatekeeper_tpu import policies
+    from gatekeeper_tpu.client import Backend, RegoDriver
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    n_dense = int(50_000 * SCALE)  # config-6 inventory scale
+    n_join = int(4_000 * SCALE)
+    objs_dense = synth_mixed_objects(n_dense, seed=12)
+    for i, o in enumerate(objs_dense):
+        if i % 50:  # ~2% violating tail: keep materialization sparse
+            o["metadata"]["labels"]["team"] = "core"
+    # join-kind inventory: mostly-unique hosts/selectors with a ~2%
+    # colliding tail, so the cross-object filter does real work but the
+    # exact-message materialization (same cost on both sides) stays off
+    # the critical path
+    objs_join = []
+    for i in range(n_join):
+        if i % 2:
+            host = (f"dup{i % 10}.corp.example" if i % 50 == 1
+                    else f"h{i}.corp.example")
+            objs_join.append({
+                "apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+                "metadata": {"name": f"ing-{i}", "namespace": f"ns{i % 9}"},
+                "spec": {"rules": [{"host": host}]}})
+        else:
+            sel = ({"app": f"dupapp{i % 10}"} if i % 50 == 0
+                   else {"app": f"app{i}"})
+            objs_join.append({
+                "apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": f"svc-{i}", "namespace": f"ns{i % 9}"},
+                "spec": {"selector": sel}})
+
+    # shipped-library coverage: the ratcheted headline numbers
+    drv, client = new_client()
+    for name in policies.names():
+        if name.startswith("general/"):
+            client.add_template(policies.load(name))
+    cov = compiled_coverage(drv, client)
+
+    per_kind = {}
+    best = 0.0
+    for kind, tmpl, params in EXTENDED_FORM_TEMPLATES:
+        is_join = kind.startswith("XUnique")
+        objs = objs_join if is_join else objs_dense
+        con = {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+               "kind": kind, "metadata": {"name": kind.lower()},
+               "spec": ({"parameters": params} if params else {})}
+        row = {"objects": len(objs), "path": None}
+        for side in ("interpreter", "device"):
+            drv2 = RegoDriver() if side == "interpreter" else None
+            if drv2 is None:
+                drv2, client2 = new_client()
+            else:
+                client2 = Backend(drv2).new_client([K8sValidationTarget()])
+            client2.add_template(tmpl)
+            client2.add_constraint(con)
+            for o in objs:
+                client2.add_data(o)
+            client2.audit()  # warm-up (device: background XLA compile)
+            if side == "device":
+                t0 = time.time()
+                while hasattr(drv2, "warm_status") and \
+                        drv2.warm_status()["compiling"] and \
+                        time.time() - t0 < 600:
+                    time.sleep(0.2)
+                assert drv2.compiled_for(kind) is not None or \
+                    drv2.join_for(kind) is not None, \
+                    f"{kind} fell back: {drv2.fallback_reasons()}"
+                row["path"] = "join" if drv2.join_for(kind) else "device"
+            best_s = float("inf")
+            for _ in range(2):
+                # measure the full per-kind sweep, not PR 1's unchanged-
+                # rows delta shortcut (which answers from cache in ~0s)
+                if hasattr(drv2, "_audit_results_cache"):
+                    drv2._audit_results_cache.clear()
+                t0 = time.time()
+                nres = len(client2.audit().results())
+                best_s = min(best_s, time.time() - t0)
+            row[f"{side}_audit_s"] = round(best_s, 4)
+            row[f"{side}_violations"] = nres
+        assert row["interpreter_violations"] == row["device_violations"], \
+            f"{kind}: verdict count diverged"
+        row["speedup"] = round(
+            row["interpreter_audit_s"] / max(row["device_audit_s"], 1e-9), 1)
+        best = max(best, row["speedup"])
+        per_kind[kind] = row
+
+    print(json.dumps({
+        "config": 12, "metric": "compile_widening_speedup",
+        "value": best,
+        "unit": ("x (best per-kind steady audit speedup, interpreter vs "
+                 "newly device-compiled path, extended-form corpus; "
+                 f"dense kinds at {n_dense} objects, join kinds at "
+                 f"{n_join})"),
+        "general_library_compiled_fraction":
+            cov["device_compiled_fraction"],
+        "general_library_interpreter_kinds": cov["interpreter_kinds"],
+        "per_kind": per_kind,
+    }))
+
+
 def run(which: list[int]) -> None:
     table = {1: config1, 2: config2, 3: config3, 5: config5, 6: config6,
              7: config7, 8: config8, 9: config9, 10: config10,
-             11: config11}
+             11: config11, 12: config12}
     for c in which:
         if c not in table:
             sys.exit(f"unknown bench config {c}: choose from "

@@ -658,6 +658,18 @@ def report_last_sync(ts: Optional[float] = None) -> None:
                        ts if ts is not None else time.time())
 
 
+def report_compile_fallback(kind: str, reason: str) -> None:
+    """One template kind falling back to the interpreter at ingestion,
+    labeled by the stable Uncompilable reason CODE (ir/compile.py
+    REASON_CODES — a bounded set, never free prose). Operators read
+    this next to /debug/templates' per-kind fallback detail to see WHY
+    a kind audits at Python speed instead of the device path."""
+    REGISTRY.counter_add("gatekeeper_tpu_compile_fallback_total",
+                         "Template kinds that fell back to the "
+                         "interpreter at ingestion, by Uncompilable "
+                         "reason code", kind=kind, reason=reason)
+
+
 def report_device_demotion(kind: str, reason: str) -> None:
     REGISTRY.counter_add("gatekeeper_tpu_device_demotions_total",
                          "Templates demoted from the device path to the "

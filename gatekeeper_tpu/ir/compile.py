@@ -16,17 +16,26 @@ Supported subset (grown corpus-first, SURVEY.md §7 P0):
     path segments indexed by const-bound vars (`spec[field][_]`)
   * local partial-set rules (`input_containers`) and path-valued helper
     functions, flattened by ir/specialize.py before compilation
-  * set comprehensions (multi-literal bodies with filters, key-sets,
-    const-head existence sets), set difference/intersection, membership,
-    and count comparisons that reduce to emptiness tests
+  * set comprehensions (multi-literal filter bodies over the generator
+    element, non-var heads via binding introduction, object AND
+    parameter key-sets, const-head existence sets), set
+    difference/intersection, membership against constants or computed
+    values, and count comparisons that reduce to emptiness tests
   * string predicates startswith/endswith/contains/re_match/glob with
     patterns from parameters or constants (match-table rows), including
     pattern transforms (trim) applied at encode time
-  * pure unary helper functions (canonify_cpu/mem) as vocab-indexed
-    derived columns, and binary string helpers (path_matches) as
+  * pure unary helper functions (canonify_cpu/mem) and unary builtins
+    (to_number/lower/upper/trim_space) as vocab-indexed derived
+    columns, and binary string helpers (path_matches) as
     interpreter-backed match-table rows (ops/derived.py)
+  * pure builtins over all-constant arguments folded at compile time
+    (concat/sprintf/... — computed bracket keys reduce to static paths)
   * boolean/value helper functions inlined with constant-formal
     unification; `not` with locally-bound axes reduced inside the negation
+
+Anything outside raises Uncompilable(code, detail) with a code from the
+stable bounded REASON_CODES taxonomy; the driver records it, /debug/
+templates and gatekeeper_tpu_compile_fallback_total{reason} surface it.
 """
 
 from __future__ import annotations
@@ -75,13 +84,71 @@ _PATTERN_TRANSFORMS = {"trim": "trim", "lower": "lower", "upper": "upper",
 _CMP_OPS = {"==": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt",
             ">=": "ge"}
 _ARITH_OPS = {"+": "add", "-": "sub", "*": "mul"}
-_BUILTIN_DERIVED = {"to_number"}
+# unary builtins lowered to vocab-indexed derived columns (ops/derived.py
+# builtin_unary): evaluated once per interned vocab entry on the host,
+# a single gather inside the [N, C] sweep
+_BUILTIN_DERIVED = {"to_number", "lower", "upper", "trim_space"}
+# pure builtins folded at compile time when every argument is constant
+# (computed bracket keys like concat("/", ["apps", "v1"]) reduce to the
+# static-field path the walker already handles)
+_CONST_FOLDABLE = {"concat", "sprintf", "lower", "upper", "trim",
+                   "trim_space", "trim_prefix", "trim_suffix", "replace",
+                   "to_number", "format_int"}
+_NOFOLD = object()
 _MAX_INLINE_DEPTH = 8
 _MAX_SLOT_AXES = 2
 
 
+# Stable fallback-reason taxonomy. The metric
+# `gatekeeper_tpu_compile_fallback_total{reason}` labels on these codes
+# (bounded label set) and tests assert on codes, not prose — the detail
+# string is free to change, the codes are an interface.
+REASON_CODES = frozenset({
+    # dense (elementwise) compiler
+    "rule-shape",     # violation rule missing / not a partial set
+    "axes",           # axis scoping: nesting depth, reduce-in-scope, keys
+    "with-modifier",  # `with` is not vectorizable
+    "binding",        # unsupported binding / destructure pattern
+    "call",           # builtin or helper call outside the subset
+    "unbound-var",    # reference to a var the compiler never bound
+    "input-root",     # input.* path outside review/parameters
+    "path",           # ref/bracket shape the path walker can't follow
+    "set-op",         # set bracket/difference/intersection misuse
+    "const",          # non-scalar constant
+    "comprehension",  # comprehension form outside the subset
+    "guard",          # guard/comparison expression outside the subset
+    "count",          # count() misuse (incl. non-emptiness set counts)
+    "pattern",        # match pattern not from parameters/constants
+    "helper",         # helper function inlining failed
+    "module-shape",   # template lib/entry module merge failed (driver)
+    # inventory-join compiler
+    "join-input",     # input reference outside input.review
+    "join-generator", # inventory generator missing or malformed
+    "join-with",      # `with` inside a join clause
+    "join-identity",  # identity (not identical(...)) fn outside the shape
+    "join-data",      # data read outside the inventory generator
+    "join-mixed",     # mixed inv/rev literal that is not a join equality
+    "join-shape",     # violation clause not recognizable as a join
+    "internal",       # taxonomy drift guard — never raised deliberately
+})
+
+
 class Uncompilable(Exception):
-    pass
+    """A template (or clause) outside the device-compilable subset.
+
+    `code` is one of REASON_CODES; `detail` carries the site-specific
+    prose. str() renders "code: detail" — operators see both, metrics
+    and tests key on the code alone."""
+
+    def __init__(self, code: str, detail: str = ""):
+        if code not in REASON_CODES:
+            # taxonomy drift must not crash the compile path (the caller
+            # treats Uncompilable as a routine fallback signal) — fold
+            # the stray code into the detail under a stable label
+            code, detail = "internal", f"{code}: {detail}" if detail else code
+        self.code = code
+        self.detail = detail
+        super().__init__(f"{code}: {detail}" if detail else code)
 
 
 # ---------------------------------------------------------------- symbolics
@@ -236,7 +303,7 @@ def compile_template(module: A.Module, kind: str) -> Program:
     ctx = _Ctx(module, kind)
     vio = ctx.rules.get("violation")
     if not vio:
-        raise Uncompilable("no violation rule")
+        raise Uncompilable("rule-shape", "no violation rule")
     clauses = []
     for rule in vio:
         clause = _compile_clause(ctx, rule)
@@ -264,7 +331,7 @@ def _check_no_nested_axis(e: Expr, active: set) -> None:
     collapse to a size-1 reduce — reject (sibling reuse is fine)."""
     if isinstance(e, (OrReduce, SumReduce)):
         if e.axis in active:
-            raise Uncompilable(f"axis {e.axis} reduced within its own scope")
+            raise Uncompilable("axes", f"axis {e.axis} reduced within its own scope")
         _check_no_nested_axis(e.e, active | {e.axis})
     elif isinstance(e, (And, Or)):
         for x in e.items:
@@ -353,7 +420,7 @@ def _needed_vars(rule: A.Rule) -> set:
 
 def _compile_clause(ctx: _Ctx, rule: A.Rule) -> Clause:
     if rule.kind != "partial_set":
-        raise Uncompilable("violation must be a partial-set rule")
+        raise Uncompilable("rule-shape", "violation must be a partial-set rule")
     comp = _ClauseCompiler(ctx, _needed_vars(rule))
     for lit in rule.body:
         comp.literal(lit)
@@ -377,7 +444,7 @@ class _ClauseCompiler:
 
     def literal(self, lit: A.Literal) -> None:
         if lit.withs:
-            raise Uncompilable("with modifiers are not vectorizable")
+            raise Uncompilable("with-modifier", "with modifiers are not vectorizable")
         e = lit.expr
         if isinstance(e, A.SomeDecl):
             return
@@ -388,7 +455,7 @@ class _ClauseCompiler:
                 return  # head-only binding: host materializes
             self.env[name] = self.bind_rhs(e.rhs)
             if self.pending_scopes:
-                raise Uncompilable("set iteration in binding position")
+                raise Uncompilable("binding", "set iteration in binding position")
             return
         if not lit.negated and isinstance(e, (A.Assign, A.Unify)) and \
                 isinstance(e.lhs, A.ArrayLit) and isinstance(e.rhs, A.Call) \
@@ -396,7 +463,7 @@ class _ClauseCompiler:
             self.split_destructure(e.lhs, e.rhs)
             return
         if not lit.negated and isinstance(e, (A.Assign, A.Unify)):
-            raise Uncompilable(f"unsupported binding pattern {e!r}")
+            raise Uncompilable("binding", f"unsupported binding pattern {e!r}")
         # guard literal
         new_axes_start = len(self.clause_axes)
         expr = self.bool_expr(e)
@@ -423,14 +490,14 @@ class _ClauseCompiler:
         is undefined unless the split yields exactly len(lhs) parts."""
         if len(call.args) != 2 or not isinstance(call.args[1], A.Scalar) \
                 or not isinstance(call.args[1].value, str):
-            raise Uncompilable("split destructure needs a constant separator")
+            raise Uncompilable("binding", "split destructure needs a constant separator")
         sep = call.args[1].value
         base = self.value_expr(self.to_symbolic(call.args[0]))
         k = len(lhs.items)
         col0 = None
         for i, v in enumerate(lhs.items):
             if not isinstance(v, A.Var):
-                raise Uncompilable("split destructure into non-vars")
+                raise Uncompilable("binding", "split destructure into non-vars")
             col = self.ctx.derived_col("split", f"{sep}|{i}|{k}")
             if i == 0:
                 col0 = col
@@ -465,15 +532,56 @@ class _ClauseCompiler:
             if t.op in _ARITH_OPS:
                 return SExpr(Arith(_ARITH_OPS[t.op], self.num_expr(l),
                                    self.num_expr(r)))
-            raise Uncompilable(f"unsupported binary op {t.op} in binding")
+            raise Uncompilable("binding", f"unsupported binary op {t.op} in binding")
         if isinstance(t, A.Call):
             if tuple(t.fn) == ("count",):
                 return self.count_symbolic(t.args[0])
             return self.call_value(t)
-        raise Uncompilable(f"unsupported binding rhs {type(t).__name__}")
+        raise Uncompilable("binding", f"unsupported binding rhs {type(t).__name__}")
+
+    def _const_term(self, a) -> Any:
+        """The constant value of a term, or _NOFOLD."""
+        if isinstance(a, A.Scalar):
+            return a.value
+        if isinstance(a, A.ArrayLit):
+            items = [self._const_term(x) for x in a.items]
+            return _NOFOLD if any(x is _NOFOLD for x in items) \
+                else tuple(items)
+        if isinstance(a, A.Var):
+            bound = self.env.get(a.name)
+            if isinstance(bound, SConst) and not isinstance(
+                    bound.value, tuple):
+                return bound.value
+        return _NOFOLD
+
+    def _const_fold(self, t: A.Call) -> Optional[SConst]:
+        """Evaluate a pure builtin over all-constant arguments at compile
+        time (via the exact host builtin, so folding can never diverge
+        from the interpreter)."""
+        fn = tuple(t.fn)
+        if len(fn) != 1 or fn[0] not in _CONST_FOLDABLE:
+            return None
+        vals = [self._const_term(a) for a in t.args]
+        if any(v is _NOFOLD for v in vals):
+            return None
+        from ..rego.builtins import BUILTINS
+
+        b = BUILTINS.get(fn)
+        if b is None:
+            return None
+        try:
+            r = b(*vals)
+        except Exception:
+            return None  # undefined at compile time: normal paths decide
+        if isinstance(r, (str, int, float, bool)):
+            return SConst(r)
+        return None
 
     def call_value(self, t: A.Call) -> Symbolic:
         """A call in value (binding) position."""
+        folded = self._const_fold(t)
+        if folded is not None:
+            return folded
         fn = tuple(t.fn)
         if fn == ("sprintf",) and len(t.args) == 2 and \
                 isinstance(t.args[0], A.Scalar) and \
@@ -489,7 +597,7 @@ class _ClauseCompiler:
             if isinstance(base, _CELL_EXPRS):
                 col = self.ctx.derived_col("builtin", fn[0])
                 return SExpr(DerivedVal(col, base))
-            raise Uncompilable(f"{fn[0]} over non-cell value")
+            raise Uncompilable("call", f"{fn[0]} over non-cell value")
         if len(fn) == 1 and fn[0] in self.ctx.rules:
             sym = self._unary_derived(fn[0], t.args)
             if sym is not None:
@@ -502,17 +610,17 @@ class _ClauseCompiler:
         """Resolve a Var/Ref term to a symbolic path/element."""
         if isinstance(t, A.Var):
             if t.name == "input":
-                raise Uncompilable("bare input reference")
+                raise Uncompilable("input-root", "bare input reference")
             if t.name in self.env:
                 return self.env[t.name]
-            raise Uncompilable(f"unbound var {t.name}")
+            raise Uncompilable("unbound-var", f"unbound var {t.name}")
         if not isinstance(t, A.Ref):
-            raise Uncompilable(f"not a ref: {type(t).__name__}")
+            raise Uncompilable("path", f"not a ref: {type(t).__name__}")
         if isinstance(t.base, A.Var) and t.base.name == "input":
             sym = None
             args = t.args
             if not args or not isinstance(args[0], A.Scalar):
-                raise Uncompilable("dynamic input root")
+                raise Uncompilable("input-root", "dynamic input root")
             root0 = args[0].value
             if root0 == "review":
                 if len(args) > 1 and isinstance(args[1], A.Scalar) and \
@@ -526,7 +634,7 @@ class _ClauseCompiler:
                 sym = SPath(root="params", segs=())
                 rest = args[1:]
             else:
-                raise Uncompilable(f"unsupported input root {root0!r}")
+                raise Uncompilable("input-root", f"unsupported input root {root0!r}")
         else:
             sym = self.resolve_ref(t.base) if isinstance(t.base, A.Ref) else \
                 self.resolve_var_base(t.base)
@@ -537,18 +645,18 @@ class _ClauseCompiler:
         if isinstance(base, A.Var):
             if base.name in self.env:
                 return self.env[base.name]
-            raise Uncompilable(f"unbound base var {base.name}")
-        raise Uncompilable(f"unsupported ref base {type(base).__name__}")
+            raise Uncompilable("unbound-var", f"unbound base var {base.name}")
+        raise Uncompilable("path", f"unsupported ref base {type(base).__name__}")
 
     def walk_segments(self, sym: Symbolic, args: tuple) -> Symbolic:
         for ai, arg in enumerate(args):
             if isinstance(sym, SSet):
                 return self.set_bracket(sym, arg, args[ai + 1:])
             if not isinstance(sym, SPath):
-                raise Uncompilable("cannot descend into non-path symbolic")
+                raise Uncompilable("path", "cannot descend into non-path symbolic")
             if isinstance(arg, A.Scalar):
                 if not isinstance(arg.value, str):
-                    raise Uncompilable("non-string static bracket")
+                    raise Uncompilable("path", "non-string static bracket")
                 sym = replace(sym, segs=sym.segs + (Seg("field", name=arg.value),))
             elif isinstance(arg, A.Var):
                 name = arg.name
@@ -597,7 +705,7 @@ class _ClauseCompiler:
                 # iteration over the collection plus a key == value guard
                 sym = self._computed_key_bracket(sym, self.to_symbolic(arg))
             else:
-                raise Uncompilable("composite bracket pattern")
+                raise Uncompilable("path", "composite bracket pattern")
         return sym
 
     def _computed_key_bracket(self, sym: SPath, key_sym) -> SPath:
@@ -613,7 +721,7 @@ class _ClauseCompiler:
             # is authoritative), never under-fire
             arg_expr = self.value_expr(key_sym.arg)
             if not isinstance(arg_expr, _CELL_EXPRS):
-                raise Uncompilable("unsupported sprintf key argument")
+                raise Uncompilable("call", "unsupported sprintf key argument")
             col = self.ctx.derived_col("strip_prefix", key_sym.prefix)
             axis = self.ctx.new_axis("obj")
             kind = "param" if sym.root == "params" else "obj"
@@ -628,7 +736,7 @@ class _ClauseCompiler:
             return out
         key_expr = self.value_expr(key_sym)
         if not isinstance(key_expr, _CELL_EXPRS):
-            raise Uncompilable("unsupported computed bracket key")
+            raise Uncompilable("path", "unsupported computed bracket key")
         axis = self.ctx.new_axis("obj")
         kind = "param" if sym.root == "params" else "obj"
         out = replace(sym, segs=sym.segs + (Seg("iter", axis=axis),))
@@ -642,9 +750,9 @@ class _ClauseCompiler:
         """boundset[x]: membership test (const) or element iteration
         (fresh var / wildcard)."""
         if rest:
-            raise Uncompilable("descending into set elements")
+            raise Uncompilable("set-op", "descending into set elements")
         if s.source == "exists":
-            raise Uncompilable("bracket on existence-only set")
+            raise Uncompilable("set-op", "bracket on existence-only set")
         if isinstance(arg, A.Scalar):
             elem = self._set_elem_expr(s)
             test = Cmp("eq", elem, self._const_expr(arg.value), dtype="auto")
@@ -661,7 +769,19 @@ class _ClauseCompiler:
             if not arg.name.startswith("$wc"):
                 self.env[arg.name] = SExpr(elem)
             return SExpr(elem)
-        raise Uncompilable("unsupported set bracket")
+        if isinstance(arg, (A.Ref, A.Call, A.Var)):
+            # membership test against a computed value:
+            # boundset[input.review.object.metadata.name]
+            val = self.value_expr(self.to_symbolic(arg))
+            if isinstance(val, _CELL_EXPRS):
+                elem = self._set_elem_expr(s)
+                test = Cmp("eq", elem, val, dtype="auto")
+                if s.filter is not None:
+                    test = And((s.filter, test))
+                for ax in reversed(s.axes):
+                    test = OrReduce(ax, test)
+                return SExpr(test)
+        raise Uncompilable("set-op", "unsupported set bracket")
 
     def _const_expr(self, v) -> Expr:
         if isinstance(v, bool):
@@ -670,7 +790,7 @@ class _ClauseCompiler:
             return Const("num", float(v))
         if isinstance(v, str):
             return Const("str", v)
-        raise Uncompilable(f"unsupported constant {v!r}")
+        raise Uncompilable("const", f"unsupported constant {v!r}")
 
     def _register_axis(self, axis: str, kind: str, sym: SPath) -> None:
         """Axis presence is owned by the slot of the iterated collection."""
@@ -687,7 +807,7 @@ class _ClauseCompiler:
     def _obj_slot(self, sym: SPath, mode: str) -> ObjSlotRec:
         n_axes = sum(1 for s in sym.segs if s.kind == "iter")
         if n_axes > _MAX_SLOT_AXES:
-            raise Uncompilable("too many iteration axes in one path")
+            raise Uncompilable("axes", "too many iteration axes in one path")
         key = (sym.root, sym.segs, mode)
         rec = self.ctx.obj_slots.get(key)
         if rec is None:
@@ -712,47 +832,60 @@ class _ClauseCompiler:
     def set_compr(self, t: A.SetCompr) -> SSet:
         """{head | generator; ...filters...}. Forms:
           {x | x := path[_]}        — value set
-          {k | path[k]}             — key set
+          {x.f | x := path[_]}      — value set with a non-var head (the
+                                      head path extends the generator's)
+          {k | path[k]}             — key set, over OBJECT or PARAMETER
+                                      maps
           {x | x = path[_][k]; ...} — nested value set
           {1 | guards}              — existence set (const head)
-        Extra body literals become the element filter."""
+        Body literals may bind intermediate vars (the bindings land in
+        the comprehension-local env, so multi-literal filter bodies can
+        reference the generator element); remaining literals become the
+        element filter."""
         sub = _ClauseCompiler(self.ctx, self.needed | _body_vars(t.body),
                               env=dict(self.env), depth=self.depth)
         head = t.head
         head_name = head.name if isinstance(head, A.Var) else None
-        if head_name is not None:
-            sub.needed = sub.needed | {head_name}
+        head_vars: set = set()
+        _collect_vars(head, head_vars)
+        sub.needed = sub.needed | head_vars
+        # a head var already bound in the enclosing scope can never be a
+        # key-iteration binder here (it would unify, not generate)
+        head_preknown = head_name is not None and head_name in sub.env
         start_axes = len(sub.clause_axes)
-        gen_path: Optional[SPath] = None
-        source: Optional[str] = None
+        key_gen: Optional[tuple] = None  # (SKey binder, iterated SPath)
         filters: list[Expr] = []
-        for li, lit in enumerate(t.body):
+        for lit in t.body:
             e = lit.expr
-            if gen_path is None and not lit.negated and head_name and \
-                    isinstance(e, (A.Assign, A.Unify)) and \
-                    isinstance(e.lhs, A.Var) and e.lhs.name == head_name:
-                sym = sub.resolve_ref(e.rhs) if isinstance(
-                    e.rhs, (A.Ref, A.Var)) else None
-                if not isinstance(sym, SPath) or not any(
-                        s.kind == "iter" for s in sym.segs):
-                    raise Uncompilable("comprehension generator must iterate")
-                gen_path = sym
-                source = "paramvals" if sym.root == "params" else "objvals"
+            if isinstance(e, A.SomeDecl):
                 continue
-            if gen_path is None and not lit.negated and head_name and \
-                    isinstance(e, A.Ref):
+            if not lit.negated and isinstance(e, (A.Assign, A.Unify)) and \
+                    isinstance(e.lhs, A.Var) and e.lhs.name not in sub.env:
+                # fresh-var binding; a unify against an ALREADY-bound var
+                # falls through to the filter path as an equality (a
+                # rebind would widen the set — an under-fire risk)
+                sub.env[e.lhs.name] = sub.bind_rhs(e.rhs)
+                if sub.pending_scopes:
+                    raise Uncompilable("binding",
+                                       "set iteration in binding position")
+                continue
+            if not lit.negated and isinstance(e, A.Ref) and \
+                    key_gen is None and not head_preknown:
+                # possible key-iteration generator: path[k] binding the
+                # head var as a fresh map key
                 sym = sub.resolve_ref(e)
-                bound = sub.env.get(head_name)
+                bound = sub.env.get(head_name) if head_name else None
                 if isinstance(bound, SKey) and isinstance(sym, SPath):
-                    if sym.root == "params":
-                        raise Uncompilable("param key-set comprehension")
-                    gen_path = sym
-                    source = "objkeys"
+                    key_gen = (bound, sym)
                     continue
-                # a plain ref guard (e.g. the generator for a const head)
-                expr = sub.bool_expr(e)
-                expr = sub._wrap_pending(expr)
-                filters.append(expr if not lit.negated else Not(expr))
+                # plain ref guard: reuse the resolved symbolic (resolving
+                # again via bool_expr would mint duplicate axes)
+                if isinstance(sym, SExpr) and isinstance(sym.expr,
+                                                         _BOOL_EXPRS):
+                    expr = sym.expr
+                else:
+                    expr = Truthy(sub.value_expr(sym))
+                filters.append(sub._wrap_pending(expr))
                 continue
             # filter literal
             ax_mark = len(sub.clause_axes)
@@ -766,18 +899,50 @@ class _ClauseCompiler:
         axes = tuple(a.name for a in sub.clause_axes[start_axes:])
         filt = And(tuple(filters)) if len(filters) > 1 else (
             filters[0] if filters else None)
-        if gen_path is None:
-            if head_name is None and isinstance(head, A.Scalar):
-                # existence set: {1 | guards}
-                return SSet(source="exists", path=None, axes=axes,
-                            filter=filt)
-            raise Uncompilable("unrecognized set comprehension form")
-        return SSet(source=source, path=gen_path, axes=axes, filter=filt)
+        if key_gen is not None:
+            binder, sym = key_gen
+            source = "paramkeys" if binder.kind == "param" else "objkeys"
+            return SSet(source=source, path=sym, axes=axes, filter=filt)
+        # value set: the head term resolved against the comprehension env
+        # (a bound var, or a non-var head like c.image extending the
+        # generator element's path)
+        if head_name is not None or isinstance(head, (A.Ref, A.Call)):
+            sym = sub.env.get(head_name) if head_name is not None else None
+            if sym is None:
+                try:
+                    sym = sub.to_symbolic(head)
+                except Uncompilable as e:
+                    raise Uncompilable(
+                        "comprehension",
+                        f"unsupported comprehension head ({e.detail or e.code})")
+            if isinstance(sym, SPath) and any(
+                    s.kind == "iter" for s in sym.segs):
+                source = "paramvals" if sym.root == "params" else "objvals"
+                return SSet(source=source, path=sym, axes=axes, filter=filt)
+            if isinstance(sym, SKey):
+                # head is a key var bound through a v := m[k] literal
+                ax = self.ctx.axes[sym.axis]
+                rec = self.ctx.rec_for_slot(ax.slot)
+                if rec is not None:
+                    path = SPath(root=getattr(rec, "root", "params"),
+                                 segs=tuple(rec.segs))
+                    source = ("paramkeys" if sym.kind == "param"
+                              else "objkeys")
+                    return SSet(source=source, path=path, axes=axes,
+                                filter=filt)
+            raise Uncompilable("comprehension",
+                               "comprehension generator must iterate")
+        if isinstance(head, A.Scalar):
+            # existence set: {1 | guards}
+            return SSet(source="exists", path=None, axes=axes,
+                        filter=filt)
+        raise Uncompilable("comprehension",
+                           "unrecognized set comprehension form")
 
     def bool_list_compr(self, t: A.ArrayCompr) -> SBoolList:
         """[b | x = params.list[_]; ...guards...; b = pred(x)]"""
         if not isinstance(t.head, A.Var):
-            raise Uncompilable("array comprehension head must be a var")
+            raise Uncompilable("comprehension", "array comprehension head must be a var")
         head = t.head.name
         sub = _ClauseCompiler(self.ctx, self.needed | {head} | _body_vars(t.body),
                               env=dict(self.env), depth=self.depth)
@@ -792,7 +957,7 @@ class _ClauseCompiler:
             else:
                 sub.literal(lit)
         if pred is None:
-            raise Uncompilable("array comprehension without boolean head binding")
+            raise Uncompilable("comprehension", "array comprehension without boolean head binding")
         axes = tuple(a.name for a in sub.clause_axes[start_axes:])
         guards = [g.expr if not g.negated else Not(g.expr)
                   for g in sub.guards]
@@ -821,7 +986,7 @@ class _ClauseCompiler:
             rhs = self.to_symbolic(e.rhs)
             _check_zero_only(lhs, rhs, "eq")
             return self.eq_expr(lhs, rhs)
-        raise Uncompilable(f"unsupported guard {type(e).__name__}")
+        raise Uncompilable("guard", f"unsupported guard {type(e).__name__}")
 
     def to_symbolic(self, t) -> Symbolic:
         if isinstance(t, A.Var) and t.name in self.env:
@@ -834,7 +999,7 @@ class _ClauseCompiler:
 
     def cmp_expr(self, e: A.BinOp) -> Expr:
         if e.op not in _CMP_OPS:
-            raise Uncompilable(f"unsupported operator {e.op}")
+            raise Uncompilable("guard", f"unsupported operator {e.op}")
         op = _CMP_OPS[e.op]
         # X == sprintf("prefix%v", [t]) — equality against a prefixed
         # string (apparmor annotation keys): strip the prefix via a derived
@@ -868,7 +1033,7 @@ class _ClauseCompiler:
         if not fmt.endswith("%v") or fmt.count("%") != 1:
             return None
         if op != "eq":
-            raise Uncompilable("sprintf equality only supports ==")
+            raise Uncompilable("guard", "sprintf equality only supports ==")
         prefix = fmt[:-2]
         col = self.ctx.derived_col("strip_prefix", prefix)
         base = self.value_expr(self.to_symbolic(value_t))
@@ -889,7 +1054,7 @@ class _ClauseCompiler:
         for a, b in ((lhs, rhs), (rhs, lhs)):
             if isinstance(a, SSprintf):
                 if op != "eq":
-                    raise Uncompilable("sprintf equality only supports ==")
+                    raise Uncompilable("guard", "sprintf equality only supports ==")
                 col = self.ctx.derived_col("strip_prefix", a.prefix)
                 other = self.value_expr(b)
                 arg = self.value_expr(a.arg)
@@ -903,9 +1068,9 @@ class _ClauseCompiler:
         for a, b in ((lhs, rhs), (rhs, lhs)):
             if isinstance(a, SConst) and a.value == ():
                 if op != "eq":
-                    raise Uncompilable("!= [] is not supported")
+                    raise Uncompilable("guard", "!= [] is not supported")
                 if not isinstance(b, SPath):
-                    raise Uncompilable("[] comparison needs a path")
+                    raise Uncompilable("guard", "[] comparison needs a path")
                 return And((KindIs(self.value_expr(b), (K_ARR,)),
                             Cmp("eq", self.count_of(b), Const("num", 0.0),
                                 dtype="num")))
@@ -925,7 +1090,7 @@ class _ClauseCompiler:
             return sym.expr
         if isinstance(sym, SConst):
             if isinstance(sym.value, bool) or not isinstance(sym.value, (int, float)):
-                raise Uncompilable("numeric comparison with non-number")
+                raise Uncompilable("guard", "numeric comparison with non-number")
             return Const("num", float(sym.value))
         return self.value_expr(sym)
 
@@ -951,7 +1116,7 @@ class _ClauseCompiler:
             mode = "entries" if axes else "scalar"
             rec = self._obj_slot(sym, mode=mode)
             return OVal(rec.slot, f="val", axis=axis)
-        raise Uncompilable(f"cannot make a scalar of {type(sym).__name__}")
+        raise Uncompilable("guard", f"cannot make a scalar of {type(sym).__name__}")
 
     def _check_key_innermost(self, sym: SKey, ax: Axis) -> None:
         """Extraction records keys for a slot's innermost axis only."""
@@ -960,7 +1125,7 @@ class _ClauseCompiler:
             return
         iters = [s.axis for s in rec.segs if s.kind == "iter"]
         if iters and iters[-1] != sym.axis:
-            raise Uncompilable("key binding on a non-innermost axis")
+            raise Uncompilable("axes", "key binding on a non-innermost axis")
 
     # ----------------------------------------------------------------- calls
 
@@ -973,15 +1138,15 @@ class _ClauseCompiler:
                 for ax in reversed(sym.axes):
                     out = OrReduce(ax, out)
                 return out
-            raise Uncompilable("any() over non-comprehension")
+            raise Uncompilable("call", "any() over non-comprehension")
         if fn == ("count",):
-            raise Uncompilable("bare count() guard")
+            raise Uncompilable("count", "bare count() guard")
         if len(fn) == 1 and fn[0] in _MATCH_OPS:
             return self.match_call(_MATCH_OPS[fn[0]], e.args)
         if fn == ("glob", "match"):
             # glob.match(pattern, delimiters, value)
             if len(e.args) != 3:
-                raise Uncompilable("glob.match arity")
+                raise Uncompilable("call", "glob.match arity")
             return self.match_call("glob", (e.args[0], e.args[2]))
         if len(fn) == 1 and fn[0] in self.ctx.rules:
             try:
@@ -991,7 +1156,7 @@ class _ClauseCompiler:
                 if alt is not None:
                     return alt
                 raise
-        raise Uncompilable(f"unsupported call {'.'.join(fn)}")
+        raise Uncompilable("call", f"unsupported call {'.'.join(fn)}")
 
     def _fn_fallback(self, name: str, args: tuple) -> Optional[Expr]:
         """Helper calls the inliner can't vectorize: unary fns become
@@ -1077,7 +1242,7 @@ class _ClauseCompiler:
                 op = f"{op}@{_PATTERN_TRANSFORMS[pattern_t.fn[0]]}:"
                 pattern_t = targs[0]
             else:
-                raise Uncompilable("unsupported pattern transform")
+                raise Uncompilable("pattern", "unsupported pattern transform")
         pattern = self.to_symbolic(pattern_t)
         row = self._pattern_row(op, pattern)
         return MatchLookup(row=row, sid=vexpr)
@@ -1085,7 +1250,7 @@ class _ClauseCompiler:
     def _pattern_row(self, op: str, pattern: Symbolic) -> Expr:
         if isinstance(pattern, SConst):
             if not isinstance(pattern.value, str):
-                raise Uncompilable("pattern must be a string")
+                raise Uncompilable("pattern", "pattern must be a string")
             return Const("row", (op, pattern.value))
         if isinstance(pattern, SPath) and pattern.root == "params":
             axes = [s.axis for s in pattern.segs if s.kind == "iter"]
@@ -1095,8 +1260,8 @@ class _ClauseCompiler:
             return PVal(rec.slot, f=f"row:{op}",
                         axis=axes[-1] if axes else None)
         if isinstance(pattern, SKey) and pattern.kind == "param":
-            raise Uncompilable("param key as pattern")
-        raise Uncompilable("pattern must come from parameters or constants")
+            raise Uncompilable("pattern", "param key as pattern")
+        raise Uncompilable("pattern", "pattern must come from parameters or constants")
 
     # ------------------------------------------------------------------ sets
 
@@ -1106,12 +1271,14 @@ class _ClauseCompiler:
         axis = axes[-1] if axes else None
         if s.source == "paramvals":
             return PVal(slot, f="val", axis=axis)
+        if s.source == "paramkeys":
+            return PVal(slot, f="key", axis=axis)
         if s.source == "objkeys":
             return OVal(slot, f="key", axis=axis)
         return OVal(slot, f="val", axis=axis)
 
     def _set_slot(self, s: SSet) -> int:
-        if s.source == "paramvals":
+        if s.source in ("paramvals", "paramkeys"):
             return self._param_slot(s.path, mode="list").slot
         return self._obj_slot(s.path, mode="entries").slot
 
@@ -1130,9 +1297,10 @@ class _ClauseCompiler:
                 for ax in reversed(sym.axes):
                     out = SumReduce(ax, out)
                 if not sym.axes:
-                    raise Uncompilable("existence set without iteration")
+                    raise Uncompilable("set-op", "existence set without iteration")
                 return out
-            if sym.source == "paramvals" and sym.filter is None:
+            if sym.source in ("paramvals", "paramkeys") and \
+                    sym.filter is None:
                 return PVal(self._set_slot(sym), f="count")
             elem = self._set_elem_expr(sym)
             inner: Expr = Exists(elem)
@@ -1149,7 +1317,7 @@ class _ClauseCompiler:
                 return PVal(rec.slot, f="count")
             rec = self._obj_slot(sym, mode="count")
             return OVal(rec.slot, f="count")
-        raise Uncompilable("unsupported count() argument")
+        raise Uncompilable("count", "unsupported count() argument")
 
     def _member_test(self, elem: Expr, s: SSet) -> Expr:
         """∃ element of s equal to elem."""
@@ -1165,10 +1333,10 @@ class _ClauseCompiler:
         """|A - B| as a device expr, valid for comparisons against 0 (set
         dedup does not change emptiness)."""
         if not isinstance(sd.left, SSet):
-            raise Uncompilable("nested set difference")
+            raise Uncompilable("set-op", "nested set difference")
         left, right = sd.left, sd.right
         if left.source == "exists" or right.source == "exists":
-            raise Uncompilable("set difference over existence set")
+            raise Uncompilable("set-op", "set difference over existence set")
         lv = self._set_elem_expr(left)
         inner: Expr = Not(self._member_test(lv, right))
         if left.filter is not None:
@@ -1177,13 +1345,13 @@ class _ClauseCompiler:
         for ax in reversed(left.axes):
             out = SumReduce(ax, out)
         if not left.axes:
-            raise Uncompilable("set difference without iteration")
+            raise Uncompilable("set-op", "set difference without iteration")
         return out
 
     def setinter_count(self, si: SSetInter) -> Expr:
         left, right = si.left, si.right
         if left.source == "exists" or right.source == "exists":
-            raise Uncompilable("set intersection over existence set")
+            raise Uncompilable("set-op", "set intersection over existence set")
         lv = self._set_elem_expr(left)
         inner: Expr = self._member_test(lv, right)
         if left.filter is not None:
@@ -1192,20 +1360,20 @@ class _ClauseCompiler:
         for ax in reversed(left.axes):
             out = SumReduce(ax, out)
         if not left.axes:
-            raise Uncompilable("set intersection without iteration")
+            raise Uncompilable("set-op", "set intersection without iteration")
         return out
 
     # --------------------------------------------------------------- helpers
 
     def inline_helper(self, name: str, args: tuple) -> Expr:
         if self.depth >= _MAX_INLINE_DEPTH:
-            raise Uncompilable(f"helper inline depth exceeded at {name}")
+            raise Uncompilable("helper", f"helper inline depth exceeded at {name}")
         rules = self.ctx.rules[name]
         actuals = [self.to_symbolic(a) for a in args]
         alts: list[Expr] = []
         for r in rules:
             if r.kind != "function":
-                raise Uncompilable(f"{name} is not a function")
+                raise Uncompilable("helper", f"{name} is not a function")
             if len(r.args) != len(actuals):
                 continue
             env = {}
@@ -1248,7 +1416,7 @@ class _ClauseCompiler:
                 body = OrReduce(ax.name, body)
             alts.append(body)
         if not alts:
-            raise Uncompilable(f"{name}: no applicable clauses")
+            raise Uncompilable("helper", f"{name}: no applicable clauses")
         return Or(tuple(alts)) if len(alts) > 1 else alts[0]
 
     def _helper_value(self, r: A.Rule, sub: "_ClauseCompiler"
@@ -1268,7 +1436,7 @@ class _ClauseCompiler:
             return Truthy(sub.value_expr(sym))
         if isinstance(v, (A.Ref, A.Var)):
             return Truthy(sub.value_expr(sub.to_symbolic(v)))
-        raise Uncompilable(f"{r.name}: unsupported head value")
+        raise Uncompilable("helper", f"{r.name}: unsupported head value")
 
 
 def _refs_input(r: A.Rule) -> bool:
@@ -1333,9 +1501,9 @@ def _check_zero_only(lhs: "Symbolic", rhs: "Symbolic", op: str) -> None:
                     not isinstance(other.value, bool) and
                     (eff_op, other.value) in _ZERO_SAFE):
                 raise Uncompilable(
-                    "set-derived counts may only be compared for emptiness "
-                    "(e.g. count(x) > 0)"
-                )
+                    "count",
+                    "set-derived counts may only be compared for "
+                    "emptiness (e.g. count(x) > 0)")
 
 
 def _body_vars(body: tuple) -> set:

@@ -129,6 +129,63 @@ def merge_template_modules(mods: list) -> Optional[A.Module]:
     return dc_replace(entry, rules=tuple(fixed))
 
 
+def _module_reads_data(module: A.Module) -> bool:
+    """Does any rule reference the data document (inventory reads)?
+    Decides which compile stage's fallback reason is the actionable one:
+    a data-reading template was always headed for the join compiler, so
+    its join reason is reported; a review-pure template's dense reason
+    is."""
+    found = [False]
+
+    def walk(t) -> None:
+        if found[0]:
+            return
+        if isinstance(t, A.Var):
+            if t.name == "data":
+                found[0] = True
+        elif isinstance(t, A.Ref):
+            walk(t.base)
+            for a in t.args:
+                walk(a)
+        elif isinstance(t, A.Call):
+            if t.fn and t.fn[0] == "data":
+                found[0] = True
+            for a in t.args:
+                walk(a)
+        elif isinstance(t, A.BinOp):
+            walk(t.lhs)
+            walk(t.rhs)
+        elif isinstance(t, A.UnaryMinus):
+            walk(t.term)
+        elif isinstance(t, (A.ArrayLit, A.SetLit)):
+            for x in t.items:
+                walk(x)
+        elif isinstance(t, A.ObjectLit):
+            for k, v in t.items:
+                walk(k)
+                walk(v)
+        elif isinstance(t, (A.ArrayCompr, A.SetCompr, A.ObjectCompr)):
+            for lit in t.body:
+                if not isinstance(lit.expr, A.SomeDecl):
+                    walk(lit.expr)
+            for h in (getattr(t, "head", None), getattr(t, "key", None),
+                      getattr(t, "value", None)):
+                if h is not None:
+                    walk(h)
+        elif isinstance(t, (A.Assign, A.Unify)):
+            walk(t.lhs)
+            walk(t.rhs)
+
+    for r in module.rules:
+        for lit in r.body:
+            if not isinstance(lit.expr, A.SomeDecl):
+                walk(lit.expr)
+        for h in (r.key, r.value):
+            if h is not None:
+                walk(h)
+    return found[0]
+
+
 def _expand_parameterless(rows, cols, c_dev: int, n_cons: int):
     """A parameterless program has no C axis on device (verdicts are
     [N, 1], constraint-independent); expand each firing row to every
@@ -377,6 +434,12 @@ class TpuDriver(RegoDriver):
         # the delta cache, or the interpreter fallback
         self._eval_counts: dict[tuple, int] = {}
         self._eval_counts_lock = threading.Lock()
+        # interpreter-bound kinds: kind -> {"reason", "dense", "join"}
+        # — the stable Uncompilable taxonomy code (+ detail prose per
+        # compile stage) recorded at ingestion, surfaced through
+        # /debug/templates and gatekeeper_tpu_compile_fallback_total
+        # so "why is this kind slow" is answerable without a debugger
+        self._fallback: dict[str, dict] = {}
         # AOT program store (ir/aot.py): serialized compiled executables
         # + warm sweep signatures, persisted under the statestore's
         # state dir (<state-dir>/aot) so a warm boot deserializes the
@@ -450,21 +513,27 @@ class TpuDriver(RegoDriver):
         self._data_taint.pop(kind, None)
         self._drop_audit_results(kind)
         self._drop_warm(kind)  # new CompiledTemplate = cold jit caches
+        self._fallback.pop(kind, None)
         module = mods[0] if len(mods) == 1 else merge_template_modules(mods)
         if module is None:
             self._compiled[kind] = None
+            self._note_fallback(
+                kind, dense=("module-shape",
+                             "template entry/lib module merge failed"))
             return
         try:
             self._programs[kind] = compile_template(module, kind)
             self._modules[kind] = module
-        except Uncompilable:
+        except Uncompilable as de:
             self._compiled[kind] = None
             # cross-object templates: try the inventory-join compiler
             from .join import compile_join
             try:
                 self._join_progs[kind] = compile_join(module, kind)
-            except Uncompilable:
-                pass
+            except Uncompilable as je:
+                self._note_fallback(kind, dense=(de.code, de.detail),
+                                    join=(je.code, je.detail),
+                                    reads_data=_module_reads_data(module))
         # off-path compilation starts at INGESTION: build the device
         # evaluator now (cheap host work on the ingesting thread — the
         # intern table is not thread-safe, so resolve_consts must not
@@ -485,6 +554,7 @@ class TpuDriver(RegoDriver):
             self._join_compiled.pop(m.group(2), None)
             self._join_frz[2].pop(m.group(2), None)
             self._data_taint.pop(m.group(2), None)
+            self._fallback.pop(m.group(2), None)
             self._drop_audit_results(m.group(2))
             self._drop_warm(m.group(2))
         return n
@@ -615,6 +685,38 @@ class TpuDriver(RegoDriver):
             ct = None
         self._compiled[kind] = ct
         return ct
+
+    def _note_fallback(self, kind: str, dense: tuple,
+                       join: Optional[tuple] = None,
+                       reads_data: bool = False) -> None:
+        """Record WHY a kind is interpreter-bound (both compile stages'
+        taxonomy codes) and count it. The headline `reason` label picks
+        the stage the template was actually headed for: a data-reading
+        template fails usefully in the JOIN compiler (its dense failure
+        is just "you read data"), a review-pure one in the dense
+        compiler."""
+        reason = (join[0] if join is not None and reads_data
+                  else dense[0])
+        self._fallback[kind] = {
+            "reason": reason,
+            "dense": {"code": dense[0], "detail": dense[1]},
+            "join": ({"code": join[0], "detail": join[1]}
+                     if join is not None else None),
+        }
+        log.info("template %s is interpreter-bound (%s): dense=%s join=%s",
+                 kind, reason, dense, join)
+        try:
+            from ..control.metrics import report_compile_fallback
+
+            report_compile_fallback(kind, reason)
+        except Exception:  # metrics backend optional in embedders
+            pass
+
+    def fallback_reasons(self) -> dict:
+        """kind -> {"reason", "dense": {code, detail}, "join": {...}}
+        for every interpreter-bound kind (empty when the whole library
+        is device-compiled)."""
+        return {k: dict(v) for k, v in self._fallback.items()}
 
     def _demote(self, kind: str, reason: str, exc: Exception) -> None:
         """A device->interpreter demotion is a ~10^4x per-eval slowdown;
@@ -804,6 +906,10 @@ class TpuDriver(RegoDriver):
                      if k == kind}
             out[kind] = {
                 "state": state,
+                # why an interpreter-bound kind didn't compile: the
+                # stable taxonomy code + per-stage detail (None for
+                # device-compiled kinds)
+                "fallback": self._fallback.get(kind),
                 "quarantine": quarantined.get(kind),
                 "eval_counts": evals,
                 # per-kind compile provenance: recent device-program

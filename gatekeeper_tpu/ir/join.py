@@ -22,10 +22,16 @@ the merged template AST and splits the clause:
 
 The `not identical(other, input.review)` exclusion becomes an identity-key
 comparison: a review never fires on a key whose only holder is its own
-stored copy. The join decision is exact except in the degenerate case of
-distinct inventory objects sharing one identity key (then it may only
-OVER-fire); host materialization re-checks every firing pair, the same
-authority contract as ir/evaljax.py.
+stored copy. Identity fns may have ANY arity and MULTIPLE clauses — each
+clause becomes an identity GROUP, and a pair is "identical" when any
+group's tuples match; inline self-exclusion disequalities
+(`name != input.review.object.metadata.name`) compile as single-pair
+groups. `some`-decls are accepted, and an inventory ref used inline in a
+literal (rather than bound `other := ...` first) is extracted into a
+synthesized generator binding. The join decision is exact except in the
+degenerate case of distinct inventory objects sharing one identity key
+(then it may only OVER-fire); host materialization re-checks every firing
+pair, the same authority contract as ir/evaljax.py.
 """
 
 from __future__ import annotations
@@ -131,9 +137,13 @@ def _is_inventory_ref(t) -> Optional[A.Ref]:
 @dataclass
 class JoinClause:
     rev_keys: str     # partial-set rule: {[k1, k2, ...]} join-key tuples
-    rev_ident: Optional[str]   # complete rule: [i1, i2, ...] identity tuple
+    # identity-fn clauses become GROUPS: one (rev complete rule, inv
+    # partial-set rule) pair per clause of the identity fn. A pair is
+    # "identical" when ANY group's tuples match, so the exclusion
+    # `not identical(...)` holds when EVERY group mismatches.
+    rev_ident: list   # complete-rule names: [i1, i2, ...] per group
     inv_entries: str  # partial-set rule: {[[path...], [k...]]}
-    inv_ident: Optional[str]   # partial-set rule: {[[path...], [i...]]}
+    inv_ident: list   # partial-set rules: {[[path...], [i...]]} per group
 
 
 @dataclass
@@ -227,13 +237,14 @@ def _rejects_parameters(module: A.Module) -> None:
     def walk(t) -> None:
         if isinstance(t, A.Var):
             if t.name == "input":
-                raise Uncompilable("join: bare input reference")
+                raise Uncompilable("join-input", "bare input reference")
         elif isinstance(t, A.Ref):
             if isinstance(t.base, A.Var) and t.base.name == "input":
                 if not (t.args and isinstance(t.args[0], A.Scalar)
                         and t.args[0].value == "review"):
                     raise Uncompilable(
-                        "join: input reference outside input.review "
+                        "join-input",
+                        "input reference outside input.review "
                         "(parameterized join templates cannot share one "
                         "fires[] per kind)")
                 for a in t.args:
@@ -309,11 +320,132 @@ def _drop_head_only(body: list, head_names: set, rules: dict) -> list:
     return body
 
 
+def _find_inv_refs(t, out: list) -> None:
+    """Collect inventory Ref nodes (by identity) anywhere in a term."""
+    if isinstance(t, A.Ref):
+        if _is_inventory_ref(t) is not None:
+            out.append(t)
+            return
+        _find_inv_refs(t.base, out)
+        for a in t.args:
+            _find_inv_refs(a, out)
+    elif isinstance(t, A.Call):
+        for a in t.args:
+            _find_inv_refs(a, out)
+    elif isinstance(t, A.BinOp):
+        _find_inv_refs(t.lhs, out)
+        _find_inv_refs(t.rhs, out)
+    elif isinstance(t, A.UnaryMinus):
+        _find_inv_refs(t.term, out)
+    elif isinstance(t, (A.ArrayLit, A.SetLit)):
+        for x in t.items:
+            _find_inv_refs(x, out)
+    elif isinstance(t, A.ObjectLit):
+        for k, v in t.items:
+            _find_inv_refs(k, out)
+            _find_inv_refs(v, out)
+    elif isinstance(t, (A.Assign, A.Unify)):
+        _find_inv_refs(t.lhs, out)
+        _find_inv_refs(t.rhs, out)
+
+
+def _replace_node(t, old, new):
+    """Replace a node found by identity (splicing ref-into-ref bases)."""
+    if t is old:
+        return new
+    if isinstance(t, A.Ref):
+        base = _replace_node(t.base, old, new)
+        args = tuple(_replace_node(a, old, new) for a in t.args)
+        if isinstance(base, A.Ref):
+            return A.Ref(base=base.base, args=base.args + args)
+        return A.Ref(base=base, args=args)
+    if isinstance(t, A.Call):
+        return A.Call(t.fn, tuple(_replace_node(a, old, new)
+                                  for a in t.args))
+    if isinstance(t, A.BinOp):
+        return A.BinOp(t.op, _replace_node(t.lhs, old, new),
+                       _replace_node(t.rhs, old, new))
+    if isinstance(t, A.UnaryMinus):
+        return A.UnaryMinus(_replace_node(t.term, old, new))
+    if isinstance(t, (A.ArrayLit, A.SetLit)):
+        return type(t)(tuple(_replace_node(x, old, new) for x in t.items))
+    if isinstance(t, A.ObjectLit):
+        return A.ObjectLit(tuple((_replace_node(k, old, new),
+                                  _replace_node(v, old, new))
+                                 for k, v in t.items))
+    if isinstance(t, (A.Assign, A.Unify)):
+        return type(t)(_replace_node(t.lhs, old, new),
+                       _replace_node(t.rhs, old, new))
+    return t
+
+
+def _split_inv_ref(ref: A.Ref):
+    """Split an inline inventory ref at the object boundary:
+    data.inventory.namespace[ns][apiv][kind][name](.residual...) — the
+    first 5 (namespaced) / 4 (cluster) post-"inventory" segments address
+    the object; the rest descend into it. None when the shape is off."""
+    args = ref.args
+    if len(args) < 2 or not isinstance(args[1], A.Scalar):
+        return None
+    n = {"namespace": 6, "cluster": 5}.get(args[1].value)
+    if n is None or len(args) < n:
+        return None
+    head = A.Ref(base=ref.base, args=args[:n])
+    return head, args[n:]
+
+
+def _extract_inline_generators(body: list, idx: int) -> list:
+    """Binding introduction for upstream-canonical clauses that use the
+    inventory ref INLINE (`data.inventory.namespace[ns][_][\"Service\"]
+    [name].spec.selector == sel`) instead of binding `other :=` first:
+    each inline ref becomes a fresh generator binding plus a residual
+    ref through the fresh var, which the side-splitter then classifies
+    normally."""
+    out: list = []
+    n_fresh = 0
+    for lit in body:
+        e = lit.expr
+        # a NEGATED inventory ref asserts absence — introducing a
+        # positive generator binding for it would invert the semantics;
+        # leave it for the generator locator to reject
+        if lit.negated:
+            out.append(lit)
+            continue
+        # the canonical binding form is left alone (the generator
+        # locator owns it)
+        if isinstance(e, (A.Assign, A.Unify)) and (
+                _is_inventory_ref(e.rhs) is not None
+                or _is_inventory_ref(e.lhs) is not None):
+            out.append(lit)
+            continue
+        refs: list = []
+        _find_inv_refs(e, refs)
+        changed = False
+        for ref in refs:
+            split = _split_inv_ref(ref)
+            if split is None:
+                continue
+            head, rest = split
+            fresh = f"__jg{idx}_{n_fresh}"
+            n_fresh += 1
+            repl = A.Ref(base=A.Var(fresh), args=rest) if rest \
+                else A.Var(fresh)
+            e = _replace_node(e, ref, repl)
+            out.append(A.Literal(expr=A.Assign(A.Var(fresh), head)))
+            changed = True
+        out.append(dc_replace(lit, expr=e) if changed else lit)
+    return out
+
+
 def _compile_clause(rule: A.Rule, rules_by_name: dict, idx: int,
                     new_rules: list, arg_pure: set) -> JoinClause:
     head_names: set = set()
     _names(rule.key, head_names)
     body = _drop_head_only(list(rule.body), head_names, rules_by_name)
+    # `some ns, apiv, name` declarations scope vars the generator walk
+    # names anyway — they carry no constraints of their own
+    body = [lit for lit in body if not isinstance(lit.expr, A.SomeDecl)]
+    body = _extract_inline_generators(body, idx)
 
     # locate the inventory generator
     gen_i = None
@@ -326,17 +458,17 @@ def _compile_clause(rule: A.Rule, rules_by_name: dict, idx: int,
             tgt = _is_inventory_ref(e)
         if tgt is not None:
             if gen_i is not None:
-                raise Uncompilable("join: multiple inventory generators")
+                raise Uncompilable("join-generator", "multiple inventory generators")
             if lit.negated:
-                raise Uncompilable("join: negated inventory generator")
+                raise Uncompilable("join-generator", "negated inventory generator")
             gen_i = i
     if gen_i is None:
-        raise Uncompilable("join: no inventory generator")
+        raise Uncompilable("join-generator", "no inventory generator")
     gen_lit = body[gen_i]
     ge = gen_lit.expr
     if not (isinstance(ge, (A.Assign, A.Unify)) and isinstance(ge.lhs, A.Var)
             and _is_inventory_ref(ge.rhs) is not None):
-        raise Uncompilable("join: generator must bind a var")
+        raise Uncompilable("join-generator", "generator must bind a var")
     other_var = ge.lhs.name
     inv_ref = ge.rhs
     # name the path segments (wildcards get fresh names so the object id
@@ -353,7 +485,7 @@ def _compile_clause(rule: A.Rule, rules_by_name: dict, idx: int,
         elif isinstance(a, A.Scalar):
             new_args.append(a)
         else:
-            raise Uncompilable("join: complex inventory path segment")
+            raise Uncompilable("join-generator", "complex inventory path segment")
     gen_expr = A.Assign(A.Var(other_var),
                         A.Ref(base=A.Var("data"),
                               args=(A.Scalar("inventory"),) + tuple(new_args)))
@@ -364,7 +496,7 @@ def _compile_clause(rule: A.Rule, rules_by_name: dict, idx: int,
     rev_lits: list = []
     inv_lits: list = []
     join_pairs: list = []     # (inv_expr, rev_expr)
-    ident_pairs: list = []    # (inv_expr, rev_expr)
+    ident_groups: list = []   # per identity-fn clause: [(inv, rev), ...]
 
     builtin1 = {fn[0] for fn in BUILTINS}
     rule_names = set(rules_by_name)
@@ -452,10 +584,10 @@ def _compile_clause(rule: A.Rule, rules_by_name: dict, idx: int,
         if i == gen_i:
             continue
         e = lit.expr
-        if isinstance(e, A.SomeDecl):
-            raise Uncompilable("join: some-decl")
+        if isinstance(e, A.SomeDecl):  # pragma: no cover - filtered above
+            continue
         if lit.withs:
-            raise Uncompilable("join: with modifier")
+            raise Uncompilable("join-with", "with modifier")
         # exclusion: `not identical(other, input.review)` /
         # `not is_self(other)` — any arity: substitute formals with the
         # actual args, then each body equality must split into a pure
@@ -464,33 +596,43 @@ def _compile_clause(rule: A.Rule, rules_by_name: dict, idx: int,
                 e.fn[0] in rules_by_name and \
                 rules_by_name[e.fn[0]][0].kind == "function" and \
                 any(side_of(a) == "inv" for a in e.args):
-            frules = rules_by_name[e.fn[0]]
-            if len(frules) != 1:
-                raise Uncompilable("join: multi-clause identity fn")
-            fr = frules[0]
-            if len(fr.args) != len(e.args) or \
-                    not all(isinstance(a, A.Var) for a in fr.args):
-                raise Uncompilable("join: identity fn arg shape")
-            env = {fa.name: aa for fa, aa in zip(fr.args, e.args)}
-            for bl in fr.body:
-                be = bl.expr
-                if bl.negated or not isinstance(be, (A.BinOp, A.Unify)) \
-                        or (isinstance(be, A.BinOp) and be.op != "=="):
-                    raise Uncompilable("join: identity fn body")
-                lhs = _subst(be.lhs, env)
-                rhs = _subst(be.rhs, env)
-                if "data" in (var_reads(lhs) | var_reads(rhs)):
-                    raise Uncompilable("join: data read in identity fn")
-                ls, rs = side_of(lhs), side_of(rhs)
-                if ls == "inv" and rs == "rev":
-                    ident_pairs.append((lhs, rhs))
-                elif rs == "inv" and ls == "rev":
-                    ident_pairs.append((rhs, lhs))
-                else:
-                    raise Uncompilable("join: identity eq shape")
+            # each clause of the identity fn becomes its own GROUP of
+            # (inv, rev) equality pairs — "identical" when any group's
+            # tuples fully match, so the negation excludes exactly the
+            # union of the clauses
+            for fr in rules_by_name[e.fn[0]]:
+                if fr.kind != "function":
+                    raise Uncompilable("join-identity",
+                                       "identity fn clause mix")
+                if len(fr.args) != len(e.args) or \
+                        not all(isinstance(a, A.Var) for a in fr.args):
+                    raise Uncompilable("join-identity", "identity fn arg shape")
+                env = {fa.name: aa for fa, aa in zip(fr.args, e.args)}
+                pairs: list = []
+                for bl in fr.body:
+                    be = bl.expr
+                    if bl.negated or not isinstance(be, (A.BinOp, A.Unify)) \
+                            or (isinstance(be, A.BinOp) and be.op != "=="):
+                        raise Uncompilable("join-identity", "identity fn body")
+                    lhs = _subst(be.lhs, env)
+                    rhs = _subst(be.rhs, env)
+                    if "data" in (var_reads(lhs) | var_reads(rhs)):
+                        raise Uncompilable("join-identity",
+                                           "data read in identity fn")
+                    ls, rs = side_of(lhs), side_of(rhs)
+                    if ls == "inv" and rs == "rev":
+                        pairs.append((lhs, rhs))
+                    elif rs == "inv" and ls == "rev":
+                        pairs.append((rhs, lhs))
+                    else:
+                        raise Uncompilable("join-identity", "identity eq shape")
+                if not pairs:
+                    raise Uncompilable("join-identity",
+                                       "empty identity fn clause")
+                ident_groups.append(pairs)
             continue
         if "data" in var_reads(e):
-            raise Uncompilable("join: data reference outside generator")
+            raise Uncompilable("join-data", "data reference outside generator")
         # fresh-var assignments side with their rhs (the bound lhs is a
         # definition, not a cross-side read)
         if not lit.negated and isinstance(e, (A.Assign, A.Unify)) and \
@@ -517,19 +659,39 @@ def _compile_clause(rule: A.Rule, rules_by_name: dict, idx: int,
             if not lit.negated:
                 inv_vars |= var_reads(e)
             continue
+        # mixed disequality (`name != input.review...name`, or
+        # `not a == b`): an INLINE self-exclusion — exactly a
+        # single-pair identity group (the pair is excluded when the
+        # sides are equal). Inventory-side undefinedness over-fires
+        # (missing sentinel mismatches), never under-fires.
+        neq = None
+        if not lit.negated and isinstance(e, A.BinOp) and e.op == "!=":
+            neq = (e.lhs, e.rhs)
+        elif lit.negated and (isinstance(e, A.Unify) or
+                              (isinstance(e, A.BinOp) and e.op == "==")):
+            neq = (e.lhs, e.rhs)
+        if neq is not None:
+            for a, b in (neq, neq[::-1]):
+                if side_of(a) == "inv" and side_of(b) == "rev":
+                    ident_groups.append([(a, b)])
+                    break
+            else:
+                raise Uncompilable("join-mixed",
+                                   "mixed disequality is not inv != rev")
+            continue
         # mixed: must be a join equality with one pure side each
         if lit.negated or not isinstance(e, (A.BinOp, A.Unify)) or \
                 (isinstance(e, A.BinOp) and e.op != "=="):
-            raise Uncompilable("join: unsupported mixed literal")
+            raise Uncompilable("join-mixed", "unsupported mixed literal")
         for a, b in ((e.lhs, e.rhs), (e.rhs, e.lhs)):
             if side_of(a) == "inv" and side_of(b) == "rev":
                 join_pairs.append((a, b))
                 break
         else:
-            raise Uncompilable("join: mixed literal is not inv==rev")
+            raise Uncompilable("join-mixed", "mixed literal is not inv==rev")
 
     if not join_pairs:
-        raise Uncompilable("join: no join predicate")
+        raise Uncompilable("join-shape", "no join predicate")
 
     # ---- synthesized rules ------------------------------------------
     path_tuple = A.ArrayLit(tuple(A.Var(v) for v in path_vars))
@@ -537,28 +699,31 @@ def _compile_clause(rule: A.Rule, rules_by_name: dict, idx: int,
     rev_key = A.ArrayLit(tuple(p[1] for p in join_pairs))
 
     rk = f"{REV_KEYS}_{idx}"
-    ri = f"{REV_IDENT}_{idx}" if ident_pairs else None
     ie = f"{INV_ENTRIES}_{idx}"
-    ii = f"{INV_IDENT}_{idx}" if ident_pairs else None
 
     new_rules.append(A.Rule(name=rk, kind="partial_set", key=rev_key,
                             body=tuple(rev_lits)))
-    if ident_pairs:
-        new_rules.append(A.Rule(
-            name=ri, kind="complete",
-            value=A.ArrayLit(tuple(p[1] for p in ident_pairs)), body=()))
     new_rules.append(A.Rule(
         name=ie, kind="partial_set",
         key=A.ArrayLit((path_tuple, inv_key)),
         body=(gen_lit,) + tuple(inv_lits)))
-    if ident_pairs:
+    ris: list = []
+    iis: list = []
+    for g, pairs in enumerate(ident_groups):
+        ri = f"{REV_IDENT}_{idx}_{g}"
+        ii = f"{INV_IDENT}_{idx}_{g}"
+        ris.append(ri)
+        iis.append(ii)
+        new_rules.append(A.Rule(
+            name=ri, kind="complete",
+            value=A.ArrayLit(tuple(p[1] for p in pairs)), body=()))
         new_rules.append(A.Rule(
             name=ii, kind="partial_set",
             key=A.ArrayLit((path_tuple,
-                            A.ArrayLit(tuple(p[0] for p in ident_pairs)))),
+                            A.ArrayLit(tuple(p[0] for p in pairs)))),
             body=(gen_lit,) + tuple(inv_lits)))
-    return JoinClause(rev_keys=rk, rev_ident=ri, inv_entries=ie,
-                      inv_ident=ii)
+    return JoinClause(rev_keys=rk, rev_ident=ris, inv_entries=ie,
+                      inv_ident=iis)
 
 
 def compile_join(module: A.Module, kind: str) -> JoinProgram:
@@ -569,7 +734,7 @@ def compile_join(module: A.Module, kind: str) -> JoinProgram:
         rules_by_name.setdefault(r.name, []).append(r)
     vio = rules_by_name.get("violation")
     if not vio:
-        raise Uncompilable("join: no violation rule")
+        raise Uncompilable("join-shape", "no violation rule")
     _rejects_parameters(module)
     from ..rego.codegen import ModuleCompiler
     arg_pure = ModuleCompiler(module).arg_pure
@@ -577,7 +742,7 @@ def compile_join(module: A.Module, kind: str) -> JoinProgram:
     clauses = []
     for idx, r in enumerate(vio):
         if r.kind != "partial_set" or r.key is None:
-            raise Uncompilable("join: violation shape")
+            raise Uncompilable("join-shape", "violation shape")
         clauses.append(_compile_clause(r, rules_by_name, idx, new_rules,
                                        arg_pure))
     prog = JoinProgram(kind=kind,
@@ -620,9 +785,9 @@ class JoinCompiled:
         self._rev_fns = []
         for c in prog.clauses:
             fk = compile_module(prog.module, entry=c.rev_keys)
-            fi = (compile_module(prog.module, entry=c.rev_ident)
-                  if c.rev_ident else None)
-            self._rev_fns.append((fk, fi))
+            fis = tuple(compile_module(prog.module, entry=ri)
+                        for ri in c.rev_ident)
+            self._rev_fns.append((fk, fis))
         # (data_gen, id(inventory_tree)) -> tabs; the tree identity keeps
         # two targets at the same data generation from sharing tables
         self._inv_cache: dict = {}
@@ -639,7 +804,9 @@ class JoinCompiled:
 
     def inv_tables(self, inventory_tree, data_gen) -> list:
         """Per clause: (U sorted unique key sids, CNT objects per key,
-        SIK identity sid when CNT==1 else IK_MULTI, host dict)."""
+        SIK [G, K] per-identity-group sid when CNT==1 else IK_MULTI,
+        host dict). G >= 1 always — a template without an identity fn
+        gets one group of missing sentinels, which never match."""
         cache_key = (data_gen, id(inventory_tree))
         hit = self._inv_cache.get(cache_key)
         # the entry pins the tree, so an id() hit can only be the same
@@ -654,14 +821,17 @@ class JoinCompiled:
             entries = self._interp.eval_rule(
                 self._pkg, c.inv_entries, None,
                 overrides={("inventory",): inventory_tree})
+            G = max(1, len(c.inv_ident))
             idents: dict = {}
-            if c.inv_ident:
+            for g, ii in enumerate(c.inv_ident):
                 iv = self._interp.eval_rule(
-                    self._pkg, c.inv_ident, None,
+                    self._pkg, ii, None,
                     overrides={("inventory",): inventory_tree})
                 if iv is not UNDEF:
                     for path, ident in iv:
-                        idents[path] = self.strtab.intern(
+                        ent = idents.setdefault(
+                            path, [IK_INV_MISSING] * G)
+                        ent[g] = self.strtab.intern(
                             "i:" + json.dumps(thaw(ident), sort_keys=True))
             by_key: dict[int, list] = {}
             if entries is not UNDEF:
@@ -669,16 +839,20 @@ class JoinCompiled:
                 for path, key in entries:
                     per_obj.setdefault(path, set()).add(
                         _canon_sid(self.strtab, key))
+                missing = (IK_INV_MISSING,) * G
                 for path, ksids in per_obj.items():
-                    ik = idents.get(path, IK_INV_MISSING)
+                    ik = tuple(idents.get(path, missing))
                     for ks in ksids:
                         by_key.setdefault(ks, []).append(ik)
             u = np.array(sorted(by_key), dtype=np.int64)
             cnt = np.array([len(by_key[k]) for k in u], dtype=np.int32)
-            sik = np.array([by_key[k][0] if len(by_key[k]) == 1
-                            else IK_MULTI for k in u], dtype=np.int64)
-            host = {int(k): (int(c_), int(s_))
-                    for k, c_, s_ in zip(u, cnt, sik)}
+            sik = np.full((G, len(u)), IK_MULTI, dtype=np.int64)
+            for j, k in enumerate(u):
+                holders = by_key[k]
+                if len(holders) == 1:
+                    sik[:, j] = holders[0]
+            host = {int(k): (int(c_), tuple(int(s) for s in sik[:, j]))
+                    for j, (k, c_) in enumerate(zip(u, cnt))}
             tabs.append((u, cnt, sik, host))
         # stale generations (and their device tensors) can't be reused;
         # drop them so long-running audits don't accumulate tables
@@ -700,24 +874,25 @@ class JoinCompiled:
         return fn(FrozenDict((("review", frz_review),)), frozen_empty)
 
     def review_keys(self, clause_i: int, frz_review) -> tuple:
-        """(key sids list, ident sid) for one review; empty list when the
-        review-side filters fail."""
+        """(key sids list, per-group ident sid tuple) for one review;
+        empty list when the review-side filters fail."""
         from ..rego.interp import UNDEF
         from ..utils.values import FrozenDict
 
-        fk, fi = self._rev_fns[clause_i]
+        fk, fis = self._rev_fns[clause_i]
+        G = max(1, len(fis))
         empty = FrozenDict()
         ks = self._rev_eval(fk, frz_review, empty)
         if ks is UNDEF or not ks:
-            return [], IK_REV_MISSING
+            return [], (IK_REV_MISSING,) * G
         sids = sorted({_canon_sid(self.strtab, k) for k in ks})
-        ik = IK_REV_MISSING
-        if fi is not None:
+        iks = [IK_REV_MISSING] * G
+        for g, fi in enumerate(fis):
             iv = self._rev_eval(fi, frz_review, empty)
             if iv is not UNDEF:
-                ik = self.strtab.intern(
+                iks[g] = self.strtab.intern(
                     "i:" + json.dumps(thaw(iv), sort_keys=True))
-        return sids, ik
+        return sids, tuple(iks)
 
     # ------------------------------------------------------------ fires
 
@@ -735,8 +910,9 @@ class JoinCompiled:
         for ci, (u, cnt, sik, host) in enumerate(tabs):
             if not len(u):
                 continue
+            G = sik.shape[0]
             keys = []
-            iks = np.full(n, IK_REV_MISSING, dtype=np.int32)
+            iks = np.full((n, G), IK_REV_MISSING, dtype=np.int32)
             hmax = 0
             for r in range(n):
                 rv = frz_reviews[r]
@@ -748,7 +924,7 @@ class JoinCompiled:
                         key_cache[(ci, id(rv))] = hit
                 ks, ik = hit
                 keys.append(ks)
-                iks[r] = ik
+                iks[r, :] = ik
                 hmax = max(hmax, len(ks))
             if hmax == 0:
                 continue
@@ -762,8 +938,12 @@ class JoinCompiled:
                         continue
                     for k in keys[r]:
                         hit = host.get(k)
-                        if hit is not None and (hit[0] >= 2
-                                                or hit[1] != iks[r]):
+                        # fires unless the key's single holder is
+                        # identical to the review under SOME group
+                        if hit is not None and (
+                                hit[0] >= 2
+                                or not any(hs == int(ig) for hs, ig
+                                           in zip(hit[1], iks[r]))):
                             out[r] = True
                             break
         return out
@@ -783,6 +963,7 @@ class JoinCompiled:
         # int32 throughout: jax runs with x64 disabled, which would
         # silently truncate int64 inputs (interned sids always fit)
         n = len(keys)
+        G = sik.shape[0]
         h = 1
         while h < hmax:
             h *= 2
@@ -790,7 +971,7 @@ class JoinCompiled:
         while kb < len(u):
             kb *= 2
         ent = self._dev_inv_cache.get(ci)
-        if ent is not None and ent[0] == inv_key and ent[1] == kb:
+        if ent is not None and ent[0] == inv_key and ent[1] == (kb, G):
             inv_args = ent[2]
         else:
             big = np.iinfo(np.int32).max
@@ -798,10 +979,10 @@ class JoinCompiled:
             u_p[:len(u)] = u
             cnt_p = np.zeros(kb, dtype=np.int32)
             cnt_p[:len(u)] = cnt
-            sik_p = np.full(kb, IK_MULTI, dtype=np.int32)
-            sik_p[:len(u)] = sik
+            sik_p = np.full((G, kb), IK_MULTI, dtype=np.int32)
+            sik_p[:, :len(u)] = sik
             inv_args = tuple(jax.device_put(a) for a in (u_p, cnt_p, sik_p))
-            self._dev_inv_cache[ci] = (inv_key, kb, inv_args)
+            self._dev_inv_cache[ci] = (inv_key, (kb, G), inv_args)
 
         karr = np.full((n, h), KEY_PAD, dtype=np.int32)
         for r, ks in enumerate(keys):
@@ -827,8 +1008,11 @@ class JoinCompiled:
                 pos = jnp.searchsorted(u_p, karr)
                 pos = jnp.clip(pos, 0, u_p.shape[0] - 1)
                 found = (u_p[pos] == karr) & (karr != KEY_PAD)
-                fire = found & ((cnt_p[pos] >= 2)
-                                | (sik_p[pos] != iks[:, None]))
+                # identical under SOME identity group blocks the fire;
+                # sik_p is [G, Kb], iks is [N, G]
+                ident_any = jnp.any(
+                    sik_p[:, pos] == iks.T[:, :, None], axis=0)
+                fire = found & ((cnt_p[pos] >= 2) | ~ident_any)
                 return jnp.any(fire, axis=1)
             if self.aot is not None:
                 from .aot import AotJit

@@ -169,6 +169,14 @@ class MatchTables:
         self._packed_cache: np.ndarray | None = None
         self._packed_key: tuple | None = None
         self._custom: dict[str, Any] = {}  # op -> fn(pattern, strings)->bool[]
+        # per-materialize window caches: (built, V) -> decoded string
+        # list / fixed-width unicode array. Without these, every row
+        # re-decodes the same vocab window (O(rows × vocab) Python), and
+        # the string-family ops loop per string; with them, decoding is
+        # amortized across rows and startswith/endswith/contains/eq run
+        # as numpy C loops over the whole window at once
+        self._win_strs: dict[tuple[int, int], list] = {}
+        self._win_arr: dict[tuple[int, int], np.ndarray] = {}
 
     def register_op(self, op: str, fn) -> None:
         """Custom predicate op (interpreter-backed binary helpers,
@@ -205,8 +213,8 @@ class MatchTables:
         materialize()'s per-row loop."""
         groups: dict[int, list[int]] = {}
         for r, (op, pattern) in enumerate(self._patterns):
-            if op == "re_match" and isinstance(pattern, str) and \
-                    self._built_len[r] < V:
+            if op in ("re_match", "glob") and isinstance(pattern, str) \
+                    and self._built_len[r] < V:
                 groups.setdefault(self._built_len[r], []).append(r)
         if not groups:
             return
@@ -219,13 +227,17 @@ class MatchTables:
             progs = []
             prog_rows = []
             for r in rows:
-                prog = regex_nfa.try_compile_device(self._patterns[r][1])
+                op, pattern = self._patterns[r]
+                # glob rows ride the same device scan as regex rows via
+                # their anchored-regex translation
+                rx = self.glob_regex(pattern) if op == "glob" else pattern
+                prog = regex_nfa.try_compile_device(rx)
                 if prog is not None:
                     progs.append(prog)
                     prog_rows.append(r)
             if n_new * len(prog_rows) < regex_nfa.DEVICE_CROSSOVER:
                 continue
-            strings = [self.table.string(i) for i in range(built, V)]
+            strings = self._window(built, V)
             # strings the byte matrix can't represent faithfully (NUL
             # markers like the pad entry / canon-num prefix are fine to
             # blank here and fix below; oversize or non-ascii strings
@@ -240,17 +252,71 @@ class MatchTables:
             res = regex_nfa.scan_device(progs, regex_nfa.bytes_matrix(clean))
             for j, r in enumerate(prog_rows):
                 row = np.array(res[j])  # jax outputs are read-only
-                pattern = self._patterns[r][1]
+                op, pattern = self._patterns[r]
+                rx = self.glob_regex(pattern) if op == "glob" else pattern
                 for k in special:
-                    row[k] = re.search(pattern, strings[k]) is not None
+                    row[k] = re.search(rx, strings[k]) is not None
                 if built == 0:
                     row[0] = False  # pad entry never matches
                 self._data[r] = np.concatenate([self._data[r], row])
                 self._built_len[r] = V
 
-    def _eval(self, op: str, pattern: str, strings: list[str]) -> np.ndarray:
+    def _window(self, built: int, V: int) -> list:
+        """Decoded vocab strings [built, V), shared across rows."""
+        key = (built, V)
+        win = self._win_strs.get(key)
+        if win is None:
+            if len(self._win_strs) > 8:  # windows die with their epoch
+                self._win_strs.clear()
+                self._win_arr.clear()
+            win = [self.table.string(i) for i in range(built, V)]
+            self._win_strs[key] = win
+        return win
+
+    # fixed-width unicode arrays cost O(window × max_len); past this
+    # length the vectorization win can't pay for the padding memory
+    MAX_VECTOR_STRLEN = 512
+
+    def _window_arr(self, built: int, V: int, strings: list[str]):
+        """Fixed-width unicode array of the window, for the vectorized
+        string-family ops (np.char runs the comparison as one C loop
+        instead of a Python generator per row). None when an oversize
+        string makes the padded array a bad trade — callers then keep
+        the per-string host path."""
+        key = (built, V)
+        if key in self._win_arr:
+            return self._win_arr[key]
+        arr = None
+        if strings:
+            if max(len(s) for s in strings) <= self.MAX_VECTOR_STRLEN:
+                arr = np.array(strings, dtype=str)
+        else:
+            arr = np.zeros(0, dtype="U1")
+        self._win_arr[key] = arr
+        return arr
+
+    @staticmethod
+    def glob_regex(pattern: str) -> str:
+        """Image-ref style glob ('*' wildcard only) as an anchored
+        regex — the single source of truth for both the host path and
+        the device NFA batch."""
+        return ("^" + ".*".join(re.escape(p) for p in pattern.split("*"))
+                + "$")
+
+    def _eval(self, op: str, pattern: str, strings: list[str],
+              arr: np.ndarray | None = None) -> np.ndarray:
         if op in self._custom:
             return np.asarray(self._custom[op](pattern, strings), dtype=bool)
+        if op in ("startswith", "endswith", "contains", "eq") and \
+                arr is not None:
+            if op == "startswith":
+                return np.char.startswith(arr, pattern)
+            if op == "endswith":
+                return np.char.endswith(arr, pattern)
+            if op == "contains":
+                return np.char.find(arr, pattern) >= 0 if pattern else \
+                    np.ones(len(strings), dtype=bool)
+            return arr == pattern
         if op == "startswith":
             return np.fromiter((s.startswith(pattern) for s in strings),
                                dtype=bool, count=len(strings))
@@ -270,10 +336,8 @@ class MatchTables:
                 return np.zeros(len(strings), dtype=bool)
             return np.fromiter((rx.search(s) is not None for s in strings),
                                dtype=bool, count=len(strings))
-        if op == "glob":  # image-ref style glob: '*' wildcard only
-            rx = re.compile(
-                "^" + ".*".join(re.escape(p) for p in pattern.split("*")) + "$"
-            )
+        if op == "glob":
+            rx = re.compile(self.glob_regex(pattern))
             return np.fromiter((rx.search(s) is not None for s in strings),
                                dtype=bool, count=len(strings))
         raise ValueError(f"unknown match op {op!r}")
@@ -314,8 +378,9 @@ class MatchTables:
         for r, (op, pattern) in enumerate(self._patterns):
             built = self._built_len[r]
             if built < V:
-                new = self._eval(op, pattern,
-                                 [self.table.string(i) for i in range(built, V)])
+                strings = self._window(built, V)
+                arr = self._window_arr(built, V, strings)
+                new = self._eval(op, pattern, strings, arr=arr)
                 if built == 0:
                     # row 0 of the vocab is the pad entry: never matches
                     new[0] = False
