@@ -254,6 +254,10 @@ def main() -> None:
         "sweep_wall_s": round(sweep_s, 4),
         "match_s": round(match_s, 3),
         "materialize_s": round(mat_s, 3),
+        # ROADMAP item 3's gate: <= 1.0 means the steady audit is
+        # sweep-bound (message materialization no longer dominates)
+        "materialize_vs_sweep":
+            round(mat_s / sweep_s, 2) if sweep_s > 0 else None,
         "evals_per_sec_per_chip": round(evals_per_sec),
         "first_audit_s": round(first_audit_s, 2),
         # cold restart (no cache volume) vs warm restart (populated XLA

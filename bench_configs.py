@@ -98,6 +98,44 @@ def steady_audit(client, iters=3):
     return best, first, len(resp.results())
 
 
+def audit_phase_breakdown(drv, client, iters=2) -> dict:
+    """Per-phase attribution of one full (non-delta) steady sweep:
+    sweep_wall_s (time blocked on the device), materialize_s (violation
+    message assembly), status_write_s (streamed constraint-status
+    publishing — 0 without an audit manager), and the headline
+    materialize_vs_sweep ratio (ROADMAP item 3's gate: <= 1.0 means
+    the steady audit is sweep-bound, not host-bound). The results
+    delta cache is dropped per iteration so the full pipeline runs."""
+    from gatekeeper_tpu.utils import profiling
+
+    best: dict = {}
+    best_wall = float("inf")
+    for _ in range(iters):
+        drop = getattr(drv, "_audit_results_cache", None)
+        if drop is not None:
+            drop.clear()
+        snap0 = profiling.timers().snapshot()
+        t0 = time.time()
+        client.audit()
+        wall = time.time() - t0
+        phases = profiling.PhaseTimers.diff(snap0,
+                                            profiling.timers().snapshot())
+        if wall < best_wall:
+            best_wall = wall
+            best = phases
+    sweep = best.get("device_sweep", 0.0)
+    mat = best.get("materialize", 0.0)
+    return {
+        "full_sweep_wall_s": round(best_wall, 4),
+        "sweep_wall_s": round(sweep, 4),
+        "materialize_s": round(mat, 4),
+        "status_write_s": round(best.get("status_write", 0.0), 4),
+        "materialize_vs_sweep":
+            round(mat / sweep, 2) if sweep > 0 else None,
+        "interp_eval_s": round(best.get("interp_eval", 0.0), 4),
+    }
+
+
 # --------------------------------------------------------------- config 1
 
 
@@ -235,12 +273,14 @@ def config2():
     for o in synth_mixed_objects(n):
         client.add_data(o)
     audit_s, first, nres = steady_audit(client)
+    phases = audit_phase_breakdown(drv, client)
     print(json.dumps({
         "config": 2, "metric": "audit_wall_clock_s",
         "value": round(audit_s, 3),
         "unit": f"s (full general library, {len(GENERAL_CONSTRAINTS)} "
                 f"constraints x {n} mixed objects, steady state)",
         "first_audit_s": round(first, 2), "violations": nres,
+        **phases,
         **compiled_coverage(drv, client),
     }))
 
@@ -350,6 +390,7 @@ def config3():
     for o in synth_pods_psp(n):
         client.add_data(o)
     audit_s, first, nres = steady_audit(client)
+    phases = audit_phase_breakdown(drv, client)
     # the tentpole's tracked number: cold restart (no cache volume) vs
     # warm restart (populated XLA cache + AOT program store) first
     # audit, each in a fresh subprocess
@@ -361,6 +402,7 @@ def config3():
                 f"{len(PSP_CONSTRAINTS)} constraints x {n} pods, "
                 f"steady state)",
         "first_audit_s": round(first, 2), "violations": nres,
+        **phases,
         **compiled_coverage(drv, client),
         **coldwarm,
     }))

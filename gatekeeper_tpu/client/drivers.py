@@ -126,6 +126,12 @@ class RegoDriver:
         self._patch_notes: list = []
         self._con_rev = 0  # constraint-store revision (ns-selector cache)
         self._ns_sel_cache: tuple = (None, False)
+        # per-constraint violation cap applied BEFORE message
+        # materialization (control/audit.py arms it with its status
+        # violations limit): pairs beyond the cap for their constraint
+        # still count toward totals but skip message assembly — capped
+        # constraints stop paying for messages that are never published
+        self.audit_violations_cap: Optional[int] = None
 
     # ------------------------------------------------------------- modules
 
@@ -689,24 +695,52 @@ class RegoDriver:
             ))
         return results
 
+    def _vec_msgs(self, target: str, kind: str, cons: list,
+                  pair_reviews: list, rows, cols, cand):
+        """Vectorized per-pair message assembly hook. The base driver
+        has no encoded columns: always the exact path. TpuDriver
+        overrides with the ir/vecmat.py plan evaluator, returning
+        (status[P] int8, msgs[P], details) — status 1 = message ready,
+        0 = veto (exact evaluator), 2 = provably no violation."""
+        return None
+
     def materialize_pairs(self, target: str, cons: list, pair_reviews: list,
-                          rows, cols, inventory: Any) -> list[Result]:
+                          rows, cols, inventory: Any,
+                          cand=None) -> list[Result]:
         """Batched exact materialization of firing (review, constraint)
         pairs, row-major. Semantically identical to calling
         _eval_template_violations per pair (the audit differential tests
-        assert that), but hoists per-constraint context (frozen params,
-        enforcement, plain copy, params-memo) and per-review context
-        (frozen review, review-memo) out of the pair loop, and caches
-        thawed msg/details per distinct violation object — the
-        head-witness memo makes those shared across pairs, so the
-        million-pair audit tail thaws each distinct witness once.
-        Results share constraint/details structures (callers treat
-        results as read-only, as they already must for .constraint)."""
+        assert that), but:
+
+          * kinds with a message plan (ir/vecmat.py) render their
+            messages VECTORIZED — one numpy assembly pass over the
+            already-built witness columns instead of one evaluator call
+            per pair — with per-pair fallback to the exact evaluator
+            for witnesses outside the plan's subset (the differential
+            suite asserts bit-equal messages either way);
+          * the exact path hoists per-constraint context (frozen
+            params, enforcement, plain copy, params-memo) and
+            per-review context (frozen review, review-memo) out of the
+            pair loop, and caches thawed msg/details per distinct
+            violation object;
+          * with audit_violations_cap armed, vectorized pairs past the
+            cap for their constraint emit count-only results (empty
+            msg) — the status writer never publishes past its limit,
+            so the messages were pure waste.
+
+        `cand`, when given, maps pair rows to global inventory-review
+        indices (rows index pair_reviews == [reviews[i] for i in
+        cand]), letting witness columns cache across sweeps on the
+        stable full review list. Results share constraint/details
+        structures (callers treat results as read-only, as they
+        already must for .constraint)."""
         if not len(rows):
             return []
         kind = cons[0].get("kind")
+        vec = self._vec_msgs(target, kind, cons, pair_reviews, rows, cols,
+                             cand)
         fn = self._codegen_for(target, kind)
-        if fn is None:
+        if fn is None and vec is None:
             out: list[Result] = []
             for ri, ci in zip(rows, cols):
                 c = cons[int(ci)]
@@ -749,7 +783,7 @@ class RegoDriver:
             hm = self._hmemo[kind] = {}
         elif len(hm) > 500_000:
             hm.clear()
-        sections = fn.__sections__
+        sections = fn.__sections__ if fn is not None else None
         vcache: dict[int, tuple] = {}  # id(violation) -> (msg, details)
         out = []
         append = out.append
@@ -761,7 +795,48 @@ class RegoDriver:
         # element extraction and they are slow dict keys
         rows = rows.tolist() if hasattr(rows, "tolist") else rows
         cols = cols.tolist() if hasattr(cols, "tolist") else cols
-        for ri, ci in zip(rows, cols):
+        vec_status = vec_msgs = vec_details = None
+        if vec is not None:
+            vec_status, vec_msgs, vec_details = vec
+        # the cap applies only inside a full audit sweep (the flag is
+        # set by the sweep entry point): what-if previews and direct
+        # pair materialization stay uncapped
+        cap = (self.audit_violations_cap
+               if getattr(self, "_in_audit_sweep", False) else None)
+        # per-call cap counters: blocks of one sweep each materialize at
+        # most `cap` messages per constraint, so the sweep's global
+        # first `cap` per constraint are always fully materialized even
+        # when mesh blocks reassemble out of materialization order
+        cap_counts: dict[int, int] = {}
+        n_vec = n_capped = 0
+        for j, (ri, ci) in enumerate(zip(rows, cols)):
+            if vec_status is not None:
+                st = vec_status[j]
+                if st == 2:  # msg witness undefined for this constraint:
+                    continue  # the head binding fails — no violation
+                if st == 1:
+                    n_vec += 1
+                    if cap is not None:
+                        seen = cap_counts.get(ci, 0)
+                        cap_counts[ci] = seen + 1
+                        if seen >= cap:
+                            n_capped += 1
+                            append(Result(
+                                msg="",
+                                metadata={"details": {}},
+                                constraint=plain[ci],
+                                review=pair_reviews[ri],
+                                enforcement_action=enforce[ci],
+                            ))
+                            continue
+                    append(Result(
+                        msg=vec_msgs[j],
+                        metadata={"details": vec_details},
+                        constraint=plain[ci],
+                        review=pair_reviews[ri],
+                        enforcement_action=enforce[ci],
+                    ))
+                    continue
             if ri != cur_ri:
                 cur_ri = ri
                 review = pair_reviews[ri]
@@ -771,7 +846,7 @@ class RegoDriver:
                     ent = (review, {})
                     self._rmemo[kind] = ent
                 rmemo = ent[1]
-            if fn is None:  # demoted mid-batch: stay on the fallback
+            if fn is None:  # demoted mid-batch / no codegen: exact path
                 out.extend(self._eval_template_violations(
                     target, cons[ci], review, enforce[ci], inventory,
                     None))
@@ -822,6 +897,17 @@ class RegoDriver:
                     review=review,
                     enforcement_action=enforce[ci],
                 ))
+        try:
+            from ..control.metrics import report_materialize_pairs
+
+            n_skip = (int((vec_status == 2).sum())
+                      if vec_status is not None else 0)
+            report_materialize_pairs("vectorized", n_vec - n_capped)
+            report_materialize_pairs("capped", n_capped)
+            report_materialize_pairs("exact",
+                                     len(rows) - n_vec - n_skip)
+        except Exception:  # metrics backend optional in embedders
+            pass
         return out
 
     # ---------------------------------------------------------- store views

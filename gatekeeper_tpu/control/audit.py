@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 from ..client import Client
 from ..utils import profiling
@@ -616,6 +616,113 @@ class InventoryTracker:
             cancel()
 
 
+class _KindStatusWriter:
+    """Streaming constraint-status publisher for one interval sweep.
+
+    The driver fires on_kind_results as each kind's sweep completes
+    (delta-served, device-consumed, or interpreter); this writer drains
+    those per-kind result batches on its own thread and issues the
+    kind's delta'd status PATCHes IMMEDIATELY — so status API I/O
+    overlaps the remaining kinds' device sweeps instead of forming one
+    post-sweep assembly pass. Kinds it publishes are excluded from the
+    post-sweep write pass; anything it failed on (API error, handler
+    error) is left unstreamed so the post-sweep pass covers it."""
+
+    # sentinel: live-pod set not resolved yet (computed on the writer
+    # thread — a kube.list on the sweep thread before the tracker
+    # drain would widen the event-drain race window)
+    _UNRESOLVED = object()
+
+    def __init__(self, manager: "AuditManager", force: bool):
+        import queue
+
+        self.manager = manager
+        self.force = force
+        self.live_pods: Any = self._UNRESOLVED
+        self.q: Any = queue.Queue()
+        self.written = 0
+        self.skipped = 0
+        self.pruned = 0
+        self.wall_s = 0.0
+        self.kinds: set = set()     # fully published kinds
+        self._seen: set = set()     # kinds already streamed once
+        self._thread: Optional[threading.Thread] = None
+        self._finished = False
+
+    def on_kind(self, target: str, kind: str, results: list) -> None:
+        """Driver-thread callback: enqueue only (the sweep must never
+        wait on status I/O). The writer thread spawns on first use so
+        an armed-but-empty sweep costs nothing."""
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="audit-status-stream")
+            self._thread.start()
+        self.q.put((target, kind, list(results)))
+
+    def _run(self) -> None:
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            if self.live_pods is self._UNRESOLVED:
+                self.live_pods = (self.manager._live_pod_ids()
+                                  if self.manager.gc_stale_statuses
+                                  else None)
+            target, kind, results = item
+            t0 = time.time()
+            try:
+                if kind in self._seen:
+                    # a second target re-audited this kind: the first
+                    # streamed write covered only its own target's
+                    # results — un-stream the kind so the post-sweep
+                    # pass re-writes it from the cross-target union
+                    self.kinds.discard(kind)
+                    continue
+                self._seen.add(kind)
+                handler = self.manager.opa.targets.get(target)
+                if handler is not None:
+                    memo: dict = {}
+                    for r in results:
+                        handler.handle_violation(r, memo)
+                by_con = self.manager._group_by_constraint(results)
+                w, s, p = self.manager._write_kind_status(
+                    kind, by_con, force=self.force,
+                    live_pods=self.live_pods)
+                if w is None:
+                    continue  # list failed / breaker: post-sweep covers
+                self.written += w
+                self.skipped += s
+                self.pruned += p
+                self.kinds.add(kind)
+            except Exception as e:
+                # post-sweep pass repairs whatever this missed
+                log.error("streamed status write failed; post-sweep "
+                          "pass will cover the kind",
+                          details={"kind": kind, "error": str(e)})
+            finally:
+                dt = time.time() - t0
+                self.wall_s += dt
+                profiling.timers().add("status_write", dt)
+
+    def finish(self) -> set:
+        """Drain, stop, and return the fully-published kinds.
+        Idempotent: the sweep's finally calls it again on the error
+        path so a raising evaluation cannot leak the writer thread."""
+        if self._finished or self._thread is None:
+            self._finished = True
+            return set(self.kinds) if self._thread is not None else set()
+        self._finished = True
+        self.q.put(None)
+        self._thread.join(timeout=300)
+        if self._thread.is_alive():
+            # a wedged write must not also wedge the sweep epilogue —
+            # fall back to the post-sweep pass for everything
+            log.error("streamed status writer stalled; post-sweep pass "
+                      "re-writes every kind")
+            return set()
+        return set(self.kinds)
+
+
 class AuditManager:
     def __init__(self, kube, opa: Client,
                  interval: float = DEFAULT_AUDIT_INTERVAL,
@@ -628,7 +735,8 @@ class AuditManager:
                  gc_stale_statuses: bool = True,
                  stream_audit: bool = False,
                  stream_window_s: float = DEFAULT_STREAM_WINDOW_S,
-                 stream_max_batch: int = DEFAULT_STREAM_MAX_BATCH):
+                 stream_max_batch: int = DEFAULT_STREAM_MAX_BATCH,
+                 stream_status_writes: bool = True):
         self.kube = kube
         self.opa = opa
         self.interval = interval
@@ -668,6 +776,11 @@ class AuditManager:
         self.stream_audit = stream_audit and incremental
         self.stream_window_s = max(0.0, stream_window_s)
         self.stream_max_batch = max(1, stream_max_batch)
+        # streaming status publishing: interval sweeps write each
+        # kind's constraint statuses AS ITS SWEEP COMPLETES (driver
+        # on_kind_results hook) instead of one post-sweep pass, so
+        # write I/O overlaps the remaining kinds' device sweeps
+        self.stream_status_writes = stream_status_writes
         self._stream_thread: Optional[threading.Thread] = None
         self._stream_cv = threading.Condition()
         self._stream_signal = False
@@ -844,10 +957,18 @@ class AuditManager:
             event_ts = stats.pop("event_ts", None) or []
             if stats["dirty"] == 0 and not event_ts:
                 return  # pure no-op events (rv echoes)
+            drv = getattr(self.opa, "driver", None)
+            cap_armed = hasattr(drv, "audit_violations_cap")
+            if cap_armed:
+                drv.audit_violations_cap = self.limit
             tr = gtrace.TRACER.start(gtrace.AUDIT)
             try:
                 with tr.span("evaluate"):
-                    results = self.opa.audit().results()
+                    try:
+                        results = self.opa.audit().results()
+                    finally:
+                        if cap_armed:
+                            drv.audit_violations_cap = None
                 by_constraint = self._group_by_constraint(results)
                 # delta against the last published fingerprints: only
                 # kinds whose violation sets moved get listed/compared
@@ -983,7 +1104,49 @@ class AuditManager:
         timers = profiling.timers()
         phases0 = timers.snapshot()
         sweep_stats: dict = {}
+        # streaming status publishing: arm the driver's per-kind
+        # completion hook so each kind's constraint statuses PATCH
+        # while later kinds are still sweeping on the device. The
+        # force decision must be made BEFORE the sweep (it matches the
+        # full-resync cadence _audit_incremental computes from the
+        # same counter).
+        driver = getattr(self.opa, "driver", None)
+        writer: Optional[_KindStatusWriter] = None
+        would_force = (not self.incremental or self._sweeps == 0
+                       or (self.full_resync_every > 0
+                           and self._sweeps % self.full_resync_every
+                           == 0))
+        if (self.stream_status_writes
+                and (self.incremental or self.audit_from_cache)
+                and hasattr(driver, "on_kind_results")
+                and (self.leader_check is None or self.leader_check())
+                and not (self.write_breaker is not None
+                         and self.write_breaker.is_open)):
+            writer = _KindStatusWriter(self, would_force)
+            driver.on_kind_results = writer.on_kind
+        # per-constraint violations cap, armed for THIS sweep only:
+        # direct client.audit() callers and previews that share the
+        # driver stay uncapped (materialize counts every pair either
+        # way; past the cap only the message assembly is skipped)
+        cap_armed = hasattr(driver, "audit_violations_cap")
+        if cap_armed:
+            driver.audit_violations_cap = self.limit
         t_ev0 = time.monotonic()
+        try:
+            return self._audit_eval_and_publish(tr, t0, t_ev0, timers,
+                                                phases0, sweep_stats,
+                                                writer)
+        finally:
+            if cap_armed:
+                driver.audit_violations_cap = None
+            if writer is not None:
+                driver.on_kind_results = None
+                # error-path backstop: a raising evaluation must not
+                # leak the writer thread (finish is idempotent)
+                writer.finish()
+
+    def _audit_eval_and_publish(self, tr, t0, t_ev0, timers, phases0,
+                                sweep_stats, writer) -> list:
         if self.incremental:
             results, sweep_stats = self._audit_incremental(tr)
             ev_wall = sweep_stats.pop("_eval_wall_s", 0.0)
@@ -996,14 +1159,25 @@ class AuditManager:
             results = self._audit_resources()
             ev_wall = time.monotonic() - t_ev0
             metrics.report_audit_sweep("full")
+        # streamed per-kind status writes ride the sweep itself: wait
+        # them out first so their wall time and published-kind set are
+        # final before the post-sweep pass
+        streamed_kinds: set = set()
+        stream_write_s = 0.0
+        if writer is not None:
+            streamed_kinds = writer.finish()
+            stream_write_s = writer.wall_s
         # phase attribution, double-count-free: when the driver
         # instrumented its internals (encode / device_sweep /
         # materialize / interp_eval / delta_serve — all inside the
         # evaluation wall), the trace records THOSE plus the
         # uncovered remainder as evaluate_other, so stages sum to the
         # sweep. An uninstrumented driver records one aggregate
-        # evaluate span instead.
+        # evaluate span instead. status_write accrues on the streaming
+        # writer's OWN thread (overlapping the sweep) — it is reported
+        # as its own phase, never subtracted from the eval wall.
         phases = profiling.PhaseTimers.diff(phases0, timers.snapshot())
+        phases.pop("status_write", None)
         if phases:
             for name, secs in sorted(phases.items()):
                 tr.add_phase(name, secs)
@@ -1012,6 +1186,8 @@ class AuditManager:
                 tr.add_phase("evaluate_other", residual)
         elif ev_wall > 0:
             tr.add_phase("evaluate", ev_wall)
+        if stream_write_s > 0:
+            tr.add_phase("status_write_stream", stream_write_s)
         by_constraint = self._group_by_constraint(results)
         # delta'd status writes are an INCREMENTAL-mode behavior: the
         # discovery and from-cache modes keep upstream semantics (every
@@ -1020,9 +1196,29 @@ class AuditManager:
         # timestamp still refreshes every full_resync_every intervals
         force_writes = (not self.incremental
                         or sweep_stats.get("sweep") == "full_resync")
+        # reuse the streamed writer's resolved live-pod set: the
+        # post-sweep pass must not pay a second cluster-wide pod list
+        lp = self._LIVE_PODS_UNSET
+        if writer is not None and \
+                writer.live_pods is not _KindStatusWriter._UNRESOLVED:
+            lp = writer.live_pods
+        t_w0 = time.monotonic()
         with tr.span("status_writes"):
-            writes = self._write_audit_results(by_constraint,
-                                               force=force_writes)
+            writes = self._write_audit_results(
+                by_constraint, force=force_writes,
+                exclude_kinds=streamed_kinds or None, live_pods=lp)
+        if writer is not None:
+            writes["status_writes"] = (writes.get("status_writes", 0)
+                                       + writer.written)
+            writes["status_skipped"] = (writes.get("status_skipped", 0)
+                                        + writer.skipped)
+            if writer.pruned:
+                writes["status_gc"] = (writes.get("status_gc", 0)
+                                       + writer.pruned)
+            if streamed_kinds:
+                writes["status_streamed_kinds"] = len(streamed_kinds)
+        sweep_stats["status_write_s"] = round(
+            stream_write_s + (time.monotonic() - t_w0), 4)
         # a full interval sweep (re)establishes the streaming delta
         # baseline — unless the breaker deferred the writes, in which
         # case what is published remains unknown
@@ -1264,9 +1460,46 @@ class AuditManager:
             grouped.setdefault(key, []).append(r)
         return grouped
 
+    def _write_kind_status(self, kind: str, by_constraint: dict,
+                           force: bool, live_pods) -> tuple:
+        """List + delta-compare + write ONE kind's constraint statuses.
+        Returns (written, skipped, pruned), or (None, 0, 0) when the
+        kind could not be covered (list failure / breaker open) so the
+        caller leaves it for a later pass."""
+        if self.write_breaker is not None and self.write_breaker.is_open:
+            return (None, 0, 0)
+        gvk = (CONSTRAINT_GROUP, "v1beta1", kind)
+        try:
+            constraints = self.kube.list(gvk)
+        except KubeError:
+            return (None, 0, 0)
+        written = skipped = pruned = 0
+        for obj in constraints:
+            self.heartbeat = time.monotonic()  # progress per write
+            name = (obj.get("metadata") or {}).get("name") or ""
+            violations = by_constraint.get((kind, name), [])
+            entries = self._status_entries(violations)
+            gced = live_pods is not None and \
+                prune_stale_by_pod(obj, live_pods)
+            pruned += 1 if gced else 0
+            cur = obj.get("status") or {}
+            if not force and not gced and \
+                    cur.get("totalViolations") == len(violations) \
+                    and (cur.get("violations") or []) == entries:
+                skipped += 1
+                continue
+            if self._update_constraint_status(obj, entries,
+                                              len(violations)):
+                written += 1
+        return (written, skipped, pruned)
+
+    _LIVE_PODS_UNSET = object()
+
     def _write_audit_results(self, by_constraint: dict[tuple, list],
                              force: bool = False,
-                             kinds: Optional[set] = None) -> dict:
+                             kinds: Optional[set] = None,
+                             exclude_kinds: Optional[set] = None,
+                             live_pods=_LIVE_PODS_UNSET) -> dict:
         """status.byPod[audit] style update with cap + truncation + retry
         (manager.go:428-574). Constraints with no violations this run get
         their violation list cleared — but a constraint whose CURRENT
@@ -1295,31 +1528,23 @@ class AuditManager:
             # passes None and still covers everything, so external
             # clobbers of untouched kinds heal there, as drift)
             target_kinds &= kinds
-        live_pods = self._live_pod_ids() if self.gc_stale_statuses else None
+        if exclude_kinds:
+            # already published mid-sweep by the streaming status
+            # writer: re-listing them here would double the API load
+            target_kinds -= exclude_kinds
+        if live_pods is self._LIVE_PODS_UNSET:
+            live_pods = (self._live_pod_ids()
+                         if self.gc_stale_statuses else None)
         written = skipped = pruned = 0
         for kind in sorted(target_kinds):
-            gvk = (CONSTRAINT_GROUP, "v1beta1", kind)
-            try:
-                constraints = self.kube.list(gvk)
-            except KubeError:
+            w, s, p = self._write_kind_status(kind, by_constraint,
+                                              force=force,
+                                              live_pods=live_pods)
+            if w is None:
                 continue
-            for obj in constraints:
-                self.heartbeat = time.monotonic()  # progress per write
-                name = (obj.get("metadata") or {}).get("name") or ""
-                violations = by_constraint.get((kind, name), [])
-                entries = self._status_entries(violations)
-                gced = live_pods is not None and \
-                    prune_stale_by_pod(obj, live_pods)
-                pruned += 1 if gced else 0
-                cur = obj.get("status") or {}
-                if not force and not gced and \
-                        cur.get("totalViolations") == len(violations) \
-                        and (cur.get("violations") or []) == entries:
-                    skipped += 1
-                    continue
-                if self._update_constraint_status(obj, entries,
-                                                  len(violations)):
-                    written += 1
+            written += w
+            skipped += s
+            pruned += p
         pruned += self._gc_template_statuses(live_pods)
         metrics.report_audit_status_writes(written, skipped)
         out = {"status_writes": written, "status_skipped": skipped}

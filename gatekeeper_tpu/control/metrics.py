@@ -731,6 +731,26 @@ def report_audit_sweep(path: str) -> None:
                          "Audit sweeps by evaluation path", path=path)
 
 
+def report_materialize_pairs(path: str, n: int) -> None:
+    """Firing (review, constraint) pairs the audit materialized, by
+    message path: "vectorized" (numpy plan assembly, ir/vecmat.py),
+    "exact" (per-pair evaluator — plan-less kinds and vetoed pairs),
+    "capped" (past the per-constraint status cap: counted, message
+    skipped)."""
+    if n > 0:
+        REGISTRY.counter_add("gatekeeper_tpu_audit_materialize_pairs_total",
+                             "Materialized firing pairs by message path",
+                             float(n), path=path)
+
+
+def report_msg_template_cache(outcome: str) -> None:
+    """Message-plan cache lookup for one materialize batch: "hit" (plan
+    reused), "miss" (plan compiled from the template head this call)."""
+    REGISTRY.counter_add("gatekeeper_tpu_audit_msg_template_cache_total",
+                         "Message-template plan cache lookups",
+                         outcome=outcome)
+
+
 def report_audit_dirty(dirty: int, total: int, vocab_grown: int = 0) -> None:
     """Incremental audit delta stats: dirty-set size, tracked inventory
     size, encoded-row cache hit ratio, and vocab growth this sweep."""

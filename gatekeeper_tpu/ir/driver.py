@@ -434,6 +434,16 @@ class TpuDriver(RegoDriver):
         # the delta cache, or the interpreter fallback
         self._eval_counts: dict[tuple, int] = {}
         self._eval_counts_lock = threading.Lock()
+        # vectorized message materialization (ir/vecmat.py): per-kind
+        # message plans (None = exact path) and rendered witness
+        # columns keyed (target, witness) — both rebuilt lazily
+        self._msg_plans: dict[str, Any] = {}
+        self._witcols: dict = {}
+        # per-kind audit results observer (control/audit.py's streaming
+        # status writer): called (target, kind, results) as each kind's
+        # sweep completes, so status publishing overlaps the remaining
+        # kinds' device sweeps
+        self.on_kind_results: Optional[Any] = None
         # interpreter-bound kinds: kind -> {"reason", "dense", "join"}
         # — the stable Uncompilable taxonomy code (+ detail prose per
         # compile stage) recorded at ingestion, surfaced through
@@ -511,6 +521,7 @@ class TpuDriver(RegoDriver):
         self._join_compiled.pop(kind, None)
         self._join_frz[2].pop(kind, None)  # template update: stale keys
         self._data_taint.pop(kind, None)
+        self._msg_plans.pop(kind, None)
         self._drop_audit_results(kind)
         self._drop_warm(kind)  # new CompiledTemplate = cold jit caches
         self._fallback.pop(kind, None)
@@ -554,6 +565,7 @@ class TpuDriver(RegoDriver):
             self._join_compiled.pop(m.group(2), None)
             self._join_frz[2].pop(m.group(2), None)
             self._data_taint.pop(m.group(2), None)
+            self._msg_plans.pop(m.group(2), None)
             self._fallback.pop(m.group(2), None)
             self._drop_audit_results(m.group(2))
             self._drop_warm(m.group(2))
@@ -972,6 +984,7 @@ class TpuDriver(RegoDriver):
         self._join_frz = (None, {}, {})
         self._audit_results_cache.clear()
         self._review_idx_cache = (None, None, None)
+        self._witcols.clear()
 
     # --------------------------------------------- warm-restart snapshots
 
@@ -1150,6 +1163,14 @@ class TpuDriver(RegoDriver):
     # --------------------------------------------------------------- audit
 
     def _eval_audit(self, target: str, trace: Optional[list]) -> list[Result]:
+        self._in_audit_sweep = True  # arms the pre-materialization
+        try:                         # violations cap (when set)
+            return self._eval_audit_inner(target, trace)
+        finally:
+            self._in_audit_sweep = False
+
+    def _eval_audit_inner(self, target: str,
+                          trace: Optional[list]) -> list[Result]:
         constraints = self._constraints(target)
         self._audit_used_mesh = False
         # one latency sample per audit, from the FIRST consumed kind:
@@ -1183,6 +1204,21 @@ class TpuDriver(RegoDriver):
         # bound for pathological template counts
         window = 64
         delta_served: set = set()
+
+        # per-kind completion observer (the streaming status writer):
+        # fired as soon as a kind's results exist, so constraint-status
+        # publishing overlaps the remaining kinds' device sweeps
+        cb = self.on_kind_results if trace is None else None
+
+        def emit(k: str) -> None:
+            if cb is None:
+                return
+            try:
+                cb(target, k, by_res.get(k, []))
+            except Exception as e:  # observer only; never fail the sweep
+                log.warning("audit kind-results observer failed for %s: "
+                            "%s: %s", k, type(e).__name__, e)
+
         for kind in sorted(by_kind):
             cons = by_kind[kind]
             ct = self.compiled_for(kind)
@@ -1196,6 +1232,7 @@ class TpuDriver(RegoDriver):
                     by_res[kind] = served
                     delta_served.add(kind)
                     self.note_eval(kind, "delta")
+                    emit(kind)
                     continue
             if ct is not None and trace is None:
                 while len(pending) >= window:
@@ -1204,6 +1241,7 @@ class TpuDriver(RegoDriver):
                                                      by_kind[k0], reviews,
                                                      lookup_ns, inventory,
                                                      sig_cache)
+                    emit(k0)
                 st = self._audit_dispatch(target, kind, ct, cons, reviews,
                                           lookup_ns, sig_cache)
                 if st is not None:
@@ -1213,27 +1251,32 @@ class TpuDriver(RegoDriver):
                                                   reviews, lookup_ns,
                                                   inventory, trace,
                                                   sig_cache)
+                emit(kind)
                 continue
             if ct is not None:
                 by_res[kind] = self._audit_compiled(target, kind, ct, cons,
                                                     reviews, lookup_ns,
                                                     inventory, trace,
                                                     sig_cache)
+                emit(kind)
                 continue
             jc = self.join_for(kind)
             if jc is not None:
                 by_res[kind] = self._audit_join(target, kind, jc, cons,
                                                 reviews, lookup_ns,
                                                 inventory, trace, sig_cache)
+                emit(kind)
                 continue
             by_res[kind] = self._audit_interp(target, kind, cons, reviews,
                                               lookup_ns, inventory, trace,
                                               sig_cache)
+            emit(kind)
         for kind, st in pending:
             by_res[kind] = self._audit_consume(target, kind, st,
                                                by_kind[kind], reviews,
                                                lookup_ns, inventory,
                                                sig_cache)
+            emit(kind)
         if trace is None:
             for kind in sorted(by_kind):
                 # seed/refresh the delta cache from this full sweep —
@@ -1498,6 +1541,151 @@ class TpuDriver(RegoDriver):
             self._quarantine_kind(kind, "audit-eval", e)
             return None
 
+    # ------------------------------------------- vectorized materialize
+
+    def _msg_plan(self, kind: str):
+        """The kind's message plan (ir/vecmat.py), or None for the exact
+        per-pair path. Cached per template generation; metrics count the
+        cache so plan-compile churn is visible."""
+        if kind in self._msg_plans:
+            self._note_msg_cache("hit")
+            return self._msg_plans[kind]
+        self._note_msg_cache("miss")
+        plan = None
+        prog = self._programs.get(kind)
+        module = self._modules.get(kind)
+        if prog is not None and module is not None:
+            from .vecmat import plan_messages
+            try:
+                plan = plan_messages(module, prog)
+            except Exception as e:
+                # a planner bug must degrade to the exact path, never
+                # break materialization
+                log.warning("message plan for %s failed (%s: %s); using "
+                            "the exact evaluator", kind,
+                            type(e).__name__, e)
+                plan = None
+        self._msg_plans[kind] = plan
+        if plan is not None:
+            log.info("template %s messages vectorized (%d witnesses)",
+                     kind, len(plan.witnesses))
+        return plan
+
+    @staticmethod
+    def _note_msg_cache(outcome: str) -> None:
+        try:
+            from ..control.metrics import report_msg_template_cache
+
+            report_msg_template_cache(outcome)
+        except Exception:  # metrics backend optional in embedders
+            pass
+
+    def _witness_col(self, target: str, w, base_reviews: list):
+        """Rendered witness column (+ veto mask) over `base_reviews`,
+        cached until the data revision moves or the list is replaced —
+        steady-state audits fancy-index resident columns instead of
+        re-walking the inventory."""
+        key = (target, w)
+        base_key = (id(base_reviews), len(base_reviews))
+        ent = self._witcols.get(key)
+        if ent is not None and ent[0] == self._data_rev and \
+                ent[1] == base_key:
+            return ent[2], ent[3]
+        from .vecmat import build_row_witness
+
+        arr, veto = build_row_witness(base_reviews, w)
+        self._witcols[key] = (self._data_rev, base_key, arr, veto)
+        return arr, veto
+
+    def _vec_msgs(self, target, kind, cons, pair_reviews, rows, cols,
+                  cand):
+        """ir/vecmat.py plan evaluation for one pair batch: fill the
+        plan's witnesses for every firing pair via numpy fancy-indexing
+        and assemble messages as U-dtype concatenation — no per-pair
+        Python. Returns (status[P] int8, msgs[P] list, details) or None
+        (exact path). status: 1 = message ready, 0 = vetoed to the
+        exact evaluator (absent / non-string / oversize witness), 2 =
+        provably no violation (msg witness undefined for that
+        constraint's parameters)."""
+        plan = self._msg_plan(kind)
+        if plan is None:
+            return None
+        program = self._programs.get(kind)
+        if program is None:
+            return None
+        from . import vecmat
+
+        if plan.conditions and not vecmat.check_conditions(
+                program, plan.conditions, cons):
+            return None
+        rows_np = np.asarray(rows, dtype=np.int64)
+        cols_np = np.asarray(cols, dtype=np.int64)
+        # witness columns build over the STABLE full review list when
+        # the caller maps pair rows through `cand` (identity
+        # spot-checked), so steady-state sweeps reuse them; otherwise
+        # over this call's pair_reviews
+        base = pair_reviews
+        base_rows = rows_np
+        if cand is not None:
+            full = self._inventory_reviews(target)
+            cand_arr = np.asarray(cand, dtype=np.int64)
+            if len(cand_arr) == len(pair_reviews) and (
+                    not len(pair_reviews)
+                    or (pair_reviews[0] is full[int(cand_arr[0])]
+                        and pair_reviews[-1] is full[int(cand_arr[-1])])):
+                base = full
+                base_rows = cand_arr[rows_np]
+        status = np.ones(len(rows_np), dtype=np.int8)
+        parts: list = []
+        for w in plan.witnesses:
+            if w.kind == "const":
+                parts.append(None)  # folded into the literal segments
+                continue
+            if w.kind == "param":
+                strs = []
+                dead = np.zeros(len(cons), dtype=bool)
+                for k, c in enumerate(cons):
+                    spec = c.get("spec")
+                    spec = spec if isinstance(spec, dict) else {}
+                    p = spec.get("parameters")
+                    frz = self._freeze_params(c, p if p is not None
+                                              else {})
+                    s = vecmat.render_param_witness(w, frz)
+                    if s is None:
+                        dead[k] = True
+                        strs.append("")
+                    else:
+                        strs.append(s)
+                col_arr = (np.array(strs, dtype=str) if strs
+                           else np.zeros(0, dtype="U1"))
+                parts.append(col_arr[cols_np])
+                if dead.any():
+                    status[dead[cols_np]] = 2
+                continue
+            arr, veto = self._witness_col(target, w, base)
+            status[veto[base_rows]] = 0
+            parts.append(arr[base_rows])
+        # assembly: literal segments merge into their neighbors; each
+        # np.char.add runs as one C loop over the whole pair batch
+        msgs = None
+        lit = plan.segments[0]
+        for w, part, seg in zip(plan.witnesses, parts,
+                                plan.segments[1:]):
+            if part is None:  # const witness: pure literal
+                lit = lit + w.text + seg
+                continue
+            if lit:
+                part = np.char.add(lit, part)
+            lit = seg
+            msgs = part if msgs is None else np.char.add(msgs, part)
+        if msgs is None:
+            msg_list = [lit] * len(rows_np)
+        else:
+            if lit:
+                msgs = np.char.add(msgs, lit)
+            msg_list = msgs.tolist()
+        return status, msg_list, plan.details
+
     def _audit_consume(self, target, kind, st, cons, reviews, lookup_ns,
                        inventory, sig_cache):
         """Phase 2: sync the dispatched slabs in order, materialize."""
@@ -1554,7 +1742,7 @@ class TpuDriver(RegoDriver):
                 keep = mask[cand[rows], cols]
                 res = self.materialize_pairs(
                     target, cons, cand_reviews, rows[keep], cols[keep],
-                    inventory)
+                    inventory, cand=cand)
                 dt = _time.time() - t0
                 t_mat += dt
                 if shard is None:
@@ -1633,7 +1821,8 @@ class TpuDriver(RegoDriver):
         rows = hit[rows_rep]
         if trace is None:
             return self.materialize_pairs(target, cons, cand_reviews,
-                                          rows, cols, inventory)
+                                          rows, cols, inventory,
+                                          cand=cand)
         out: list[Result] = []
         for ri, ci in zip(rows, cols):
             constraint = cons[int(ci)]
@@ -1698,6 +1887,10 @@ class TpuDriver(RegoDriver):
         if ent is None or ent["con_gen"] != self._constraint_gen or \
                 ent["reviews"] is not reviews:
             return None
+        if ent.get("capped") and self.audit_violations_cap is None:
+            # cached results carry count-only (capped) messages from a
+            # manager sweep: an UNCAPPED caller must re-materialize
+            return None
         notes = self._notes_between(ent["rev"], self._data_rev)
         if notes is None:
             return None
@@ -1743,7 +1936,12 @@ class TpuDriver(RegoDriver):
             by_row.setdefault(i, []).append(res)
         self._audit_results_cache[(target, kind)] = {
             "con_gen": self._constraint_gen, "reviews": reviews,
-            "rev": self._data_rev, "by_row": by_row}
+            "rev": self._data_rev, "by_row": by_row,
+            # capped sweeps cache count-only messages past the per-
+            # constraint limit; delta-serve refuses them to uncapped
+            # callers (the reverse — full messages to a capped sweep —
+            # is a superset and serves fine)
+            "capped": self.audit_violations_cap is not None}
 
     def _match_mask(self, target, kind, cons, reviews, lookup_ns,
                     sig_cache):

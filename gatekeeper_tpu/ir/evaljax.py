@@ -635,36 +635,65 @@ class _SlabPairs:
     def pairs(self):
         ct = self._ct
         feats, params, table, derived, chunk, slab, n, c = self._args
-        for k, (used_rcap, dev_arr) in enumerate(self._pend):
+        for k, (used_pcap, dev_arr) in enumerate(self._pend):
             arr = np.asarray(dev_arr)  # sync point + single fetch
-            rcount = int(arr[0, 0])
-            while rcount > used_rcap:
-                used_rcap = max(used_rcap,
-                                1 << (rcount - 1).bit_length())
-                fn2 = ct._slab_pairs_jit(chunk, slab, used_rcap)
+            pcount = int(arr[0, 0])
+            while pcount > used_pcap:
+                used_pcap = max(used_pcap,
+                                1 << (pcount - 1).bit_length())
+                fn2 = ct._slab_pairs_jit(chunk, slab, used_pcap)
                 arr = np.asarray(fn2(feats, params, table, derived,
-                                     np.int32(k * slab), np.int32(n)))
-                rcount = int(arr[0, 0])
+                                     np.int32(k * slab), np.int32(n),
+                                     np.int32(c)))
+                pcount = int(arr[0, 0])
             ct._rows_cap = max(ct._rows_cap,
-                               (1 << (rcount - 1).bit_length())
-                               if rcount > 1 else 256)
-            yield _decode_row_blocks(arr, rcount, c)
+                               (1 << (pcount - 1).bit_length())
+                               if pcount > 1 else 256)
+            yield _decode_pair_blocks(arr, pcount)
 
 
-def _decode_row_blocks(arr: np.ndarray, rcount: int, c: int):
-    """(rows, cols) row-major from a _gather_rows block: unpack each
-    firing row's column bitmask on host (vectorized numpy; sub-ms even
-    for thousands of rows)."""
-    if rcount == 0:
+def _decode_pair_blocks(arr: np.ndarray, pcount: int):
+    """(rows, cols) from one device pair block: the kernels decode the
+    bit-packed verdicts to dense (row, constraint) index pairs ON
+    DEVICE (row-major, invalid columns already masked), so the host
+    does no bitmask unpacking at all — two int64 casts and a slice."""
+    if pcount == 0:
         z = np.zeros(0, dtype=np.int64)
         return z, z.copy()
-    body = arr[1:1 + rcount]
-    rows_idx = body[:, 0].astype(np.int64)
-    sub = body[:, 1:]
-    bits = (sub[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
-    flat = bits.reshape(rcount, -1)[:, :c].astype(bool)
-    r_rep, cols = np.nonzero(flat)
-    return rows_idx[r_rep], cols.astype(np.int64)
+    body = arr[1:1 + pcount]
+    return body[:, 0].astype(np.int64), body[:, 1].astype(np.int64)
+
+
+def _pair_expand(packed, valid_rows, row0, c, pcap):
+    """Shared device tail for every pair kernel: masked bit-packed
+    verdicts [R, W] -> one [pcap+1, 2] uint32 block — header row
+    carrying the true pair count, then (global row, constraint) index
+    pairs in row-major order (fixed-capacity nonzero over the unpacked
+    bit matrix; jnp.nonzero's ascending flat order IS row-major).
+    `valid_rows` masks extraction padding / slab overlap; `row0` is the
+    block's global row offset; `c` (traced) masks the C-bucket padding
+    columns so library edits inside a bucket still hit this program."""
+    r, w = packed.shape
+    w32 = w * 32
+    packed = jnp.where(valid_rows[:, None], packed, jnp.uint32(0))
+    bits = (packed[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)
+            ) & jnp.uint32(1)
+    flat = bits.reshape(r, w32).astype(bool)
+    flat = jnp.logical_and(flat, jnp.arange(w32, dtype=jnp.int32)[None, :]
+                           < c)
+    pcount = jnp.sum(flat, dtype=jnp.int32)
+    pidx = jnp.nonzero(flat.reshape(-1), size=pcap,
+                       fill_value=r * w32)[0]
+    ok = pidx < r * w32
+    sel = jnp.where(ok, pidx, 0)
+    prow = (row0 + sel // w32).astype(jnp.uint32)
+    pcol = (sel % w32).astype(jnp.uint32)
+    prow = jnp.where(ok, prow, jnp.uint32(0))
+    pcol = jnp.where(ok, pcol, jnp.uint32(0))
+    body = jnp.stack([prow, pcol], axis=1)  # [pcap, 2]
+    header = jnp.zeros((1, 2), jnp.uint32)
+    header = header.at[0, 0].set(pcount.astype(jnp.uint32))
+    return jnp.concatenate([header, body], axis=0)
 
 
 class _MeshPairs:
@@ -698,7 +727,8 @@ class _MeshPairs:
             # sweep at the next power of two (rare; remembered below)
             rcap = max(rcap, 1 << (int(counts.max()) - 1).bit_length())
             fn = ct._mesh_pairs_jit(self._mesh, self._chunk, rcap)
-            arr = np.asarray(fn(feats, params, table, derived, n_valid))
+            arr = np.asarray(fn(feats, params, table, derived, n_valid,
+                                np.int32(c)))
             counts = arr[:: rcap + 1, 0].astype(np.int64)
         # RATCHET, like _SlabPairs does for _rows_cap: resetting to this
         # sweep's count made alternating small/large mesh sweeps re-trip
@@ -708,7 +738,7 @@ class _MeshPairs:
                                 if counts.max(initial=0) > 1 else 256)
         for k in range(n_shards):
             block = arr[k * (rcap + 1): (k + 1) * (rcap + 1)]
-            rows, cols = _decode_row_blocks(block, int(block[0, 0]), c)
+            rows, cols = _decode_pair_blocks(block, int(block[0, 0]))
             yield k, rows, cols
 
 
@@ -743,7 +773,8 @@ class _MeshSlabPairs:
         # cross-kind window consumes handles long after construction
         self._pend = [
             (s, rcap, fn(args[0], args[1], args[2], args[3],
-                         np.int32(s * lslab), args[4]))
+                         np.int32(s * lslab), args[4],
+                         np.int32(args[5])))
             for s in range(min(self.WINDOW, n_slabs))]
         self._next = len(self._pend)
 
@@ -765,7 +796,8 @@ class _MeshSlabPairs:
                 self._pend.append(
                     (self._next, ct._rows_cap_mesh,
                      fn(feats, params, table, derived,
-                        np.int32(self._next * lslab), n_valid)))
+                        np.int32(self._next * lslab), n_valid,
+                        np.int32(c))))
                 self._next += 1
             jax.block_until_ready(dev)  # the slab boundary: the ONLY
             # sync point in the loop
@@ -780,7 +812,8 @@ class _MeshSlabPairs:
                 fn = ct._mesh_slab_pairs_jit(self._mesh, self._chunk,
                                              lslab, rcap)
                 arr = np.asarray(fn(feats, params, table, derived,
-                                    np.int32(s * lslab), n_valid))
+                                    np.int32(s * lslab), n_valid,
+                                    np.int32(c)))
                 counts = arr[:: rcap + 1, 0].astype(np.int64)
             ct._rows_cap_mesh = max(
                 ct._rows_cap_mesh, 256,
@@ -788,8 +821,7 @@ class _MeshSlabPairs:
                 if counts.max(initial=0) > 1 else 256)
             for k in range(n_shards):
                 block = arr[k * (rcap + 1): (k + 1) * (rcap + 1)]
-                rows, cols = _decode_row_blocks(block, int(block[0, 0]),
-                                                c)
+                rows, cols = _decode_pair_blocks(block, int(block[0, 0]))
                 yield k, rows, cols
 
 
@@ -816,7 +848,7 @@ class CompiledTemplate:
         self._fn = self._ajit("eval", (), self._eval)
         self._scan_cache: dict[int, Any] = {}
         self._pairs_cache: dict[tuple, Any] = {}
-        # remembered firing-row gather capacity (see _gather_rows)
+        # remembered firing-pair gather capacity (see _gather_pairs)
         self._rows_cap = 256
         # per-shard capacity for the mesh sweep (fires_pairs_mesh_dispatch)
         self._rows_cap_mesh = 256
@@ -845,19 +877,22 @@ class CompiledTemplate:
                     w = self._fn
                 elif tag == "scan":
                     w = self._scan_jit(*static)
-                elif tag == "slab":
+                elif tag == "slabp":
                     w = self._slab_pairs_jit(*static)
-                elif tag == "rows":
-                    w = self._rows_jit(*static)
-                elif tag in ("mesh", "mesh-slab"):
+                elif tag == "pairsg":
+                    w = self._pairs_jit(*static)
+                elif tag in ("meshp", "mesh-slabp"):
                     if mesh is None or \
                             tuple(sorted(mesh.shape.items())) != static[-1]:
                         continue
-                    if tag == "mesh":
+                    if tag == "meshp":
                         w = self._mesh_pairs_jit(mesh, *static[:-1])
                     else:
                         w = self._mesh_slab_pairs_jit(mesh, *static[:-1])
                 else:
+                    # pre-pair-decode tags ("slab"/"rows"/"mesh"/
+                    # "mesh-slab") produced row-bitmask blocks; their
+                    # stored executables are format-incompatible — skip
                     continue
                 key = self.aot.entry_key(self.fingerprint, tag, static,
                                          ent["asig"])
@@ -1002,8 +1037,9 @@ class CompiledTemplate:
     def _pairs_dispatch_mono(self, feats, params, match_table, derived,
                              chunk: int, n: int,
                              c: Optional[int] = None):
-        """ASYNC dispatch of the monolithic packed sweep + row gather;
-        _pairs_consume_mono syncs (with the capacity-retry loop)."""
+        """ASYNC dispatch of the monolithic packed sweep + device pair
+        decode; _pairs_consume_mono syncs (with the capacity-retry
+        loop)."""
         n_feat = next(iter(next(iter(feats.values())).values())).shape[0]
         if n_feat % chunk:
             pad_n = ((n_feat + chunk - 1) // chunk) * chunk
@@ -1012,37 +1048,39 @@ class CompiledTemplate:
                                   (a.ndim - 1)), feats)
         packed = self._packed_device(feats, params, match_table, derived,
                                      chunk)
+        if c is None:
+            c = _param_c(params)
         rcap = self._rows_cap
-        dev = self._gather_rows(packed, n, rcap)
-        return (packed, n, rcap, dev,
-                c if c is not None else _param_c(params))
+        dev = self._gather_pairs(packed, n, c, rcap)
+        return (packed, n, rcap, dev, c)
 
     def _pairs_consume_mono(self, st):
         packed, n, rcap, dev, c = st
         arr = np.asarray(dev)  # sync
-        rcount = int(arr[0, 0])
-        while rcount > rcap:
-            rcap = max(rcap, 1 << (rcount - 1).bit_length())
-            arr = np.asarray(self._gather_rows(packed, n, rcap))
-            rcount = int(arr[0, 0])
-        self._rows_cap = max(256, (1 << (rcount - 1).bit_length())
-                             if rcount > 1 else 256)
-        return _decode_row_blocks(arr, rcount, c)
+        pcount = int(arr[0, 0])
+        while pcount > rcap:
+            rcap = max(rcap, 1 << (pcount - 1).bit_length())
+            arr = np.asarray(self._gather_pairs(packed, n, c, rcap))
+            pcount = int(arr[0, 0])
+        self._rows_cap = max(256, (1 << (pcount - 1).bit_length())
+                             if pcount > 1 else 256)
+        return _decode_pair_blocks(arr, pcount)
 
-    def _slab_pairs_jit(self, chunk: int, slab: int, rcap: int):
-        """One fused jit per (chunk, slab, rcap): clamped dynamic-slice
+    def _slab_pairs_jit(self, chunk: int, slab: int, pcap: int):
+        """One fused jit per (chunk, slab, pcap): clamped dynamic-slice
         of the FULL device-resident feature tree at a traced `start`,
-        chunked sweep, bit-pack, and firing-row gather, returning one
-        [rcap+1, W+1] row block (see _gather_rows). One device dispatch
-        + one fetch per slab — per-leaf host pad/slice op storms (and
-        scalar count fetches) each cost an RTT on a network-tunneled
-        chip."""
-        key = ("slab", chunk, slab, rcap)
+        chunked sweep, bit-pack, and dense pair decode (_pair_expand),
+        returning one [pcap+1, 2] pair block. One device dispatch + one
+        fetch per slab — per-leaf host pad/slice op storms (and scalar
+        count fetches) each cost an RTT on a network-tunneled chip —
+        and the host receives (row, constraint) INDEX arrays, no
+        bitmask unpacking."""
+        key = ("slabp", chunk, slab, pcap)
         fn = self._pairs_cache.get(key)
         if fn is not None:
             return fn
 
-        def run(feats, params, table, derived, start, n_valid):
+        def run(feats, params, table, derived, start, n_valid, c):
             leaf = next(iter(next(iter(feats.values())).values()))
             n_feat = leaf.shape[0]  # static
             cs = jnp.minimum(start, n_feat - slab)
@@ -1054,9 +1092,9 @@ class CompiledTemplate:
 
             def body(ch):
                 fires = self._eval(ch, params, table, derived)  # [chunk, C]
-                c = fires.shape[-1]
-                w = (c + 31) // 32
-                pad = w * 32 - c
+                cc = fires.shape[-1]
+                w = (cc + 31) // 32
+                pad = w * 32 - cc
                 if pad:
                     fires = jnp.pad(fires, ((0, 0), (0, pad)))
                 bits = fires.reshape(fires.shape[0], w, 32)
@@ -1066,29 +1104,14 @@ class CompiledTemplate:
 
             packed = jax.lax.map(body, chunked)
             packed = packed.reshape((slab,) + packed.shape[2:])
-            w = packed.shape[1]
             rows_global = cs + jnp.arange(slab, dtype=jnp.int32)
             # mask extraction padding (>= n_valid) AND the clamp overlap
             # (< start): overlap rows were already emitted by the
             # previous slab
             valid = (rows_global < n_valid) & (rows_global >= start)
-            packed = jnp.where(valid[:, None], packed, jnp.uint32(0))
-            per_row = jnp.sum(jax.lax.population_count(packed), axis=1,
-                              dtype=jnp.int32)
-            row_any = per_row > 0
-            rcount = jnp.sum(row_any, dtype=jnp.int32)
-            rows_idx = jnp.nonzero(row_any, size=rcap, fill_value=slab)[0]
-            sel = jnp.where(rows_idx < slab, rows_idx, 0)
-            sub = packed[sel]
-            sub = jnp.where((rows_idx < slab)[:, None], sub, jnp.uint32(0))
-            gr = jnp.where(rows_idx < slab, cs + rows_idx,
-                           jnp.int32(n_feat)).astype(jnp.uint32)
-            body2 = jnp.concatenate([gr[:, None], sub], axis=1)
-            header = jnp.zeros((1, w + 1), jnp.uint32)
-            header = header.at[0, 0].set(rcount.astype(jnp.uint32))
-            return jnp.concatenate([header, body2], axis=0)
+            return _pair_expand(packed, valid, cs, c, pcap)
 
-        fn = self._ajit("slab", (chunk, slab, rcap), run)
+        fn = self._ajit("slabp", (chunk, slab, pcap), run)
         self._pairs_cache[key] = fn
         return fn
 
@@ -1120,29 +1143,31 @@ class CompiledTemplate:
         rcap = self._rows_cap
         fn = self._slab_pairs_jit(chunk, slab, rcap)
         pend = [(rcap, fn(feats, params, match_table, derived,
-                          np.int32(k * slab), np.int32(n)))
+                          np.int32(k * slab), np.int32(n), np.int32(c)))
                 for k in range(n_slabs)]
         return _SlabPairs(self, pend, feats, params, match_table, derived,
                           chunk, slab, n, c)
 
-    def _mesh_pairs_jit(self, mesh, chunk: int, rcap: int):
-        """One fused SPMD program per (mesh, chunk, per-shard rcap):
+    def _mesh_pairs_jit(self, mesh, chunk: int, pcap: int):
+        """One fused SPMD program per (mesh, chunk, per-shard pcap):
         shard_map over the mesh's "data" axis — each device sweeps its
         contiguous N/D row block (chunked lax.map, same eval body as the
         single-device sweep), bit-packs verdicts over C, masks padding
-        rows by GLOBAL row index, and gathers its local firing rows at
-        capacity rcap. Output spec P("data") concatenates the per-shard
-        [rcap+1, W+1] row blocks, so the host pays ONE fetch for the
-        whole mesh. No cross-device collective during evaluation: the
-        object axis is pure data parallelism; aggregation happens on
-        host from per-shard blocks (counts ride in each block header)."""
-        key = ("mesh", id(mesh), chunk, rcap)
+        rows by GLOBAL row index, and decodes its local firing pairs to
+        dense (row, constraint) indices at capacity pcap (_pair_expand).
+        Output spec P("data") concatenates the per-shard [pcap+1, 2]
+        pair blocks, so the host pays ONE fetch for the whole mesh and
+        does no bit unpacking. No cross-device collective during
+        evaluation: the object axis is pure data parallelism;
+        aggregation happens on host from per-shard blocks (counts ride
+        in each block header)."""
+        key = ("meshp", id(mesh), chunk, pcap)
         fn = self._pairs_cache.get(key)
         if fn is not None:
             return fn
         from jax.sharding import PartitionSpec as P
 
-        def local(feats_l, params, table, derived, n_valid):
+        def local(feats_l, params, table, derived, n_valid, c):
             leaf = next(iter(next(iter(feats_l.values())).values()))
             n_loc = leaf.shape[0]  # static: N // data axis size
             chunked = jax.tree_util.tree_map(
@@ -1150,9 +1175,9 @@ class CompiledTemplate:
 
             def body(ch):
                 fires = self._eval(ch, params, table, derived)  # [chunk, C]
-                c = fires.shape[-1]
-                w = (c + 31) // 32
-                pad = w * 32 - c
+                cc = fires.shape[-1]
+                w = (cc + 31) // 32
+                pad = w * 32 - cc
                 if pad:
                     fires = jnp.pad(fires, ((0, 0), (0, pad)))
                 bits = fires.reshape(fires.shape[0], w, 32)
@@ -1163,29 +1188,13 @@ class CompiledTemplate:
 
             packed = jax.lax.map(body, chunked)
             packed = packed.reshape((n_loc,) + packed.shape[2:])
-            w = packed.shape[1]
             idx = jax.lax.axis_index("data")
             row0 = idx * n_loc
             rows_global = row0 + jnp.arange(n_loc, dtype=jnp.int32)
-            packed = jnp.where((rows_global < n_valid)[:, None], packed,
-                               jnp.uint32(0))
-            per_row = jnp.sum(jax.lax.population_count(packed), axis=1,
-                              dtype=jnp.int32)
-            row_any = per_row > 0
-            rcount = jnp.sum(row_any, dtype=jnp.int32)
-            rows_idx = jnp.nonzero(row_any, size=rcap, fill_value=n_loc)[0]
-            sel = jnp.where(rows_idx < n_loc, rows_idx, 0)
-            sub = packed[sel]
-            sub = jnp.where((rows_idx < n_loc)[:, None], sub,
-                            jnp.uint32(0))
-            gr = jnp.where(rows_idx < n_loc, row0 + rows_idx,
-                           jnp.int32(0)).astype(jnp.uint32)
-            body2 = jnp.concatenate([gr[:, None], sub], axis=1)
-            header = jnp.zeros((1, w + 1), jnp.uint32)
-            header = header.at[0, 0].set(rcount.astype(jnp.uint32))
-            return jnp.concatenate([header, body2], axis=0)
+            return _pair_expand(packed, rows_global < n_valid, row0, c,
+                                pcap)
 
-        def run(feats, params, table, derived, n_valid):
+        def run(feats, params, table, derived, n_valid, c):
             fspec = jax.tree_util.tree_map(
                 lambda a: P("data", *([None] * (a.ndim - 1))), feats)
             rep = lambda tree: jax.tree_util.tree_map(
@@ -1193,32 +1202,33 @@ class CompiledTemplate:
             return _shard_map_wrap(
                 local, mesh=mesh,
                 in_specs=(fspec, rep(params), rep(table), rep(derived),
-                          P()),
+                          P(), P()),
                 out_specs=P("data", None),
-            )(feats, params, table, derived, n_valid)
+            )(feats, params, table, derived, n_valid, c)
 
         fn = self._ajit(
-            "mesh", (chunk, rcap, tuple(sorted(mesh.shape.items()))), run)
+            "meshp", (chunk, pcap, tuple(sorted(mesh.shape.items()))), run)
         self._pairs_cache[key] = fn
         return fn
 
     def _mesh_slab_pairs_jit(self, mesh, chunk: int, lslab: int,
-                             rcap: int):
-        """One fused SPMD program per (mesh, chunk, lslab, rcap): the
+                             pcap: int):
+        """One fused SPMD program per (mesh, chunk, lslab, pcap): the
         slab twin of _mesh_pairs_jit — each device dynamic-slices its
         next `lslab` LOCAL rows at a traced `start` (so every slab of
         a sweep reuses ONE compiled program), sweeps/bit-packs them,
-        and gathers its local firing rows at capacity rcap, with
-        global row indices stamped from axis_index. Out spec P("data")
-        concatenates per-shard [rcap+1, W+1] blocks: one dispatch +
-        one fetch per slab for the whole mesh."""
-        key = ("mesh-slab", id(mesh), chunk, lslab, rcap)
+        and decodes its local firing pairs to dense (row, constraint)
+        indices at capacity pcap, with global row indices stamped from
+        axis_index. Out spec P("data") concatenates per-shard
+        [pcap+1, 2] blocks: one dispatch + one fetch per slab for the
+        whole mesh, nothing to unpack on host."""
+        key = ("mesh-slabp", id(mesh), chunk, lslab, pcap)
         fn = self._pairs_cache.get(key)
         if fn is not None:
             return fn
         from jax.sharding import PartitionSpec as P
 
-        def local(feats_l, params, table, derived, start, n_valid):
+        def local(feats_l, params, table, derived, start, n_valid, c):
             leaf = next(iter(next(iter(feats_l.values())).values()))
             n_loc = leaf.shape[0]  # static: N // data axis size
             cs = jnp.minimum(start, n_loc - lslab)
@@ -1231,9 +1241,9 @@ class CompiledTemplate:
 
             def body(ch):
                 fires = self._eval(ch, params, table, derived)
-                c = fires.shape[-1]
-                w = (c + 31) // 32
-                pad = w * 32 - c
+                cc = fires.shape[-1]
+                w = (cc + 31) // 32
+                pad = w * 32 - cc
                 if pad:
                     fires = jnp.pad(fires, ((0, 0), (0, pad)))
                 bits = fires.reshape(fires.shape[0], w, 32)
@@ -1244,7 +1254,6 @@ class CompiledTemplate:
 
             packed = jax.lax.map(body, chunked)
             packed = packed.reshape((lslab,) + packed.shape[2:])
-            w = packed.shape[1]
             idx = jax.lax.axis_index("data")
             row0 = idx * n_loc
             loc_rows = cs + jnp.arange(lslab, dtype=jnp.int32)
@@ -1253,25 +1262,9 @@ class CompiledTemplate:
             # overlap (< start): overlap rows were already emitted by
             # the previous slab
             valid = (rows_global < n_valid) & (loc_rows >= start)
-            packed = jnp.where(valid[:, None], packed, jnp.uint32(0))
-            per_row = jnp.sum(jax.lax.population_count(packed), axis=1,
-                              dtype=jnp.int32)
-            row_any = per_row > 0
-            rcount = jnp.sum(row_any, dtype=jnp.int32)
-            rows_idx = jnp.nonzero(row_any, size=rcap,
-                                   fill_value=lslab)[0]
-            sel = jnp.where(rows_idx < lslab, rows_idx, 0)
-            sub = packed[sel]
-            sub = jnp.where((rows_idx < lslab)[:, None], sub,
-                            jnp.uint32(0))
-            gr = jnp.where(rows_idx < lslab, row0 + cs + rows_idx,
-                           jnp.int32(0)).astype(jnp.uint32)
-            body2 = jnp.concatenate([gr[:, None], sub], axis=1)
-            header = jnp.zeros((1, w + 1), jnp.uint32)
-            header = header.at[0, 0].set(rcount.astype(jnp.uint32))
-            return jnp.concatenate([header, body2], axis=0)
+            return _pair_expand(packed, valid, row0 + cs, c, pcap)
 
-        def run(feats, params, table, derived, start, n_valid):
+        def run(feats, params, table, derived, start, n_valid, c):
             fspec = jax.tree_util.tree_map(
                 lambda a: P("data", *([None] * (a.ndim - 1))), feats)
             rep = lambda tree: jax.tree_util.tree_map(
@@ -1279,13 +1272,13 @@ class CompiledTemplate:
             return _shard_map_wrap(
                 local, mesh=mesh,
                 in_specs=(fspec, rep(params), rep(table), rep(derived),
-                          P(), P()),
+                          P(), P(), P()),
                 out_specs=P("data", None),
-            )(feats, params, table, derived, start, n_valid)
+            )(feats, params, table, derived, start, n_valid, c)
 
         fn = self._ajit(
-            "mesh-slab",
-            (chunk, lslab, rcap, tuple(sorted(mesh.shape.items()))), run)
+            "mesh-slabp",
+            (chunk, lslab, pcap, tuple(sorted(mesh.shape.items()))), run)
         self._pairs_cache[key] = fn
         return fn
 
@@ -1347,7 +1340,8 @@ class CompiledTemplate:
                 (feats, params, match_table, derived, np.int32(n), c))
         rcap = self._rows_cap_mesh
         fn = self._mesh_pairs_jit(mesh, chunk_eff, rcap)
-        dev = fn(feats, params, match_table, derived, np.int32(n))
+        dev = fn(feats, params, match_table, derived, np.int32(n),
+                 np.int32(c))
         return _MeshPairs(self, mesh, dev, rcap, chunk_eff,
                           (feats, params, match_table, derived,
                            np.int32(n), c))
@@ -1365,42 +1359,26 @@ class CompiledTemplate:
             feats, params, match_table, derived, chunk=chunk, slab=slab,
             n_true=n_true, n_cons=n_cons).pairs()
 
-    def _gather_rows(self, packed, n: int, rcap: int):
-        """Device firing-ROW gather: one [rcap+1, W+1] uint32 block —
-        header row carrying the firing-row count, then per firing row
-        its global row index and its bit-packed column verdicts.
+    def _gather_pairs(self, packed, n: int, c: int, pcap: int):
+        """Device firing-PAIR gather: one [pcap+1, 2] uint32 block —
+        header row carrying the pair count, then the (row, constraint)
+        index pairs row-major (see _pair_expand).
 
-        Audits are ROW-sparse (~1% of objects violate anything), so
-        shipping the firing rows' bitmasks is ~rcount x (W+1) words —
-        far below per-pair indices — and the whole result is ONE
-        device->host fetch (a network-tunneled chip pays ~0.1s per
-        roundtrip, so scalar-count-then-data would double the cost).
-        Rows >= n are extraction padding, masked before counting. Host
-        decodes with _decode_row_blocks (vectorized numpy)."""
-        return self._rows_jit(rcap)(packed, np.int32(n))
+        Audits are ~99.99% rejects, so the dense index pairs are tiny,
+        the whole result is ONE device->host fetch (a network-tunneled
+        chip pays ~0.1s per roundtrip, so scalar-count-then-data would
+        double the cost), and the host does no bit unpacking at all.
+        Rows >= n are extraction padding, masked before counting; cols
+        >= c are C-bucket padding, masked on device too."""
+        return self._pairs_jit(pcap)(packed, np.int32(n), np.int32(c))
 
-    def _rows_jit(self, rcap: int):
-        fn = self._pairs_cache.get(("rows", rcap))
+    def _pairs_jit(self, pcap: int):
+        fn = self._pairs_cache.get(("pairsg", pcap))
         if fn is None:
-            def run(packed, n):
-                npad, w = packed.shape
-                valid = jnp.arange(npad, dtype=jnp.int32)[:, None] < n
-                packed = jnp.where(valid, packed, jnp.uint32(0))
-                per_row = jnp.sum(jax.lax.population_count(packed), axis=1,
-                                  dtype=jnp.int32)  # [Npad]
-                row_any = per_row > 0
-                rcount = jnp.sum(row_any, dtype=jnp.int32)
-                rows_idx = jnp.nonzero(row_any, size=rcap,
-                                       fill_value=npad)[0]  # sorted asc
-                sel = jnp.where(rows_idx < npad, rows_idx, 0)
-                sub = packed[sel]  # [rcap, W]
-                sub = jnp.where((rows_idx < npad)[:, None], sub,
-                                jnp.uint32(0))
-                body = jnp.concatenate(
-                    [rows_idx.astype(jnp.uint32)[:, None], sub], axis=1)
-                header = jnp.zeros((1, w + 1), jnp.uint32)
-                header = header.at[0, 0].set(rcount.astype(jnp.uint32))
-                return jnp.concatenate([header, body], axis=0)
-            fn = self._ajit("rows", (rcap,), run)
-            self._pairs_cache[("rows", rcap)] = fn
+            def run(packed, n, c):
+                npad = packed.shape[0]
+                valid = jnp.arange(npad, dtype=jnp.int32) < n
+                return _pair_expand(packed, valid, jnp.int32(0), c, pcap)
+            fn = self._ajit("pairsg", (pcap,), run)
+            self._pairs_cache[("pairsg", pcap)] = fn
         return fn

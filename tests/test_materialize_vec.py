@@ -1,0 +1,385 @@
+"""Vectorized violation materialization (ISSUE 11 tentpole).
+
+Contract: for every kind with a message plan (ir/vecmat.py), the
+vectorized numpy message assembly is BIT-EQUAL to the exact per-pair
+evaluator — messages, details, enforcement, and order — across the
+shipped general + pod-security-policy libraries and adversarial
+witness shapes (multi-arg sprintf, unicode, >512-char strings that
+veto the fixed-width window). Witnesses outside the plan's subset
+must veto their pair back to the exact path, never render wrong.
+
+The pre-materialization cap: with audit_violations_cap armed, each
+constraint's first `cap` pairs materialize fully and the rest become
+count-only results — totals intact, published entries unaffected.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import bench_configs as bc
+from gatekeeper_tpu import policies
+from gatekeeper_tpu.client import Backend
+from gatekeeper_tpu.ir import TpuDriver
+from gatekeeper_tpu.target import K8sValidationTarget
+from gatekeeper_tpu.target.batch import match_masks
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+
+def mk_client(drv=None):
+    drv = drv or TpuDriver()
+    return drv, Backend(drv).new_client([K8sValidationTarget()])
+
+
+def _materialize_both(drv, kind, cons, reviews, with_cand=True):
+    """Device firing pairs for one kind, materialized twice: through
+    the vectorized plan and with the plan disabled (exact evaluator).
+    Returns (vec_results, exact_results, n_pairs, plan_active)."""
+    lookup_ns = drv._namespace_lookup(TARGET)
+    inventory = drv._inventory_tree(TARGET)
+    ct = drv.compiled_for(kind)
+    assert ct is not None, f"{kind} must device-compile for this test"
+    mask = match_masks(cons, reviews, lookup_ns)
+    cand = np.flatnonzero(mask.any(axis=1))
+    cand_reviews = [reviews[int(i)] for i in cand]
+    rows, cols = drv.eval_compiled_pairs(
+        ct, kind, cand_reviews, cons,
+        feat_key=(drv._data_gen, hash(cand.tobytes())), cand=cand,
+        target=TARGET)
+    keep = mask[cand[rows], cols]
+    rows, cols = rows[keep], cols[keep]
+    kw = {"cand": cand} if with_cand else {}
+    plan_active = drv._vec_msgs(TARGET, kind, cons, cand_reviews, rows,
+                                cols, cand if with_cand else None) \
+        is not None
+    r_vec = drv.materialize_pairs(TARGET, cons, cand_reviews, rows, cols,
+                                  inventory, **kw)
+    orig = drv._vec_msgs
+    drv._vec_msgs = lambda *a, **k: None
+    try:
+        r_exact = drv.materialize_pairs(TARGET, cons, cand_reviews, rows,
+                                        cols, inventory, **kw)
+    finally:
+        drv._vec_msgs = orig
+    return r_vec, r_exact, len(rows), plan_active
+
+
+def _key(r):
+    return (r.constraint["kind"], r.constraint["metadata"]["name"],
+            r.msg, r.metadata, r.enforcement_action,
+            id(r.review))
+
+
+def _load_library(prefix, constraints, objects):
+    drv, client = mk_client()
+    for name in policies.names():
+        if name.startswith(prefix):
+            client.add_template(policies.load(name))
+    for kind, cname, params in constraints:
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind, "metadata": {"name": cname},
+            "spec": ({"parameters": params} if params else {}),
+        })
+    for o in objects:
+        client.add_data(o)
+    return drv, client
+
+
+# ------------------------------------------------- library differential
+
+
+def test_psp_library_bit_equal():
+    """Every PSP kind's device pairs materialize bit-equal messages on
+    the vectorized and exact paths; the dominant kinds actually take
+    the vectorized path."""
+    drv, client = _load_library("pod-security-policy/",
+                                bc.PSP_CONSTRAINTS,
+                                bc.synth_pods_psp(1500))
+    reviews = drv._inventory_reviews(TARGET)
+    cons_all = drv._constraints(TARGET)
+    vec_kinds = set()
+    total = 0
+    for kind in sorted({c.get("kind") for c in cons_all}):
+        cons = [c for c in cons_all if c.get("kind") == kind]
+        if drv.compiled_for(kind) is None:
+            continue
+        r_vec, r_exact, n, active = _materialize_both(drv, kind, cons,
+                                                      reviews)
+        assert [_key(r) for r in r_vec] == [_key(r) for r in r_exact], \
+            f"{kind}: vectorized messages diverge from the evaluator"
+        if active:
+            vec_kinds.add(kind)
+        total += n
+    assert total > 0
+    # the kinds that dominate the BENCH_r05 materialization tail must
+    # be on the vectorized path, or the tentpole regressed
+    assert {"K8sPSPSELinux", "K8sPSPForbiddenSysctls"} <= vec_kinds
+
+
+def test_general_library_bit_equal():
+    drv, client = _load_library("general/", bc.GENERAL_CONSTRAINTS,
+                                bc.synth_mixed_objects(1200))
+    reviews = drv._inventory_reviews(TARGET)
+    cons_all = drv._constraints(TARGET)
+    for kind in sorted({c.get("kind") for c in cons_all}):
+        cons = [c for c in cons_all if c.get("kind") == kind]
+        if drv.compiled_for(kind) is None:
+            continue
+        r_vec, r_exact, _n, _a = _materialize_both(drv, kind, cons,
+                                                   reviews)
+        assert [_key(r) for r in r_vec] == [_key(r) for r in r_exact], \
+            f"{kind}: vectorized messages diverge from the evaluator"
+
+
+def test_plan_gating_per_axis_witnesses_stay_exact():
+    """Kinds whose messages carry per-axis witnesses (container names)
+    or non-const details must have NO plan — the device verdict cannot
+    attribute which element fired."""
+    drv, client = _load_library("pod-security-policy/",
+                                bc.PSP_CONSTRAINTS,
+                                bc.synth_pods_psp(50))
+    for name in policies.names():
+        if name.startswith("general/"):
+            client.add_template(policies.load(name))
+    assert drv._msg_plan("K8sPSPSELinux") is not None
+    assert drv._msg_plan("K8sPSPForbiddenSysctls") is not None
+    assert drv._msg_plan("K8sPSPHostNamespace") is not None
+    assert drv._msg_plan("K8sHttpsOnly") is not None
+    # c.name is a per-axis witness; %v of securityContext is composite
+    assert drv._msg_plan("K8sPSPAllowPrivilegeEscalationContainer") is None
+    assert drv._msg_plan("K8sPSPPrivilegedContainer") is None
+    assert drv._msg_plan("K8sPSPCapabilities") is None
+    # details carry a witness -> exact path
+    assert drv._msg_plan("K8sRequiredLabels") is None
+
+
+# --------------------------------------------- adversarial witnesses
+
+
+VECDIFF_TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1beta1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "vecdiff"},
+    "spec": {
+        "crd": {"spec": {"names": {"kind": "VecDiff"}}},
+        "targets": [{"target": "admission.k8s.gatekeeper.sh", "rego": """
+package vecdiff
+
+violation[{"msg": msg, "details": {}}] {
+  input.review.object.metadata.labels["flag"] == "bad"
+  msg := sprintf("object <%v> in namespace <%v> flagged (note: %v, max: %v)", [input.review.object.metadata.name, input.review.object.metadata.namespace, input.parameters.note, input.parameters.max])
+}
+"""}],
+    },
+}
+
+
+def _vecdiff_client(pods):
+    drv, client = mk_client()
+    client.add_template(VECDIFF_TEMPLATE)
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "VecDiff", "metadata": {"name": "vd"},
+        "spec": {"parameters": {"note": "uñícødé «note»",
+                                "max": 3}},
+    })
+    for p in pods:
+        client.add_data(p)
+    return drv, client
+
+
+def _pod(name, ns="d", flag="bad"):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": {"flag": flag}}}
+
+
+def test_multiarg_sprintf_unicode_and_oversize_witnesses():
+    """Multi-arg sprintf with unicode witnesses and a >512-char name
+    (vetoes the fixed-width window -> exact path) stay bit-equal."""
+    from gatekeeper_tpu.ir.vecmat import MAX_WITNESS_STRLEN
+
+    long_name = "pé-" + "x" * (MAX_WITNESS_STRLEN + 10)
+    pods = [
+        _pod("pød-世界"),          # unicode witness
+        _pod(long_name),                          # oversize: veto
+        _pod("plain"),
+        _pod("skipped", flag="ok"),               # no violation
+    ]
+    drv, client = _vecdiff_client(pods)
+    assert drv._msg_plan("VecDiff") is not None
+    reviews = drv._inventory_reviews(TARGET)
+    cons = drv._constraints(TARGET)
+    r_vec, r_exact, n, active = _materialize_both(drv, "VecDiff", cons,
+                                                  reviews)
+    assert active and n == 3
+    assert [_key(r) for r in r_vec] == [_key(r) for r in r_exact]
+    msgs = sorted(r.msg for r in r_vec)
+    assert any("uñícødé «note»" in m for m in msgs)
+    assert any(long_name in m for m in msgs)
+    assert all("max: 3)" in m for m in msgs)
+
+
+def test_absent_and_nonstring_witnesses_veto_to_exact():
+    """A pair whose witness is absent or non-string must fall back to
+    the exact evaluator (which emits nothing for an undefined msg
+    binding) — never render a wrong message."""
+    pods = [
+        _pod("named"),
+        {"apiVersion": "v1", "kind": "Pod",       # no namespace witness
+         "metadata": {"name": "no-ns", "labels": {"flag": "bad"}}},
+    ]
+    drv, client = _vecdiff_client(pods)
+    reviews = drv._inventory_reviews(TARGET)
+    cons = drv._constraints(TARGET)
+    r_vec, r_exact, _n, active = _materialize_both(drv, "VecDiff", cons,
+                                                   reviews)
+    assert active
+    assert [_key(r) for r in r_vec] == [_key(r) for r in r_exact]
+    # the cluster-scoped pod has no namespace: the msg binding fails,
+    # so only the namespaced pod produces a violation on BOTH paths
+    assert sorted(r.review["name"] for r in r_vec) == ["named"]
+
+
+def test_undefined_param_witness_skips_constraint():
+    """A constraint whose parameters lack the msg witness path emits no
+    violations (the msg binding is undefined) — the vectorized path
+    must skip those columns exactly like the evaluator."""
+    drv, client = mk_client()
+    client.add_template(VECDIFF_TEMPLATE)
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "VecDiff", "metadata": {"name": "no-note"},
+        "spec": {"parameters": {"max": 1}},  # no "note": msg undefined
+    })
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "VecDiff", "metadata": {"name": "with-note"},
+        "spec": {"parameters": {"note": "n", "max": 1}},
+    })
+    for p in [_pod("a"), _pod("b")]:
+        client.add_data(p)
+    reviews = drv._inventory_reviews(TARGET)
+    cons = drv._constraints(TARGET)
+    r_vec, r_exact, _n, active = _materialize_both(drv, "VecDiff", cons,
+                                                   reviews)
+    assert active
+    assert [_key(r) for r in r_vec] == [_key(r) for r in r_exact]
+    assert {r.constraint["metadata"]["name"] for r in r_vec} == \
+        {"with-note"}
+
+
+# ----------------------------------------------------------- capping
+
+
+def test_cap_before_materialization():
+    """With audit_violations_cap armed (as the audit manager arms it),
+    each constraint's first `cap` pairs materialize full messages and
+    the rest are count-only — totals and publishable entries identical
+    to the uncapped sweep."""
+    pods = [_pod(f"p-{i:03d}") for i in range(30)]
+    drv, client = _vecdiff_client(pods)
+    reviews = drv._inventory_reviews(TARGET)
+    cons = drv._constraints(TARGET)
+    lookup_ns = drv._namespace_lookup(TARGET)
+    inventory = drv._inventory_tree(TARGET)
+    ct = drv.compiled_for("VecDiff")
+    mask = match_masks(cons, reviews, lookup_ns)
+    cand = np.flatnonzero(mask.any(axis=1))
+    cand_reviews = [reviews[int(i)] for i in cand]
+    rows, cols = drv.eval_compiled_pairs(
+        ct, "VecDiff", cand_reviews, cons,
+        feat_key=(drv._data_gen, hash(cand.tobytes())), cand=cand,
+        target=TARGET)
+    keep = mask[cand[rows], cols]
+    rows, cols = rows[keep], cols[keep]
+
+    uncapped = drv.materialize_pairs(TARGET, cons, cand_reviews, rows,
+                                     cols, inventory, cand=cand)
+    drv.audit_violations_cap = 5
+    drv._in_audit_sweep = True
+    try:
+        capped = drv.materialize_pairs(TARGET, cons, cand_reviews, rows,
+                                       cols, inventory, cand=cand)
+    finally:
+        drv._in_audit_sweep = False
+        drv.audit_violations_cap = None
+    assert len(capped) == len(uncapped) == 30  # totals intact
+    # the first 5 per constraint are fully materialized, byte-equal to
+    # the uncapped sweep; the rest are count-only
+    assert [r.msg for r in capped[:5]] == [r.msg for r in uncapped[:5]]
+    assert all(r.msg == "" for r in capped[5:])
+    assert all(r.enforcement_action == uncapped[i].enforcement_action
+               for i, r in enumerate(capped))
+
+
+def test_cap_ignored_outside_audit_sweep():
+    """Previews and direct materialization stay uncapped even when the
+    manager armed the cap on the shared driver."""
+    pods = [_pod(f"q-{i}") for i in range(8)]
+    drv, client = _vecdiff_client(pods)
+    drv.audit_violations_cap = 2  # armed, but no sweep flag
+    reviews = drv._inventory_reviews(TARGET)
+    cons = drv._constraints(TARGET)
+    r_vec, r_exact, _n, _a = _materialize_both(drv, "VecDiff", cons,
+                                               reviews)
+    assert all(r.msg for r in r_vec)
+    assert [_key(r) for r in r_vec] == [_key(r) for r in r_exact]
+
+
+def test_manager_sweep_caps_direct_audit_stays_uncapped():
+    """End to end: a manager-driven sweep caps materialization at its
+    status limit, while a direct client.audit() on the SAME driver
+    right after stays uncapped — including not being served capped
+    messages from the results delta cache."""
+    from gatekeeper_tpu.control.audit import AuditManager
+    from gatekeeper_tpu.control.kube import FakeKube
+
+    pods = [_pod(f"m-{i:02d}") for i in range(12)]
+    drv, client = _vecdiff_client(pods)
+    # force the device sweep path at this tiny scale so the
+    # materialize_pairs pipeline (where the cap lives) actually runs
+    drv._dev_batch_lat_s = 1e-6
+    drv._host_pair_rate = 1.0
+    kube = FakeKube()
+    mgr = AuditManager(kube, client, audit_from_cache=True,
+                       constraint_violations_limit=4,
+                       gc_stale_statuses=False,
+                       stream_status_writes=False)
+    res = mgr.audit_once()
+    assert len(res) == 12  # totals are never capped
+    assert sum(1 for r in res if r.msg) == 4
+    assert all(r.msg == "" for r in res[4:])
+    # direct caller on the shared driver: full messages, even though
+    # the delta cache was just populated by the capped sweep
+    direct = client.audit().results()
+    assert len(direct) == 12
+    assert all(r.msg for r in direct)
+
+
+# ------------------------------------------------- witness cache reuse
+
+
+def test_witness_columns_cached_and_invalidated():
+    """Witness columns over the stable review list are reused across
+    sweeps and rebuilt after an inventory write."""
+    pods = [_pod(f"w-{i}") for i in range(6)]
+    drv, client = _vecdiff_client(pods)
+    reviews = drv._inventory_reviews(TARGET)
+    cons = drv._constraints(TARGET)
+    r1, _e1, _n, active = _materialize_both(drv, "VecDiff", cons, reviews)
+    assert active
+    keys = [k for k in drv._witcols if k[0] == TARGET]
+    assert keys
+    ent_before = drv._witcols[keys[0]]
+    r2, _e2, _n2, _a2 = _materialize_both(drv, "VecDiff", cons, reviews)
+    assert drv._witcols[keys[0]] is ent_before  # cache hit
+    # rename a pod: the column must rebuild and messages must follow
+    client.add_data(_pod("w-renamed"))
+    reviews = drv._inventory_reviews(TARGET)
+    r3, e3, _n3, _a3 = _materialize_both(drv, "VecDiff", cons, reviews)
+    assert [_key(r) for r in r3] == [_key(r) for r in e3]
+    assert any("w-renamed" in r.msg for r in r3)

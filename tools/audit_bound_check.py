@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""CI audit-bound check: the steady audit must stay SWEEP-bound.
+
+Runs a reduced-scale BENCH config 3 (full pod-security-policy library
+over synthetic pods) in-process, measures the non-delta steady sweep's
+phase breakdown (bench_configs.audit_phase_breakdown), and asserts
+
+    materialize_s <= 2 * sweep_wall_s  (+ a small absolute floor)
+
+— the ROADMAP item 3 regression gate: host-side violation-message
+materialization must not grow back past the device sweep it decorates.
+The absolute floor (ABS_FLOOR_S) absorbs timer noise at reduced scale,
+where both phases are tens of milliseconds on a CI host.
+
+Prints the full phase breakdown always; exits 1 on a violated bound
+(the CI job is non-blocking — the signal is the printed breakdown).
+
+    BENCH_SCALE=0.1 python tools/audit_bound_check.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+ABS_FLOOR_S = float(os.environ.get("AUDIT_BOUND_FLOOR_S", "0.3"))
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench_configs as bc
+    from gatekeeper_tpu import policies
+
+    n = int(50_000 * bc.SCALE)
+    drv, client = bc.new_client()
+    for name in policies.names():
+        if name.startswith("pod-security-policy/"):
+            client.add_template(policies.load(name))
+    for kind, cname, params in bc.PSP_CONSTRAINTS:
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+            "kind": kind, "metadata": {"name": cname},
+            "spec": ({"parameters": params} if params else {}),
+        })
+    for o in bc.synth_pods_psp(n):
+        client.add_data(o)
+    # force the device sweep path: at reduced scale the cost model
+    # would (correctly) keep the whole audit on the host, measuring
+    # nothing — this check exists to watch the device-sweep/
+    # materialize ratio, so pin the dispatch decision
+    drv._dev_batch_lat_s = 1e-6
+    drv._host_pair_rate = 1.0
+    t0 = time.time()
+    client.audit()  # cold: compiles + extraction
+    while drv.warm_status()["compiling"] and time.time() - t0 < 600:
+        time.sleep(0.2)
+    phases = bc.audit_phase_breakdown(drv, client, iters=3)
+    out = {"check": "audit-bound", "objects": n,
+           "constraints": len(bc.PSP_CONSTRAINTS), **phases}
+    sweep = phases["sweep_wall_s"]
+    mat = phases["materialize_s"]
+    bound = 2 * sweep + ABS_FLOOR_S
+    out["bound_s"] = round(bound, 4)
+    out["ok"] = mat <= bound
+    print(json.dumps(out))
+    if not out["ok"]:
+        print(f"AUDIT-BOUND VIOLATED: materialize_s={mat:.3f}s exceeds "
+              f"2x sweep_wall_s + {ABS_FLOOR_S}s = {bound:.3f}s — "
+              f"host-side message materialization is dominating the "
+              f"device sweep again (phase breakdown above)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
