@@ -58,18 +58,37 @@ def _device_sanity() -> None:
         raise
 
 
-def main() -> None:
+def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--config", type=int, nargs="+", default=None,
                    help="run only these bench_configs.py configs "
                         "(e.g. --config 7 for the mutation micro-batch "
                         "bench) and skip the audit headline")
+    p.add_argument("--trend", action="store_true",
+                   help="skip the benchmark entirely and print the "
+                        "perf-trend report over the committed "
+                        "BENCH_r*.json history (tools/bench_trend.py; "
+                        "run that directly with --check for the "
+                        "CI regression gate)")
+    p.add_argument("--trend-check", action="store_true",
+                   help="with --trend: exit 1 when any gated headline "
+                        "metric's latest round regressed >25%% vs its "
+                        "best prior round")
     args = p.parse_args()
+    if args.trend or args.trend_check:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "bench_trend.py")
+        spec = importlib.util.spec_from_file_location("bench_trend", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main(["--check"] if args.trend_check else [])
     _device_sanity()
     if args.config:
         import bench_configs
-        bench_configs.run(args.config)
-        return
+        # per-config failures are recorded (and printed) individually;
+        # the exit code still fails a blocking CI step on any of them
+        return 1 if bench_configs.run(args.config) else 0
     t_setup = time.time()
     from gatekeeper_tpu.client import Backend
     from gatekeeper_tpu.ir import TpuDriver
@@ -207,14 +226,15 @@ def main() -> None:
     import subprocess
 
     configs = {}
+    want_configs = ["1", "2", "3", "5", "6", "7", "9", "10", "11", "12"]
     try:
         # FULL scale by default: BENCH_r0N.json must carry the
         # 10k-object and 50k-pod numbers, not reduced-scale stand-ins
         env = dict(os.environ)
         proc = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "bench_configs.py"),
-             "1", "2", "3", "5", "6", "7", "9", "10", "11", "12"],
+                os.path.abspath(__file__)), "bench_configs.py")]
+            + want_configs,
             capture_output=True, text=True, env=env,
             timeout=int(os.environ.get("BENCH_CONFIGS_TIMEOUT", 2700)))
         for line in proc.stdout.splitlines():
@@ -227,6 +247,17 @@ def main() -> None:
                     pass
         if proc.returncode != 0 and not configs:
             configs["error"] = proc.stderr[-500:]
+        # a config that produced NO line at all (hard crash before its
+        # own error record, cut-off output) must still be
+        # distinguishable from "regressed" in the trend table: record
+        # an explicit per-config error instead of silent absence
+        for c in want_configs:
+            if c not in configs:
+                configs[c] = {
+                    "config": int(c),
+                    "error": "no output (crashed or cut off; rc="
+                             f"{proc.returncode}) "
+                             + proc.stderr[-200:].strip()}
     except subprocess.TimeoutExpired as e:
         for line in (e.stdout or "").splitlines():
             line = line.strip()
@@ -237,6 +268,10 @@ def main() -> None:
                 except ValueError:
                     pass
         configs["timeout"] = True
+        for c in want_configs:
+            if c not in configs:
+                configs[c] = {"config": int(c),
+                              "error": "timeout before this config ran"}
     except Exception as e:  # never lose the headline to the side configs
         configs["error"] = str(e)[:200]
 

@@ -1045,6 +1045,8 @@ def _engine_child(socket_path: str) -> None:
     from gatekeeper_tpu.control.webhook import (
         MicroBatcher, NamespaceLabelHandler, ValidationHandler)
 
+    from gatekeeper_tpu.control import metrics as gmetrics
+
     _, client = _general_library_client()
     batcher = MicroBatcher(client, max_wait=0.003, max_batch=256)
     validation = ValidationHandler(client, kube=None, batcher=batcher)
@@ -1056,7 +1058,26 @@ def _engine_child(socket_path: str) -> None:
     engine = BackplaneEngine(socket_path, validation=validation,
                              ns_label=NamespaceLabelHandler(()))
     engine.start()
-    print("READY", flush=True)
+    # capacity attribution during the bench: this engine serves
+    # /metrics (ephemeral port, announced on the READY line) with the
+    # saturation probes armed, so one scrape mid-sweep reads batch
+    # fill/seal reasons, queue depths, and the eval duty cycle
+    gmetrics.register_saturation_probe(
+        "admission-queue",
+        lambda: gmetrics.report_queue_depth("admission",
+                                            batcher.pending()))
+    drv = client.driver
+    if hasattr(drv, "duty_cycle"):
+        gmetrics.register_saturation_probe(
+            "engine-duty-cycle",
+            lambda: gmetrics.report_duty_cycle(drv.duty_cycle()))
+    mport = 0
+    try:
+        mserver = gmetrics.serve(0, addr="127.0.0.1")
+        mport = mserver.server_address[1]
+    except OSError:
+        pass
+    print(f"READY {mport}", flush=True)
     threading.Event().wait()
 
 
@@ -1291,10 +1312,13 @@ def config5():
 
     def _spawn_engines(n: int, tag: str) -> tuple:
         """Spawn n --serve-engine children, each on its own socket.
-        Returns (procs, socket_paths); raises with the child's stderr
-        tail when one fails to come up (the caller records an explicit
-        skip — a silent empty sweep hid exactly this in BENCH_r05)."""
-        procs, socks = [], []
+        Returns (procs, socket_paths, metrics_ports); raises with the
+        child's stderr tail when one fails to come up (the caller
+        records an explicit skip — a silent empty sweep hid exactly
+        this in BENCH_r05). The READY line carries each engine's
+        /metrics port (0 = unavailable) for the mid-sweep
+        saturation scrape."""
+        procs, socks, mports = [], [], []
         try:
             for k in range(n):
                 sp = os.path.join(
@@ -1313,15 +1337,74 @@ def config5():
                     raise RuntimeError(
                         f"backplane engine {k} failed to start: "
                         f"{err or 'no stderr'}")
+                parts = (line or "").split()
+                try:
+                    mports.append(int(parts[1]))
+                except (IndexError, ValueError):
+                    mports.append(0)
                 # drain later output so a full pipe can never block
                 import threading as _th
                 _th.Thread(target=proc.stdout.read, daemon=True).start()
                 _th.Thread(target=proc.stderr.read, daemon=True).start()
-            return procs, socks
+            return procs, socks, mports
         except Exception:
             for p in procs:
                 p.kill()
             raise
+
+    def _scrape_raw(mport: int) -> dict:
+        """Raw attribution counters/gauges from one /metrics scrape of
+        the serving engine (admission plane only)."""
+        import re as _re
+        import urllib.request
+
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/metrics",
+            timeout=5).read().decode()
+        out: dict = {"seals": {}, "fill_sum": 0.0, "fill_count": 0,
+                     "queue_depth": {}, "duty": None}
+        for m in _re.finditer(
+                r'gatekeeper_tpu_batch_seal_total\{plane="admission",'
+                r'reason="([^"]+)"\} (\S+)', text):
+            out["seals"][m.group(1)] = int(float(m.group(2)))
+        fs = _re.search(r'gatekeeper_tpu_batch_fill_ratio_sum'
+                        r'\{plane="admission"\} (\S+)', text)
+        fc = _re.search(r'gatekeeper_tpu_batch_fill_ratio_count'
+                        r'\{plane="admission"\} (\S+)', text)
+        if fs and fc:
+            out["fill_sum"] = float(fs.group(1))
+            out["fill_count"] = int(float(fc.group(1)))
+        for m in _re.finditer(
+                r'gatekeeper_tpu_queue_depth\{[^}]*queue="([^"]+)"\}'
+                r' (\S+)', text):
+            out["queue_depth"][m.group(1)] = float(m.group(2))
+        m = _re.search(
+            r'gatekeeper_tpu_device_duty_cycle\{engine="[^"]*"\} (\S+)',
+            text)
+        if m:
+            out["duty"] = float(m.group(1))
+        return out
+
+    def _attribution_delta(before: dict, after: dict) -> dict:
+        """This topology's attribution read: seal/fill counter DELTAS
+        between the scrape before and after its rate sweep (one
+        long-lived engine serves every topology, so cumulative totals
+        would smear earlier topologies' traffic in), plus the
+        post-sweep duty cycle (its sample window spans the sweep) and
+        queue depths."""
+        seals = {r: after["seals"].get(r, 0) - before["seals"].get(r, 0)
+                 for r in set(after["seals"]) | set(before["seals"])}
+        out: dict = {"batch_seal_reasons":
+                     {r: n for r, n in sorted(seals.items()) if n > 0}}
+        dn = after["fill_count"] - before["fill_count"]
+        if dn > 0:
+            out["batch_fill_ratio_mean"] = round(
+                (after["fill_sum"] - before["fill_sum"]) / dn, 4)
+            out["batches_sealed"] = dn
+        out["queue_depth"] = after["queue_depth"]
+        if after["duty"] is not None:
+            out["device_duty_cycle"] = after["duty"]
+        return out
 
     worker_counts = [int(w) for w in os.environ.get(
         "BENCH_C5_WORKERS", "1,2,4").split(",") if w.strip()]
@@ -1338,18 +1421,34 @@ def config5():
     else:
         engine_procs: list = []
         try:
-            engine_procs, socks = _spawn_engines(1, "w")
+            engine_procs, socks, mports = _spawn_engines(1, "w")
             for n_workers in worker_counts:
                 fronts = FrontendSupervisor(n_workers, socks[0],
                                             port=0, addr="127.0.0.1")
                 fronts.start()
+                scrape: dict = {}
                 try:
                     mults = (1, 2, 3, 4, 6, 8) if n_workers > 1 \
                         else (1, 2)
                     rates = sorted({int(base * m) for m in mults})
+                    # counter DELTAS across this topology's own sweep:
+                    # one long-lived engine serves every worker count,
+                    # so cumulative totals would smear topologies
+                    pre = None
+                    if mports and mports[0]:
+                        try:
+                            pre = _scrape_raw(mports[0])
+                        except Exception:
+                            pre = None
                     sweep_n, sus_n = _run_sweep(fronts.port, rates,
                                                 n_procs, duration,
                                                 here)
+                    if pre is not None:
+                        try:
+                            scrape = _attribution_delta(
+                                pre, _scrape_raw(mports[0]))
+                        except Exception as e:
+                            scrape = {"error": str(e)[:200]}
                 finally:
                     fronts.stop()
                 best_n = sus_n or (max(sweep_n,
@@ -1361,6 +1460,7 @@ def config5():
                     "slo_met": sus_n is not None,
                     "p50_ms": best_n.get("p50_ms"),
                     "p99_ms": best_n.get("p99_ms"),
+                    "saturation": scrape or None,
                     "sweep": sweep_n,
                 })
                 if sus_n is not None and (
@@ -1392,8 +1492,8 @@ def config5():
         for n_engines in engine_counts:
             engine_procs = []
             try:
-                engine_procs, socks = _spawn_engines(n_engines,
-                                                     f"e{n_engines}-")
+                engine_procs, socks, _mp = _spawn_engines(
+                    n_engines, f"e{n_engines}-")
                 fronts = FrontendSupervisor(2, socks, port=0,
                                             addr="127.0.0.1")
                 fronts.start()
@@ -1462,6 +1562,14 @@ def config5():
                       "with the load generators; multi_worker_sweep = "
                       "pre-forked frontends over the shared batching "
                       "backplane (--admission-workers)",
+        # the attribution read (ISSUE 13 acceptance): seal-reason /
+        # fill / queue-depth / duty-cycle deltas across one topology's
+        # open-loop sweep — the topology whose sweep actually drove
+        # the batcher (later topologies can serve entirely from the
+        # decision cache and seal nothing new)
+        "saturation_scrape": max(
+            (e["saturation"] for e in mw_sweep if e.get("saturation")),
+            key=lambda s: s.get("batches_sealed", 0), default=None),
         "sweep": sweep,
         "multi_worker_sweep": sweep_or_skip(mw_sweep,
                                             "multi_worker_sweep"),
@@ -2067,16 +2175,32 @@ def config12():
     }))
 
 
-def run(which: list[int]) -> None:
+def run(which: list[int]) -> int:
+    """Run the named configs. A config-level exception no longer kills
+    the remaining configs OR vanishes into the log: it prints an
+    explicit `{"config": N, "error": ...}` JSON line (bench.py records
+    it in the output JSON, so tools/bench_trend.py can tell
+    "regressed" from "didn't run") and the process still exits
+    nonzero at the end so a blocking CI step on one config fails."""
     table = {1: config1, 2: config2, 3: config3, 5: config5, 6: config6,
              7: config7, 8: config8, 9: config9, 10: config10,
              11: config11, 12: config12}
+    failed = 0
     for c in which:
         if c not in table:
             sys.exit(f"unknown bench config {c}: choose from "
                      f"{sorted(table)} (config 4 is bench.py's headline — "
                      "run `python bench.py` with no --config)")
-        table[c]()
+        try:
+            table[c]()
+        except Exception as e:
+            failed += 1
+            import traceback
+            traceback.print_exc()
+            print(json.dumps({
+                "config": c,
+                "error": f"{type(e).__name__}: {e}"[:300]}), flush=True)
+    return failed
 
 
 def main() -> None:
@@ -2094,7 +2218,9 @@ def main() -> None:
     if sys.argv[1:2] == ["--coldwarm-child"]:
         _coldwarm_child(sys.argv[2])
         return
-    run([int(a) for a in sys.argv[1:]] or [1, 2, 3, 5, 6, 7])
+    failed = run([int(a) for a in sys.argv[1:]] or [1, 2, 3, 5, 6, 7])
+    if failed:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

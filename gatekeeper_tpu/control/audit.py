@@ -816,8 +816,19 @@ class AuditManager:
 
     def stop(self) -> None:
         self._stop.set()
+        metrics.unregister_saturation_probe("audit-stream-pending")
         with self._stream_cv:
             self._stream_cv.notify_all()
+        if self._stream_thread is not None:
+            # wait the stream loop out BEFORE zeroing: an in-flight
+            # flush's finally clause re-exports pending_count(), and
+            # with the probe already unregistered a zero written under
+            # it would be overwritten into a phantom backlog forever
+            self._stream_thread.join(timeout=10.0)
+        if self.stream_audit and self.tracker is not None:
+            # the gauge is SET-only: a stopped stream must not export
+            # its last backlog forever
+            metrics.report_stream_pending(0)
         if self.tracker is not None:
             self.tracker.stop()
 
@@ -894,6 +905,14 @@ class AuditManager:
 
         tracker.track_event_times = True
         tracker.on_event = on_event
+        # the streaming backlog was only visible in logs: export the
+        # dirty-key depth as a gauge, refreshed around every flush AND
+        # on each scrape, so backlog growth (detection latency about to
+        # follow) is scrapeable before it becomes a latency incident
+        metrics.register_saturation_probe(
+            "audit-stream-pending",
+            lambda: metrics.report_stream_pending(
+                tracker.pending_count()))
         log.info("streaming audit armed",
                  details={"window_ms": round(self.stream_window_s * 1e3),
                           "max_batch": self.stream_max_batch})
@@ -934,6 +953,10 @@ class AuditManager:
                 metrics.report_stream_flush("error")
                 log.error("stream flush failed; interval backstop will "
                           "reconcile", details=str(e))
+            finally:
+                # per-flush gauge refresh: pending drops to ~0 after a
+                # healthy flush; a stuck writer leaves it growing
+                metrics.report_stream_pending(tracker.pending_count())
 
     def _stream_flush(self) -> None:
         tracker = self.tracker

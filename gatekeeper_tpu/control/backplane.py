@@ -236,6 +236,17 @@ class BackplaneEngine:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="backplane-accept", daemon=True)
         self._accept_thread.start()
+
+        def _probe():
+            from . import metrics
+            with self._inflight_lock:
+                n = self._inflight
+            metrics.report_queue_depth("backplane_engine", n,
+                                       engine=self.engine_id)
+
+        from . import metrics as _metrics
+        _metrics.register_saturation_probe(
+            f"backplane-engine-{self.engine_id}", _probe)
         log.info("backplane engine listening",
                  details={"socket": self.socket_path})
 
@@ -253,6 +264,11 @@ class BackplaneEngine:
         suite uses this to emulate an engine crash (kill -9) under a
         live burst: every frontend's in-flight forward fails over to
         the failure-stance answer."""
+        from . import metrics
+        metrics.unregister_saturation_probe(
+            f"backplane-engine-{self.engine_id}")
+        metrics.report_queue_depth("backplane_engine", 0,
+                                   engine=self.engine_id)
         self._stop.set()
         if self._listener is not None:
             try:
@@ -280,6 +296,11 @@ class BackplaneEngine:
         """Called AFTER the frontends drained: no new frames arrive, so
         finish the in-flight verdicts, drain the shared batcher, and
         tear the listener down."""
+        from . import metrics
+        metrics.unregister_saturation_probe(
+            f"backplane-engine-{self.engine_id}")
+        metrics.report_queue_depth("backplane_engine", 0,
+                                   engine=self.engine_id)
         self._stop.set()
         if self._listener is not None:
             try:
@@ -466,7 +487,18 @@ class BackplaneEngine:
             pass
         finally:
             with self._conns_lock:
-                self._conns.pop(fd, None)
+                ent = self._conns.pop(fd, None)
+            worker = ent[2] if ent else None
+            if worker is not None:
+                # gauges only ever SET: a frontend that died mid-burst
+                # would otherwise export its last (high) in-flight
+                # forever — zero it, since a dead frontend truthfully
+                # has nothing in flight
+                try:
+                    from . import metrics
+                    metrics.report_backplane_inflight(worker, 0)
+                except Exception:
+                    pass
             try:
                 conn.close()
             except OSError:
@@ -492,6 +524,12 @@ class BackplaneEngine:
         errs = int(stats.get("errors") or 0)
         if errs:
             metrics.report_backplane_error(worker, errs)
+        if "inflight" in stats:
+            # sampled per stats interval: how many forwarded reviews
+            # this frontend is still waiting on — the saturation read
+            # that separates "frontends backed up" from "engine idle"
+            metrics.report_backplane_inflight(
+                worker, int(stats.get("inflight") or 0))
         # frontend-side span deltas (sampled requests only): each
         # frontend ships aggregated histograms for the stages it owns
         # (frontend_parse) — the engine's trace sink skips those
@@ -912,6 +950,9 @@ class BackplaneRouter:
         for c in self.clients:
             c.close()
 
+    def inflight(self) -> int:
+        return sum(c.inflight() for c in self.clients)
+
     def call(self, path: str, body: bytes, timeout_s: float,
              deadline: float,
              trace_ctx: Optional[tuple] = None) -> tuple[int, bytes]:
@@ -1058,6 +1099,7 @@ class FrontendServer:
         self.port = self.http.port
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         name="frontend", daemon=True)
+        self._last_inflight = 0
         self._stats_stop = threading.Event()
         self._stats_thread = threading.Thread(
             target=self._stats_loop, name="frontend-stats", daemon=True)
@@ -1157,8 +1199,14 @@ class FrontendServer:
     def _stats_loop(self) -> None:
         while not self._stats_stop.wait(STATS_INTERVAL_S):
             stats = self.stats.drain(self.worker_id)
-            if stats is not None:
-                self.client.send_stats(stats)
+            inflight = self.client.inflight()
+            if stats is None:
+                if not inflight and self._last_inflight == 0:
+                    continue  # nothing moved; skip the frame
+                stats = {"worker": self.worker_id}
+            stats["inflight"] = inflight
+            self._last_inflight = inflight
+            self.client.send_stats(stats)
 
     def stop(self, drain_timeout: float = 10.0) -> None:
         """Frontend drain: stop accepting, finish in-flight HTTP

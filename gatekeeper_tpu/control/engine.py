@@ -215,13 +215,37 @@ def main(argv=None) -> int:
             max_queue=args.admission_max_queue,
             default_timeout=args.admission_default_timeout)
     sink = LibrarySink(client, mutation_system)
+    # saturation probes, same set the primary registers: this child has
+    # no /metrics server, so the probes refresh on each M-frame stats
+    # poll instead and the gauges relay to the primary (engine-labeled
+    # series — per-chip duty cycle / queue depth must be readable off
+    # the primary's one scrape, not just for engine 0)
+    if validation is not None:
+        metrics.register_saturation_probe(
+            "admission-queue",
+            lambda b=validation.batcher: metrics.report_queue_depth(
+                "admission", b.pending(), engine=args.engine_id))
+    if mutation is not None:
+        metrics.register_saturation_probe(
+            "mutation-queue",
+            lambda b=mutation.batcher: metrics.report_queue_depth(
+                "mutation", b.pending(), engine=args.engine_id))
+    if hasattr(driver, "duty_cycle"):
+        metrics.register_saturation_probe(
+            "engine-duty-cycle",
+            lambda: metrics.report_duty_cycle(driver.duty_cycle()))
+
+    def stats_source():
+        metrics.run_saturation_probes()
+        return metrics.engine_stats_snapshot()
+
     engine = BackplaneEngine(
         args.socket, validation=validation, ns_label=ns_label,
         mutation=mutation,
         default_timeout=args.admission_default_timeout,
         engine_id=args.engine_id,
         library_sink=sink,
-        stats_source=metrics.engine_stats_snapshot)
+        stats_source=stats_source)
     # refuse admission until the supervisor's first full sync lands:
     # the frontends' router fails those requests over to synced engines
     engine.ready_check = lambda: sink.synced

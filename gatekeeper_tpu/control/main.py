@@ -301,6 +301,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds beyond which a completed trace also "
                         "logs a structured slow-request line with its "
                         "full stage decomposition; <= 0 disables")
+    p.add_argument("--slo-admission-p99", type=float, default=0.1,
+                   help="admission-latency SLO threshold (seconds): the "
+                        "objective promises 99%% of admission decisions "
+                        "complete under this, compiled against the "
+                        "request_duration_seconds histogram into the "
+                        "gatekeeper_tpu_slo_burn_rate{slo=\"admission_"
+                        "p99_latency\"} gauges (5m/1h windows). Should "
+                        "be one of the histogram's bucket bounds")
+    p.add_argument("--slo-availability-target", type=float, default=0.999,
+                   help="admission availability SLO target: at most "
+                        "1-target of requests may end shed/timeout/"
+                        "error (reads request_count). Burn rate 1.0 = "
+                        "consuming the error budget exactly at the "
+                        "sustained-compliance rate")
+    p.add_argument("--slo-detection-p99", type=float, default=1.0,
+                   help="violation-detection SLO threshold (seconds): "
+                        "99%% of streaming-audit detections (watch "
+                        "event -> status write) must complete under "
+                        "this (reads gatekeeper_tpu_violation_"
+                        "detection_seconds). Should be one of that "
+                        "histogram's bucket bounds")
+    p.add_argument("--slo-sample-interval", type=float, default=15.0,
+                   help="seconds between SLO totals samples (the ring "
+                        "spans the longest burn window at this "
+                        "cadence); <= 0 disables the SLO engine, "
+                        "burn-rate gauges, and /debug/slo")
     p.add_argument("--debug-endpoints", nargs="?", const=True,
                    default=True, type=_parse_bool,
                    help="serve /debug/traces (flight-recorder dump), "
@@ -352,6 +378,29 @@ class Runtime:
         gtrace.TRACER.configure(
             getattr(args, "trace_sample_rate", 0.01),
             getattr(args, "trace_slow_threshold", 1.0))
+        # SLO layer: declarative objectives compiled against the
+        # existing request/detection series into 5m/1h burn-rate
+        # gauges + /debug/slo (control/slo.py). Sample interval <= 0
+        # disables the whole layer.
+        self.slo = None
+        slo_interval = getattr(args, "slo_sample_interval", 15.0) or 0
+        if slo_interval > 0:
+            from .slo import SloEngine, default_objectives
+            try:
+                self.slo = SloEngine(
+                    default_objectives(
+                        admission_p99_s=getattr(
+                            args, "slo_admission_p99", 0.1),
+                        availability_target=getattr(
+                            args, "slo_availability_target", 0.999),
+                        detection_p99_s=getattr(
+                            args, "slo_detection_p99", 1.0)),
+                    sample_interval_s=slo_interval)
+            except ValueError as e:
+                # a nonsense target (e.g. 1.0) disables the layer
+                # loudly instead of crash-looping the pod
+                log.warning("SLO objectives invalid; SLO layer "
+                            "disabled", details=str(e))
         # a debug profile window must not run twice concurrently
         self._profile_until = 0.0
         self._profile_lock = threading.Lock()
@@ -794,6 +843,10 @@ class Runtime:
             "traces": lambda q: gtrace.TRACER.recorder.dump(),
             "templates": self._debug_templates,
             "profile": self._debug_profile,
+            "slo": lambda q: (self.slo.status() if self.slo is not None
+                              else {"disabled": True,
+                                    "hint": "--slo-sample-interval > 0 "
+                                            "enables the SLO engine"}),
         }
 
     def _debug_templates(self, query: str) -> dict:
@@ -889,7 +942,35 @@ class Runtime:
         ]:
             self.kube.register_kind(gvk, namespaced=namespaced)
 
+    def _register_saturation_probes(self) -> None:
+        """Scrape-time gauge refreshers for the capacity-attribution
+        read: admission/mutation queue depth (the --admission-max-queue
+        counter itself) and the engine's eval duty cycle. The
+        backplane engine and the streaming audit register their own
+        probes; frontends ship per-worker in-flight over S frames."""
+        if self.validation_handler is not None:
+            batcher = self.validation_handler.batcher
+            metrics.register_saturation_probe(
+                "admission-queue",
+                lambda: metrics.report_queue_depth(
+                    "admission", batcher.pending()))
+        if self.mutation_handler is not None:
+            mbatcher = self.mutation_handler.batcher
+            metrics.register_saturation_probe(
+                "mutation-queue",
+                lambda: metrics.report_queue_depth(
+                    "mutation", mbatcher.pending()))
+        driver = getattr(self.opa, "driver", None)
+        if hasattr(driver, "duty_cycle"):
+            metrics.register_saturation_probe(
+                "engine-duty-cycle",
+                lambda: metrics.report_duty_cycle(driver.duty_cycle()))
+
     def start(self) -> None:
+        # build identity FIRST: every scrape of this process carries
+        # the version/jax/platform/device-count join gauge
+        metrics.report_build_info()
+        self._register_saturation_probes()
         debug = (self.debug_providers()
                  if getattr(self.args, "debug_endpoints", True) else None)
         if self.args.metrics_backend == "prometheus":
@@ -1010,6 +1091,8 @@ class Runtime:
                 self.backplane.connected)
         if self.snapshots is not None:
             self.snapshots.start()
+        if self.slo is not None:
+            self.slo.start()
         self._ready = True
         # long-lived-server GC tuning: everything built so far (engine,
         # policy caches, codegen closures) is effectively permanent;
@@ -1023,6 +1106,20 @@ class Runtime:
 
     def stop(self) -> None:
         self._ready = False
+        if self.slo is not None:
+            self.slo.stop()
+        for probe in ("admission-queue", "mutation-queue",
+                      "engine-duty-cycle"):
+            metrics.unregister_saturation_probe(probe)
+        # the gauges are SET-only: zero the stopped plane's depths (and
+        # its duty cycle) so a still-running process (embedders, tests)
+        # doesn't export the last sampled value forever
+        if self.validation_handler is not None:
+            metrics.report_queue_depth("admission", 0)
+        if self.mutation_handler is not None:
+            metrics.report_queue_depth("mutation", 0)
+        if hasattr(getattr(self.opa, "driver", None), "duty_cycle"):
+            metrics.report_duty_cycle(0.0)
         if self.elector is not None:
             # graceful lease release FIRST: the surviving replica takes
             # over immediately instead of waiting out the lease duration

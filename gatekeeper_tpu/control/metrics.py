@@ -16,7 +16,7 @@ import os
 import threading
 import time
 from collections import defaultdict
-from typing import Optional
+from typing import Callable, Optional
 
 
 class _Metric:
@@ -32,6 +32,11 @@ class _Metric:
         self.bucket_counts: dict[tuple, list] = {}
         self.sums: dict[tuple, float] = defaultdict(float)
         self.counts: dict[tuple, int] = defaultdict(int)
+        # OpenMetrics exemplars: per label-set, per bucket index, the
+        # LATEST (trace_id, observed value, unix ts) that landed in
+        # that bucket — a slow p99 bucket links straight to its
+        # /debug/traces flight-recorder entry
+        self.exemplars: dict[tuple, dict[int, tuple]] = {}
 
 
 class Registry:
@@ -78,7 +83,11 @@ class Registry:
 
     def observe(self, name: str, help_: str, value: float,
                 buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 60,
-                         300), **labels) -> None:
+                         300), exemplar: Optional[str] = None,
+                **labels) -> None:
+        """`exemplar` (a sampled trace id) attaches to the bucket this
+        observation lands in; the OpenMetrics exposition renders it so
+        a latency bucket links to its flight-recorder trace."""
         m = self._get(name, help_, "histogram", tuple(sorted(labels)))
         with m.lock:
             self._freeze_buckets(m, buckets)
@@ -86,14 +95,17 @@ class Registry:
             if key not in m.bucket_counts:
                 m.bucket_counts[key] = [0] * (len(buckets) + 1)
             counts = m.bucket_counts[key]
+            idx = len(buckets)  # +Inf overflow by default
             for i, b in enumerate(buckets):
                 if value <= b:
-                    counts[i] += 1
+                    idx = i
                     break
-            else:
-                counts[-1] += 1
+            counts[idx] += 1
             m.sums[key] += value
             m.counts[key] += 1
+            if exemplar:
+                m.exemplars.setdefault(key, {})[idx] = (
+                    str(exemplar), value, time.time())
 
     def observe_bucketed(self, name: str, help_: str, buckets: tuple,
                          bucket_counts: list, sum_: float, count: int,
@@ -156,7 +168,15 @@ class Registry:
         for name, ent in cur.items():
             labels = tuple(ent.get("labels") or ())
             pent = prev.get(name) or {}
-            if ent["kind"] in ("counter", "gauge"):
+            if ent["kind"] == "gauge":
+                # gauges merge by LAST VALUE, not delta — the relayed
+                # series carry engine/worker labels so each child owns
+                # its own series, and a delta-add would turn any dip
+                # (queue draining, duty decaying) into garbage
+                for k, v in ent.get("values") or []:
+                    self.gauge_set(name, ent.get("help", ""), v,
+                                   **dict(zip(labels, tuple(k))))
+            elif ent["kind"] == "counter":
                 pvals = {tuple(k): v
                          for k, v in (pent.get("values") or [])}
                 for k, v in ent.get("values") or []:
@@ -188,35 +208,60 @@ class Registry:
 
     # ------------------------------------------------------------- render
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition; `openmetrics=True` renders the
+        OpenMetrics dialect instead: per-bucket EXEMPLARS
+        (`... # {trace_id="<id>"} <value> <ts>`), the terminating
+        `# EOF`, and SPEC-COMPLIANT counter naming — OpenMetrics
+        requires counter samples to be `<family>_total` with the
+        family (HELP/TYPE) named WITHOUT the suffix, and strict
+        scrapers (Prometheus's openmetrics parser included) reject the
+        whole exposition otherwise. Our `*_total` counters keep their
+        sample names (family drops the suffix); legacy reference-named
+        counters (`request_count`, ...) gain `_total` on the sample in
+        this dialect only. The plain text format is byte-identical to
+        what it always was — exemplars and the renames exist only in
+        the negotiated dialect."""
         out = []
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         for m in metrics:
-            out.append(f"# HELP {m.name} {m.help}")
-            out.append(f"# TYPE {m.name} {m.kind}")
+            name = m.name
+            if openmetrics and m.kind == "counter":
+                family = name[:-6] if name.endswith("_total") else name
+                sample = family + "_total"
+            else:
+                family = sample = name
+            out.append(f"# HELP {family} {m.help}")
+            out.append(f"# TYPE {family} {m.kind}")
             with m.lock:
                 if m.kind in ("counter", "gauge"):
                     for key, v in sorted(m.values.items()):
-                        out.append(f"{m.name}{_fmt(m.label_names, key)} {_num(v)}")
+                        out.append(f"{sample}{_fmt(m.label_names, key)} {_num(v)}")
                 else:
                     for key in sorted(m.bucket_counts):
+                        ex = m.exemplars.get(key, {}) if openmetrics \
+                            else {}
                         cum = 0
                         for i, b in enumerate(m.buckets):
                             cum += m.bucket_counts[key][i]
                             out.append(
                                 f"{m.name}_bucket"
-                                f"{_fmt(m.label_names, key, le=_num(b))} {cum}")
+                                f"{_fmt(m.label_names, key, le=_num(b))} {cum}"
+                                + _exemplar_suffix(ex.get(i)))
                         cum += m.bucket_counts[key][-1]
                         out.append(
                             f"{m.name}_bucket"
-                            f"{_fmt(m.label_names, key, le='+Inf')} {cum}")
+                            f"{_fmt(m.label_names, key, le='+Inf')} {cum}"
+                            + _exemplar_suffix(ex.get(len(m.buckets))))
                         out.append(
                             f"{m.name}_sum{_fmt(m.label_names, key)} "
                             f"{_num(m.sums[key])}")
                         out.append(
                             f"{m.name}_count{_fmt(m.label_names, key)} "
                             f"{m.counts[key]}")
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
 
@@ -244,7 +289,35 @@ def _num(v: float) -> str:
     return repr(v)
 
 
+def _exemplar_suffix(ex: Optional[tuple]) -> str:
+    """OpenMetrics exemplar clause for one bucket sample line (empty
+    when the bucket never saw a sampled observation)."""
+    if not ex:
+        return ""
+    trace_id, value, ts = ex
+    return f' # {{trace_id="{_esc(trace_id)}"}} {_num(value)} {ts:.3f}'
+
+
 REGISTRY = Registry()
+
+# OpenMetrics content negotiation: the media type a scraper sends in
+# Accept to request the exemplar-bearing dialect, and what we answer
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+TEXT_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def negotiate_exposition(accept_header: Optional[str],
+                         registry: Registry = REGISTRY
+                         ) -> tuple[bytes, str]:
+    """(body, content_type) for one /metrics scrape, honoring the
+    Accept header: `application/openmetrics-text` gets the OpenMetrics
+    dialect (bucket exemplars + # EOF); everything else gets the
+    classic text format."""
+    om = bool(accept_header) and \
+        "application/openmetrics-text" in accept_header
+    body = registry.render(openmetrics=om).encode()
+    return body, OPENMETRICS_CONTENT_TYPE if om else TEXT_CONTENT_TYPE
 
 
 # ------------------------------------------------- process self-metrics
@@ -336,6 +409,40 @@ def update_process_metrics(registry: Registry = REGISTRY) -> None:
 _IMPORT_TIME = time.time()
 
 
+# ------------------------------------------------- saturation probes
+
+# scrape-time gauge refreshers: each is a zero-arg callable that sets
+# its own gauges (queue depths, duty cycles, stream backlog) against
+# the live objects it closed over — sampled state, not accumulation,
+# so refreshing per scrape is both cheap and always current. Probes
+# must never fail a scrape; errors are swallowed per probe.
+_SATURATION_PROBES: dict[str, Callable[[], None]] = {}
+_PROBES_LOCK = threading.Lock()
+
+
+def register_saturation_probe(name: str, probe) -> None:
+    """Install (or replace) a named scrape-time gauge refresher."""
+    with _PROBES_LOCK:
+        _SATURATION_PROBES[name] = probe
+
+
+def unregister_saturation_probe(name: str) -> None:
+    with _PROBES_LOCK:
+        _SATURATION_PROBES.pop(name, None)
+
+
+def run_saturation_probes() -> None:
+    """Refresh every registered saturation gauge (called on each
+    /metrics scrape, and directly by tests)."""
+    with _PROBES_LOCK:
+        probes = list(_SATURATION_PROBES.values())
+    for probe in probes:
+        try:
+            probe()
+        except Exception:
+            pass  # a dead probe must never fail the scrape
+
+
 def serve(port: int, registry: Registry = REGISTRY, addr: str = "",
           debug_providers: Optional[dict] = None
           ) -> http.server.ThreadingHTTPServer:
@@ -352,8 +459,10 @@ def serve(port: int, registry: Registry = REGISTRY, addr: str = "",
             path = path.rstrip("/")
             if path in ("", "/metrics"):
                 update_process_metrics(registry)
-                self._reply(200, registry.render().encode(),
-                            "text/plain; version=0.0.4")
+                run_saturation_probes()
+                body, ctype = negotiate_exposition(
+                    self.headers.get("Accept"), registry)
+                self._reply(200, body, ctype)
                 return
             if path.startswith("/debug/") and debug_providers:
                 body, status = render_debug(
@@ -449,6 +558,104 @@ def report_request(admission_status: str, seconds: float) -> None:
                              engine=_ENGINE_ID)
 
 
+# batch economics: how full micro-batches seal and WHY they sealed.
+# A plane whose seals are reason=deadline|max_wait at fill ~0.01 is
+# edge-bound (trickle traffic never fills a batch); reason=full at
+# fill 1.0 means the engine is the bottleneck and batching is earning
+# its latency cost.
+FILL_BUCKETS = (0.01, 0.02, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
+BATCH_SEAL_REASONS = ("deadline", "full", "max_wait", "drain")
+
+
+def report_batch_seal(reason: str, fill: float,
+                      plane: str = "admission") -> None:
+    """One sealed micro-batch: `reason` says what closed the collection
+    window (full = max_batch reached, deadline = a member's propagated
+    deadline forced an early seal, max_wait = the window elapsed,
+    drain = shutdown flush), `fill` is len(batch)/max_batch. `plane`
+    separates the validating and mutating batchers — the edge-vs-
+    engine attribution read must not mix a mutation trickle's
+    near-empty seals into the admission series."""
+    if reason not in BATCH_SEAL_REASONS:
+        reason = "max_wait"
+    REGISTRY.counter_add("gatekeeper_tpu_batch_seal_total",
+                         "Sealed micro-batches by seal reason and plane",
+                         reason=reason, plane=plane)
+    REGISTRY.observe("gatekeeper_tpu_batch_fill_ratio",
+                     "Fill ratio (members / max_batch) of each sealed "
+                     "micro-batch, by plane", min(1.0, max(0.0, fill)),
+                     buckets=FILL_BUCKETS, plane=plane)
+
+
+def report_queue_depth(queue: str, depth: int,
+                       engine: str = "") -> None:
+    """Sampled depth of one serving-plane queue (scrape-time gauge):
+    admission / mutation = MicroBatcher in-flight (queued + sealed +
+    flushing, the --admission-max-queue bound's own counter),
+    backplane_engine = engine-side evaluations in flight. `engine`
+    distinguishes co-resident BackplaneEngine instances — without it
+    two engines in one process would overwrite one series and one
+    engine's teardown zero would hide the other's live backlog."""
+    REGISTRY.gauge_set("gatekeeper_tpu_queue_depth",
+                       "Sampled serving-plane queue depth by queue "
+                       "(and owning engine, where applicable)",
+                       depth, queue=queue, engine=engine)
+
+
+def report_backplane_inflight(worker: str, inflight: int) -> None:
+    """Per-frontend forwarded-and-unanswered review count (shipped in
+    the frontend's S-frame stats): the router's least-load signal,
+    exported so 'one frontend saturated, others idle' is readable."""
+    REGISTRY.gauge_set("gatekeeper_tpu_backplane_inflight",
+                       "Reviews a frontend has forwarded over the "
+                       "backplane and not yet had answered",
+                       inflight, worker=worker)
+
+
+def report_duty_cycle(duty: float, engine: Optional[str] = None) -> None:
+    """Busy-fraction EMA of one engine's evaluator (device sweeps +
+    batched admission evals + interpreter fallback, from the driver's
+    eval wall clock): ~0 while admission p99 climbs means the EDGE is
+    saturated, not the engine — the attribution ROADMAP item 5 needs."""
+    REGISTRY.gauge_set("gatekeeper_tpu_device_duty_cycle",
+                       "Fraction of wall clock this engine's evaluator "
+                       "spent busy (EMA over scrape intervals)",
+                       min(1.0, max(0.0, duty)),
+                       engine=engine if engine is not None else _ENGINE_ID
+                       or "0")
+
+
+def report_stream_pending(pending: int) -> None:
+    """Streaming-audit backlog: tracker dirty keys buffered ahead of
+    the next flush (refreshed per flush AND per scrape) — growth here
+    means detection latency is about to follow."""
+    REGISTRY.gauge_set("gatekeeper_tpu_audit_stream_pending_events",
+                       "Watch events buffered (dirty keys) ahead of the "
+                       "next streaming-audit flush", pending)
+
+
+def report_build_info() -> None:
+    """The standard build-info join gauge, emitted once at boot:
+    version/jax/platform/device-count as labels, value always 1."""
+    from .. import __version__
+    jax_version = platform = "unknown"
+    device_count = 0
+    try:
+        import jax
+        jax_version = getattr(jax, "__version__", "unknown")
+        platform = jax.default_backend()
+        device_count = len(jax.devices())
+    except Exception:
+        pass
+    REGISTRY.gauge_set("gatekeeper_tpu_build_info",
+                       "Build/runtime identity (value is always 1; the "
+                       "labels are the payload — join dashboards on "
+                       "them)", 1.0,
+                       version=__version__, jax_version=jax_version,
+                       platform=platform, device_count=str(device_count))
+
+
 def report_batch_timeout(n: int = 1) -> None:
     """A MicroBatcher.submit() waiter gave up before its batch flushed
     (the entry is dropped from the queue so the flush never evaluates a
@@ -516,6 +723,16 @@ ENGINE_RELAY_METRICS = (
     "gatekeeper_tpu_engine_requests_total",
     "gatekeeper_tpu_stage_duration_seconds",
     "gatekeeper_tpu_traces_total",
+    # batch economics relay so per-chip seal reasons / fill ratios
+    # aggregate on the primary's /metrics like every other counter
+    "gatekeeper_tpu_batch_seal_total",
+    "gatekeeper_tpu_batch_fill_ratio",
+    # saturation GAUGES (engine-/worker-labeled series, merged by
+    # last-value): per-chip duty cycle and queue depth must read off
+    # the primary's one scrape, not just for engine 0
+    "gatekeeper_tpu_queue_depth",
+    "gatekeeper_tpu_device_duty_cycle",
+    "gatekeeper_tpu_backplane_inflight",
     # frontends ship S-frame deltas to whichever engine answers; a
     # child that received them relays the merged result up
     "gatekeeper_tpu_backplane_forward_duration_seconds",
@@ -891,12 +1108,16 @@ def _stage_engine(plane: str, engine) -> str:
 
 
 def report_stage(plane: str, stage: str, seconds: float,
-                 engine: Optional[str] = None) -> None:
+                 engine: Optional[str] = None,
+                 trace_id: Optional[str] = None) -> None:
     """One span of a sampled trace: the per-stage latency histogram
     that decomposes an admission p99 (or an audit sweep duration) into
-    its pipeline stages."""
+    its pipeline stages. `trace_id` attaches as the bucket's
+    OpenMetrics exemplar, so a slow bucket resolves to its
+    /debug/traces flight-recorder entry."""
     REGISTRY.observe("gatekeeper_tpu_stage_duration_seconds",
                      _STAGE_HELP, seconds, buckets=STAGE_BUCKETS,
+                     exemplar=trace_id,
                      plane=plane, stage=stage,
                      engine=_stage_engine(plane, engine))
 

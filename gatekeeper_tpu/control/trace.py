@@ -395,8 +395,13 @@ class Tracer:
                 metrics.report_trace(trace.plane)
                 for s in trace.spans:
                     if not s.remote:
+                        # the trace id rides along as the histogram
+                        # bucket's OpenMetrics exemplar: a slow p99
+                        # bucket links straight to this trace's
+                        # /debug/traces flight-recorder entry
                         metrics.report_stage(trace.plane, s.name,
-                                             s.duration)
+                                             s.duration,
+                                             trace_id=trace.trace_id)
             except Exception:  # the sink must never fail a request
                 pass
         try:

@@ -171,10 +171,14 @@ class MicroBatcher:
                  max_batch: int = 256,
                  target: str = "admission.k8s.gatekeeper.sh",
                  evaluate: Optional[Callable[[list], list]] = None,
-                 max_queue: int = 0):
+                 max_queue: int = 0, plane: str = "admission"):
         self.opa = opa
         self.max_wait = max_wait
         self.max_batch = max_batch
+        # which plane's batch-economics series this batcher feeds
+        # (admission | mutation): the seal/fill attribution read must
+        # not mix the two batchers' traffic shapes
+        self.plane = plane
         # load-shed depth: beyond this many queued (unsealed) requests,
         # submit() refuses immediately with AdmissionShed instead of
         # queueing into certain deadline expiry. 0 = unbounded.
@@ -288,6 +292,13 @@ class MicroBatcher:
             time.sleep(0.01)
         return False
 
+    def pending(self) -> int:
+        """Admitted-but-unanswered requests (queued + sealed +
+        flushing): the depth the --admission-max-queue bound applies
+        to, sampled by the saturation gauge probe."""
+        with self._cv:
+            return self._pending
+
     def healthy(self, max_stall: float = 30.0) -> bool:
         """Liveness: both pipeline threads alive, and — when a loop has
         work pending — that loop's heartbeat within `max_stall` (a
@@ -310,6 +321,7 @@ class MicroBatcher:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            seal_reason = "drain"
             with self._cv:
                 while not self._queue and not self._stop.is_set():
                     self.heartbeat = time.monotonic()
@@ -323,9 +335,9 @@ class MicroBatcher:
                     # and the tightest member deadline: a batch carrying
                     # a 1s-timeout review must seal in time to evaluate
                     # and answer before that review expires
-                    deadline = time.monotonic() + self.max_wait
+                    window_end = time.monotonic() + self.max_wait
                     tight = min(p.deadline for p in self._queue)
-                    deadline = min(deadline, tight - self.max_wait)
+                    deadline = min(window_end, tight - self.max_wait)
                     while (len(self._queue) < self.max_batch
                            and time.monotonic() < deadline):
                         self._cv.wait(
@@ -336,8 +348,22 @@ class MicroBatcher:
                     self._queue.sort(key=lambda p: p.deadline)
                     batch = self._queue[: self.max_batch]
                     del self._queue[: len(batch)]
+                    # what closed the window: full batch, a member's
+                    # propagated deadline, or the wait elapsing — the
+                    # seal-reason counter is how "edge-bound trickle"
+                    # (max_wait at fill ~0) and "engine-bound" (full
+                    # at fill 1.0) read off one scrape
+                    if len(batch) >= self.max_batch:
+                        seal_reason = "full"
+                    elif deadline < window_end:
+                        seal_reason = "deadline"
+                    else:
+                        seal_reason = "max_wait"
             if not batch:
                 continue
+            metrics.report_batch_seal(
+                seal_reason, len(batch) / max(1, self.max_batch),
+                plane=self.plane)
             with self._scv:
                 self._sealed.append(batch)
                 self._scv.notify()
@@ -838,7 +864,7 @@ class MutationHandler:
         self.kube = kube
         self.batcher = batcher or MicroBatcher(
             None, max_wait=batch_max_wait, evaluate=self._evaluate_batch,
-            max_queue=max_queue)
+            max_queue=max_queue, plane="mutation")
         self.fail_closed = fail_closed
         self.default_timeout = default_timeout
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 import re
 import threading
+import time
 from typing import Any, Iterable, Optional
 
 import numpy as np
@@ -434,6 +435,15 @@ class TpuDriver(RegoDriver):
         # the delta cache, or the interpreter fallback
         self._eval_counts: dict[tuple, int] = {}
         self._eval_counts_lock = threading.Lock()
+        # duty cycle: eval wall clock accumulated since the last
+        # duty_cycle() sample (device sweeps, batched admission evals,
+        # join probes, interpreter fallback), EMA-smoothed per sample
+        # window — "engine idle, edge saturated" must be readable off
+        # one scrape (gatekeeper_tpu_device_duty_cycle{engine})
+        self._busy_s = 0.0
+        self._busy_t0 = time.monotonic()
+        self._duty_ema = 0.0
+        self._duty_sampled = False
         # vectorized message materialization (ir/vecmat.py): per-kind
         # message plans (None = exact path) and rendered witness
         # columns keyed (target, witness) — both rebuilt lazily
@@ -871,13 +881,53 @@ class TpuDriver(RegoDriver):
     def compiled_kinds(self) -> list[str]:
         return sorted(set(self._programs) | set(self._join_progs))
 
-    def note_eval(self, kind: str, path: str) -> None:
+    def note_eval(self, kind: str, path: str,
+                  seconds: Optional[float] = None) -> None:
         """Count one evaluation of `kind` via `path` (device / delta /
         interp / join): the per-template eval breakdown /debug/templates
-        reports."""
+        reports. `seconds` (eval wall clock, when the call site timed
+        it) accumulates into the engine's busy fraction — the
+        duty-cycle gauge's raw signal."""
         with self._eval_counts_lock:
             self._eval_counts[(kind, path)] = \
                 self._eval_counts.get((kind, path), 0) + 1
+        if seconds:
+            self.note_busy(seconds)
+
+    def note_busy(self, seconds: float) -> None:
+        """Accumulate eval wall clock toward the duty-cycle sample."""
+        if seconds <= 0:
+            return
+        with self._eval_counts_lock:
+            self._busy_s += seconds
+
+    def duty_cycle(self, ema_alpha: float = 0.3,
+                   min_window_s: float = 0.05) -> float:
+        """Busy-fraction EMA of this engine's evaluator, sampled per
+        call (the metrics scrape probe): busy eval seconds since the
+        last sample over elapsed wall clock, EMA-smoothed so one idle
+        scrape interval doesn't zero a busy engine's reading.
+        Concurrent evals can push a raw window past 1.0 (several
+        threads blocked on one device); the fraction clamps because
+        the gauge answers "is the engine busy", not "how oversubscribed
+        is it"."""
+        now = time.monotonic()
+        with self._eval_counts_lock:
+            elapsed = now - self._busy_t0
+            if elapsed < min_window_s:
+                return self._duty_ema  # scrape storm: keep the sample
+            raw = min(1.0, self._busy_s / elapsed) if elapsed > 0 else 0.0
+            self._busy_s = 0.0
+            self._busy_t0 = now
+            if not self._duty_sampled:
+                # first sample seeds the EMA instead of decaying a
+                # meaningless zero
+                self._duty_ema = raw
+                self._duty_sampled = True
+            else:
+                self._duty_ema = (ema_alpha * raw
+                                  + (1.0 - ema_alpha) * self._duty_ema)
+            return self._duty_ema
 
     def templates_debug(self) -> dict:
         """Per-template compile/serve state for /debug/templates: how
@@ -1769,6 +1819,7 @@ class TpuDriver(RegoDriver):
                 timers.add("device_sweep", t_dev)
             if t_mat > 0:
                 timers.add("materialize", t_mat)
+            self.note_busy(t_dev + t_mat)
         if self._quarantine:
             self._quarantine_clear(kind)
         return out
@@ -1786,6 +1837,7 @@ class TpuDriver(RegoDriver):
         if cand.size == 0:
             return []
         self.note_eval(kind, "join")
+        _t_join0 = time.monotonic()
         cand_reviews = [reviews[int(i)] for i in cand]
         if self._join_frz[0] != self._data_rev:
             self._join_frz = (self._data_rev, {}, {})
@@ -1799,8 +1851,13 @@ class TpuDriver(RegoDriver):
                 rev_cache[id(r)] = ent
             frz.append(ent[1])
         try:
-            fires = jc.fires(frz, self._inventory_tree(target),
-                             self._data_gen, key_cache=key_cache)
+            try:
+                fires = jc.fires(frz, self._inventory_tree(target),
+                                 self._data_gen, key_cache=key_cache)
+            finally:
+                # monotonic + finally: an NTP step must not inflate the
+                # duty cycle, and a failed eval still burned wall clock
+                self.note_busy(time.monotonic() - _t_join0)
         except Exception as e:
             # transient-capable quarantine, not a permanent demotion —
             # join templates heal the same way compiled ones do
@@ -1995,6 +2052,7 @@ class TpuDriver(RegoDriver):
         el = _time.time() - t0
         if el > 0:
             profiling.timers().add("interp_eval", el)
+            self.note_busy(el)
         if trace is None and el > 0.005 and n_masked >= 256:
             self._observe("_host_pair_rate", n_masked / el)
         return out
@@ -2486,6 +2544,17 @@ class TpuDriver(RegoDriver):
         when the measured device-dispatch latency beats the measured host
         per-pair rate for this batch's workload; the rest through the
         interpreter per review."""
+        t0 = time.monotonic()
+        try:
+            return self._review_batch(target, reviews)
+        finally:
+            # finally, not the happy path: an engine burning its wall
+            # clock on FAILING evals must still read busy, or the duty
+            # gauge attributes the stall to the edge
+            self.note_busy(time.monotonic() - t0)
+
+    def _review_batch(self, target: str, reviews: list[dict]
+                      ) -> list[list[Result]]:
         constraints = self._constraints(target)
         lookup_ns = self._namespace_lookup(target)
         inventory = self._inventory_tree(target)

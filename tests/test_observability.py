@@ -16,7 +16,13 @@ Covers:
     registration, mismatch raises);
   * process self-metrics (start time, RSS, FDs, threads, GC);
   * a STRICT text-exposition parse of a loaded Runtime's full /metrics
-    output (HELP/TYPE present, +Inf == _count, label escaping).
+    output (HELP/TYPE present, +Inf == _count, label escaping);
+  * ISSUE 13 (saturation/SLO/trend): OpenMetrics exemplar round-trip
+    + content negotiation, batch seal reasons/fill ratio, queue-depth
+    saturation probes, engine duty-cycle EMA, build-info gauge, SLO
+    burn-rate math + /debug/slo, and the bench_trend watchdog
+    (passes on committed history, fails on a synthetic regression,
+    unit-change series restarts, did-not-run error records).
 
 Every test runs under a hard SIGALRM timeout.
 """
@@ -279,18 +285,44 @@ def test_process_self_metrics_exposed():
 # -------------------------------------------------- exposition strict parse
 
 
-def _parse_exposition_strict(text: str) -> dict:
+_EXEMPLAR_RE = re.compile(
+    r' # \{trace_id="((?:[^"\\]|\\.)*)"\} (\S+) (\S+)$')
+
+
+def _parse_exposition_strict(text: str, openmetrics: bool = False
+                             ) -> dict:
     """Strict text-format parse: every sample must belong to an
     announced metric family (HELP + TYPE first), histogram +Inf bucket
     must equal _count, label values must round-trip the escaping.
-    Returns {family: {"type", "samples": [(name, labels, value)]}}."""
+    `openmetrics=True` additionally requires the terminal `# EOF` and
+    accepts (collecting) per-bucket exemplar clauses.
+    Returns {family: {"type", "samples": [(name, labels, value)]}}
+    plus, under the reserved "__exemplars__" key, every
+    (sample_line_prefix, trace_id, value, ts) exemplar found."""
     families: dict = {}
+    exemplars: list = []
     cur = None
     sample_re = re.compile(
         r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$")
     label_re = re.compile(
         r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"(,|$)')
-    for line in text.splitlines():
+    lines = text.splitlines()
+    if openmetrics:
+        assert lines and lines[-1] == "# EOF", \
+            "OpenMetrics exposition must end with # EOF"
+        lines = lines[:-1]
+    orig_lines = lines
+    lines = []
+    for line in orig_lines:
+        m = _EXEMPLAR_RE.search(line)
+        if m:
+            assert openmetrics, \
+                "exemplar syntax leaked into the plain text format"
+            exemplars.append((line[: m.start()], m.group(1),
+                              float(m.group(2)), float(m.group(3))))
+            line = line[: m.start()]
+        lines.append(line)
+    for line in lines:
         if not line.strip():
             continue
         if line.startswith("# HELP "):
@@ -312,6 +344,18 @@ def _parse_exposition_strict(text: str) -> dict:
         name, _, labeltext, value = m.groups()
         base = re.sub(r"_(bucket|sum|count)$", "", name)
         fam = name if name in families else base
+        if fam not in families and openmetrics and \
+                name.endswith("_total"):
+            # OpenMetrics counter naming: the family drops _total, the
+            # sample carries it — and the spec REQUIRES counters to
+            # sample as _total
+            fam = name[:-6]
+            assert families.get(fam, {}).get("type") == "counter", \
+                f"{name}: _total sample without a counter family"
+        if openmetrics and families.get(fam, {}).get("type") \
+                == "counter":
+            assert name.endswith("_total"), \
+                f"OpenMetrics counter sample {name} must end _total"
         assert fam in families, f"sample {name} has no HELP/TYPE"
         assert families[fam]["type"] is not None
         labels = {}
@@ -352,6 +396,8 @@ def _parse_exposition_strict(text: str) -> dict:
                     else float(kv[0])):
                 assert v >= prev, f"{fam}: non-monotonic buckets"
                 prev = v
+    families["__exemplars__"] = {"type": "reserved",
+                                 "samples": exemplars}
     return families
 
 
@@ -641,9 +687,46 @@ violation[{"msg": "no owner label"}] {
         for frag in ('plane="admission"', 'stage="evaluate"',
                      'stage="frontend_parse"', 'stage="respond"',
                      "gatekeeper_tpu_traces_total",
-                     "process_resident_memory_bytes"):
+                     "process_resident_memory_bytes",
+                     # ISSUE 13: the capacity-attribution families a
+                     # single scrape of a loaded plane must carry
+                     "gatekeeper_tpu_batch_seal_total",
+                     "gatekeeper_tpu_batch_fill_ratio_bucket",
+                     'gatekeeper_tpu_queue_depth'
+                     '{engine="",queue="admission"}',
+                     'gatekeeper_tpu_queue_depth'
+                     '{engine="0",queue="backplane_engine"}',
+                     "gatekeeper_tpu_device_duty_cycle",
+                     "gatekeeper_tpu_build_info",
+                     "gatekeeper_tpu_slo_burn_rate",
+                     "gatekeeper_tpu_slo_target"):
             assert frag in text, f"{frag} missing from /metrics"
         _parse_exposition_strict(text)
+        # OpenMetrics negotiation on the same loaded runtime: a stage
+        # histogram bucket must carry a trace-id exemplar that
+        # RESOLVES in the flight recorder (/debug/traces)
+        conn2 = http.client.HTTPConnection("127.0.0.1", mport,
+                                           timeout=10)
+        conn2.request("GET", "/metrics",
+                      headers={"Accept":
+                               "application/openmetrics-text"})
+        resp = conn2.getresponse()
+        om = resp.read().decode()
+        conn2.close()
+        assert resp.getheader("Content-Type").startswith(
+            "application/openmetrics-text")
+        fams = _parse_exposition_strict(om.rstrip("\n"),
+                                        openmetrics=True)
+        ex_tids = {e[1] for e in fams["__exemplars__"]["samples"]
+                   if e[0].startswith(
+                       "gatekeeper_tpu_stage_duration_seconds")}
+        assert ex_tids, "no stage bucket carries a trace-id exemplar"
+        _status, tr_body = _get("127.0.0.1", mport, "/debug/traces")
+        recorded = {t["trace_id"] for t in json.loads(tr_body)
+                    .get("planes", {}).get("admission", {})
+                    .get("recent", [])}
+        assert ex_tids & recorded, \
+            f"exemplar ids {ex_tids} resolve to no recorded trace"
         # /debug/templates on the metrics port, /debug/traces on the
         # health port (same registry), unknown endpoints 404
         status, body = _get("127.0.0.1", mport, "/debug/templates")
@@ -652,8 +735,526 @@ violation[{"msg": "no owner label"}] {
         assert "K8sNeedOwner" in tmpl["templates"]
         status, _ = _get("127.0.0.1", hport, "/debug/traces")
         assert status == 200
+        # /debug/slo answers the compliance picture
+        status, body = _get("127.0.0.1", mport, "/debug/slo")
+        assert status == 200
+        slo = json.loads(body)
+        names = {o["name"] for o in slo["objectives"]}
+        assert "admission_p99_latency" in names
+        assert "availability" in names
         status, body = _get("127.0.0.1", mport, "/debug/nope")
         assert status == 404
         assert "available" in json.loads(body)
     finally:
         rt.stop()
+
+
+# ----------------------------------- exemplars + OpenMetrics negotiation
+
+
+def test_openmetrics_exemplar_round_trip():
+    """An observation carrying a trace-id exemplar renders in the
+    OpenMetrics dialect on exactly the bucket it landed in, round-trips
+    the strict parser, and never leaks into the plain text format."""
+    reg = gm.Registry()
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    reg.observe("om_stage_seconds", "h", 0.03, buckets=(0.01, 0.1, 1.0),
+                exemplar=tid, stage="evaluate")
+    reg.observe("om_stage_seconds", "h", 5.0, buckets=(0.01, 0.1, 1.0),
+                exemplar="ff" * 16, stage="evaluate")  # +Inf overflow
+    reg.observe("om_stage_seconds", "h", 0.02, buckets=(0.01, 0.1, 1.0),
+                stage="evaluate")  # unsampled: no exemplar attached
+    om = reg.render(openmetrics=True)
+    fams = _parse_exposition_strict(om, openmetrics=True)
+    exemplars = fams["__exemplars__"]["samples"]
+    assert len(exemplars) == 2, om
+    by_tid = {e[1]: e for e in exemplars}
+    line, _tid, value, ts = by_tid[tid]
+    assert 'le="0.1"' in line  # the bucket 0.03 landed in
+    assert value == 0.03 and ts > 0
+    assert 'le="+Inf"' in by_tid["ff" * 16][0]
+    # the LATEST exemplar per bucket wins
+    tid2 = "ab" * 16
+    reg.observe("om_stage_seconds", "h", 0.05, buckets=(0.01, 0.1, 1.0),
+                exemplar=tid2, stage="evaluate")
+    om2 = reg.render(openmetrics=True)
+    assert tid2 in om2 and tid not in om2
+    # plain text format: identical series, zero exemplar syntax
+    text = reg.render()
+    _parse_exposition_strict(text)
+    assert "trace_id" not in text and "# EOF" not in text
+
+
+def test_metrics_content_negotiation_over_http():
+    """GET /metrics honors Accept: a scraper asking for
+    application/openmetrics-text gets the exemplar-bearing dialect
+    (+ # EOF); everyone else gets the classic text format."""
+    reg = gm.Registry()
+    reg.observe("nego_seconds", "h", 0.3, buckets=(0.1, 1.0),
+                exemplar="cd" * 16)
+    # counters in BOTH naming styles: the OpenMetrics dialect must
+    # sample every counter as <family>_total (strict scrapers —
+    # Prometheus's openmetrics parser included — reject the whole
+    # exposition otherwise), while the text format keeps legacy names
+    reg.counter_add("legacy_count", "c", 3)
+    reg.counter_add("modern_total", "c", 4)
+    server = gm.serve(0, registry=reg, addr="127.0.0.1")
+    try:
+        port = server.server_address[1]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/metrics",
+                     headers={"Accept": "application/openmetrics-text; "
+                                        "version=1.0.0"})
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.getheader("Content-Type").startswith(
+            "application/openmetrics-text")
+        assert body.rstrip().endswith("# EOF")
+        assert 'trace_id="' + "cd" * 16 + '"' in body
+        assert "# TYPE legacy_count counter" in body
+        assert "\nlegacy_count_total 3" in body
+        assert "# TYPE modern counter" in body
+        assert "\nmodern_total 4" in body
+        _parse_exposition_strict(body.rstrip("\n"), openmetrics=True)
+        # no Accept (or a plain one): classic text format, no exemplars,
+        # legacy counter names untouched
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.getheader("Content-Type").startswith("text/plain")
+        assert "trace_id" not in body and "# EOF" not in body
+        assert "\nlegacy_count 3" in body
+        assert "legacy_count_total" not in body
+        conn.close()
+    finally:
+        server.shutdown()
+
+
+def test_trace_span_feeds_stage_exemplar():
+    """A finished sampled trace attaches its id to the stage-histogram
+    buckets it observed (control/trace.py -> report_stage exemplar)."""
+    tid = "1234567890abcdef1234567890abcdef"
+    tr = gt.TRACER.start(gt.ADMISSION, f"00-{tid}-00f067aa0ba902b7-01")
+    with tr.span("evaluate"):
+        time.sleep(0.001)
+    tr.finish()
+    om = gm.REGISTRY.render(openmetrics=True)
+    stage_lines = [ln for ln in om.splitlines()
+                   if ln.startswith("gatekeeper_tpu_stage_duration_"
+                                    "seconds_bucket") and tid in ln]
+    assert stage_lines, "trace id never reached a stage bucket exemplar"
+
+
+# ------------------------------------------ batch economics + saturation
+
+
+def _seal_counts(plane="admission"):
+    snap = gm.REGISTRY.snapshot(("gatekeeper_tpu_batch_seal_total",))
+    ent = snap.get("gatekeeper_tpu_batch_seal_total") or {}
+    # label values ordered by sorted label names: (plane, reason)
+    return {k[1]: v for k, v in
+            ((tuple(lk), v) for lk, v in ent.get("values") or [])
+            if k[0] == plane}
+
+
+def test_batch_seal_reasons_and_fill_ratio():
+    evaluate = lambda reviews: [[] for _ in reviews]  # noqa: E731
+
+    # FULL: two submits against max_batch=2 seal a full batch
+    before = _seal_counts()
+    b = MicroBatcher(None, max_wait=0.5, max_batch=2, evaluate=evaluate)
+    import threading as _threading
+    t = _threading.Thread(
+        target=lambda: b.submit(_review("f1", {"owner": "x"}),
+                                timeout=10))
+    t.start()
+    b.submit(_review("f2", {"owner": "x"}), timeout=10)
+    t.join(10)
+    b.stop()
+    after = _seal_counts()
+    assert after.get("full", 0) > before.get("full", 0), (before, after)
+
+    # MAX_WAIT: a lone submit with a far deadline seals when the
+    # collection window elapses
+    before = after
+    b = MicroBatcher(None, max_wait=0.01, max_batch=64,
+                     evaluate=evaluate)
+    b.submit(_review("w1", {"owner": "x"}), timeout=30)
+    b.stop()
+    after = _seal_counts()
+    assert after.get("max_wait", 0) > before.get("max_wait", 0), \
+        (before, after)
+
+    # DEADLINE: a tight member deadline forces the seal well before
+    # the (long) collection window
+    before = after
+    b = MicroBatcher(None, max_wait=5.0, max_batch=64,
+                     evaluate=evaluate)
+    b.submit(_review("d1", {"owner": "x"}),
+             deadline=time.monotonic() + 0.8)
+    b.stop()
+    after = _seal_counts()
+    assert after.get("deadline", 0) > before.get("deadline", 0), \
+        (before, after)
+
+    # fill-ratio histogram populated alongside
+    text = gm.REGISTRY.render()
+    assert "gatekeeper_tpu_batch_fill_ratio_bucket" in text
+    m = re.search(r'gatekeeper_tpu_batch_fill_ratio_count'
+                  r'\{plane="admission"\} (\d+)', text)
+    assert m and int(m.group(1)) >= 3
+
+
+def test_queue_depth_probe_and_stream_pending_gauge():
+    calls = []
+    gm.register_saturation_probe(
+        "test-probe", lambda: calls.append(1) or gm.report_queue_depth(
+            "admission", 7))
+    try:
+        gm.run_saturation_probes()
+        assert calls
+        text = gm.REGISTRY.render()
+        assert ('gatekeeper_tpu_queue_depth'
+                '{engine="",queue="admission"} 7') in text
+    finally:
+        gm.unregister_saturation_probe("test-probe")
+    # a raising probe must not fail the scrape
+    gm.register_saturation_probe(
+        "test-bad", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    try:
+        gm.run_saturation_probes()
+    finally:
+        gm.unregister_saturation_probe("test-bad")
+    # stream backlog gauge (satellite: was logs-only)
+    gm.report_stream_pending(42)
+    assert ('gatekeeper_tpu_audit_stream_pending_events 42'
+            in gm.REGISTRY.render())
+
+
+def test_build_info_gauge():
+    gm.report_build_info()
+    text = gm.REGISTRY.render()
+    m = re.search(r'^gatekeeper_tpu_build_info\{(.*)\} 1$', text, re.M)
+    assert m, "build info gauge missing"
+    labels = m.group(1)
+    for want in ("version=", "jax_version=", "platform=",
+                 "device_count="):
+        assert want in labels, labels
+
+
+# ------------------------------------------------------ duty cycle (EMA)
+
+
+def test_duty_cycle_ema():
+    from gatekeeper_tpu.ir import TpuDriver
+
+    drv = TpuDriver()
+    # saturate the first sample window: raw clamps to 1.0 and seeds
+    # the EMA directly (no decay from a meaningless zero)
+    drv.note_busy(100.0)
+    time.sleep(0.06)
+    first = drv.duty_cycle()
+    assert first == pytest.approx(1.0)
+    # a scrape storm (second sample inside the window) reuses the
+    # sample — the window is widened here so a loaded CI runner's
+    # scheduler stall between the two calls can't flake the assert
+    assert drv.duty_cycle(min_window_s=30.0) == pytest.approx(first)
+    # an idle window decays the EMA toward zero at alpha=0.3
+    time.sleep(0.06)
+    second = drv.duty_cycle()
+    assert second == pytest.approx(0.7, abs=0.01)
+    # eval paths actually accumulate busy time (note_eval seconds arg)
+    drv.note_eval("K8sX", "device", seconds=0.5)
+    time.sleep(0.06)
+    assert drv.duty_cycle() > second
+
+
+# ----------------------------------------------------------- SLO layer
+
+
+def _slo_registry():
+    reg = gm.Registry()
+    for v in (0.01, 0.02, 0.05, 0.05, 0.05):  # all under 0.1
+        reg.observe("request_duration_seconds", "h", v,
+                    admission_status="allow")
+    reg.counter_add("request_count", "c", 100, admission_status="allow")
+    return reg
+
+
+def test_slo_burn_rates_multi_window():
+    from gatekeeper_tpu.control.slo import SloEngine, default_objectives
+
+    reg = _slo_registry()
+    eng = SloEngine(default_objectives(admission_p99_s=0.1,
+                                       availability_target=0.99),
+                    registry=reg, sample_interval_s=15)
+    eng.sample(now=0.0)
+    # healthy traffic: zero burn on every objective/window
+    rates = eng.burn_rates(now=400.0)
+    for slo, by_window in rates.items():
+        for w, ent in by_window.items():
+            assert ent["burn_rate"] == 0.0, (slo, w, ent)
+    # 10 good + 10 shed in the next window: bad fraction 0.5 against a
+    # 1% budget = burn 50 on both windows (the 1h anchor is the same
+    # sample while history is short — lifetime-honest)
+    reg.counter_add("request_count", "c", 10, admission_status="allow")
+    reg.counter_add("request_count", "c", 10, admission_status="shed")
+    rates = eng.burn_rates(now=400.0)
+    av = rates["availability"]
+    assert av["5m"]["burn_rate"] == pytest.approx(50.0)
+    assert av["5m"]["bad"] == 10 and av["5m"]["total"] == 20
+    # latency: 3 of 8 in-window requests past the 0.1s threshold burn
+    # the p99 budget (bad fraction 0.375 over a 1% budget = 37.5)
+    for _ in range(5):
+        reg.observe("request_duration_seconds", "h", 0.05,
+                    admission_status="allow")
+    for _ in range(3):
+        reg.observe("request_duration_seconds", "h", 2.0,
+                    admission_status="allow")
+    rates = eng.burn_rates(now=401.0)
+    lat = rates["admission_p99_latency"]["5m"]
+    assert lat["bad"] == 3 and lat["total"] == 8
+    assert lat["burn_rate"] == pytest.approx(37.5)
+    # export refreshes the gauges
+    eng.export(now=402.0)
+    text = reg.render()
+    assert 'gatekeeper_tpu_slo_burn_rate{slo="availability"' \
+        ',window="5m"}' in text
+    assert 'gatekeeper_tpu_slo_target{slo="admission_p99_latency"} ' \
+        '0.99' in text
+
+
+def test_slo_window_anchoring_prefers_full_window():
+    """With enough history, the 5m window reads a 5m-old anchor while
+    the 1h window reads an older one — the two burn rates diverge when
+    the bad traffic is recent."""
+    from gatekeeper_tpu.control.slo import SloEngine, default_objectives
+
+    reg = _slo_registry()
+    eng = SloEngine(default_objectives(availability_target=0.99),
+                    registry=reg, sample_interval_s=15)
+    eng.sample(now=0.0)
+    # an hour of healthy samples
+    for t in range(1, 240):
+        eng.sample(now=t * 15.0)
+    # a recent burst of bad traffic (inside the last 5m)
+    reg.counter_add("request_count", "c", 10, admission_status="error")
+    now = 240 * 15.0
+    rates = eng.burn_rates(now=now)
+    av = rates["availability"]
+    # both windows see the same 10 bad events, but over different
+    # anchors; the FAST window must see a full-strength burn
+    assert av["5m"]["burn_rate"] > 0
+    assert av["5m"]["window_actual_s"] >= 300
+    assert av["1h"]["window_actual_s"] >= 3600
+    assert av["5m"]["total"] <= av["1h"]["total"]
+
+
+def test_slo_objective_validation():
+    from gatekeeper_tpu.control.slo import SloObjective
+
+    with pytest.raises(ValueError):
+        SloObjective("x", "latency", 1.0, "m", threshold_s=0.1)
+    with pytest.raises(ValueError):
+        SloObjective("x", "latency", 0.99, "m")  # no threshold
+    with pytest.raises(ValueError):
+        SloObjective("x", "weird", 0.99, "m")
+
+
+def test_debug_slo_provider_shape():
+    from gatekeeper_tpu.control.slo import SloEngine, default_objectives
+
+    reg = _slo_registry()
+    eng = SloEngine(default_objectives(), registry=reg,
+                    sample_interval_s=15)
+    eng.sample(now=0.0)
+    status = eng.status(now=10.0)
+    names = {o["name"] for o in status["objectives"]}
+    assert names == {"admission_p99_latency", "availability",
+                     "violation_detection_p99"}
+    for o in status["objectives"]:
+        assert "windows" in o and "target" in o
+    assert status["alert_reference_burn_rates"]["5m"] == 14.4
+
+
+# ------------------------------------------------- perf-trend watchdog
+
+
+def _bench_trend():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "bench_trend.py")
+    spec = importlib.util.spec_from_file_location("bench_trend", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_round(d, n, doc):
+    import os
+    with open(os.path.join(d, f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump({"parsed": doc}, f)
+
+
+def test_bench_trend_check_passes_on_committed_history():
+    """The acceptance gate: the committed BENCH_r01-r05 trajectory must
+    pass --check (scale changes between rounds restart series via the
+    unit string; they are not regressions)."""
+    import io
+    import os
+    from contextlib import redirect_stdout
+
+    bt = _bench_trend()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bt.main(["--dir", root, "--check"])
+    assert rc == 0, buf.getvalue()
+    report = buf.getvalue()
+    assert "# Benchmark trend" in report
+    assert "r01" in report and "r05" in report
+
+
+def test_bench_trend_fails_on_synthetic_regression(tmp_path):
+    import io
+    from contextlib import redirect_stdout
+
+    bt = _bench_trend()
+    d = str(tmp_path)
+    _write_round(d, 1, {"metric": "full_audit_wall_clock_s",
+                        "value": 1.0, "unit": "u"})
+    _write_round(d, 2, {"metric": "full_audit_wall_clock_s",
+                        "value": 1.1, "unit": "u"})
+    _write_round(d, 3, {"metric": "full_audit_wall_clock_s",
+                        "value": 1.6, "unit": "u"})  # >25% vs best=1.0
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bt.main(["--dir", d, "--check"])
+    assert rc == 1
+    assert "full_audit_wall_clock_s" in buf.getvalue()
+    # higher-is-better direction flags drops (config series, which
+    # carries a unit — the top-level admission_rps COPY is ungated)
+    c5 = {"metric": "admission_requests_per_sec", "unit": "rps"}
+    _write_round(d, 4, {"metric": "full_audit_wall_clock_s",
+                        "value": 1.0, "unit": "u", "admission_rps": 900,
+                        "configs": {"5": {**c5, "value": 1000}}})
+    _write_round(d, 5, {"metric": "full_audit_wall_clock_s",
+                        "value": 1.0, "unit": "u", "admission_rps": 350,
+                        "configs": {"5": {**c5, "value": 400}}})
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bt.main(["--dir", d, "--check"])
+    assert rc == 1
+    # exactly the unit-carrying config series flagged, not the copy
+    assert "c5.admission_requests_per_sec" in buf.getvalue()
+    assert "**350" not in buf.getvalue()
+
+
+def test_bench_trend_unit_change_restarts_series(tmp_path):
+    import io
+    from contextlib import redirect_stdout
+
+    bt = _bench_trend()
+    d = str(tmp_path)
+    _write_round(d, 1, {"metric": "audit_wall_clock_s", "value": 0.1,
+                        "unit": "s (x 1000 objects)"})
+    # 10x slower, but at 10x the scale: a series restart, not a
+    # regression
+    _write_round(d, 2, {"metric": "audit_wall_clock_s", "value": 1.0,
+                        "unit": "s (x 10000 objects)"})
+    with redirect_stdout(io.StringIO()):
+        rc = bt.main(["--dir", d, "--check"])
+    assert rc == 0
+    # same unit, same slowdown: NOW it flags
+    _write_round(d, 3, {"metric": "audit_wall_clock_s", "value": 2.0,
+                        "unit": "s (x 10000 objects)"})
+    with redirect_stdout(io.StringIO()):
+        rc = bt.main(["--dir", d, "--check"])
+    assert rc == 1
+
+
+def test_bench_trend_error_configs_reported_not_regressed(tmp_path):
+    import io
+    from contextlib import redirect_stdout
+
+    bt = _bench_trend()
+    d = str(tmp_path)
+    _write_round(d, 1, {"metric": "full_audit_wall_clock_s",
+                        "value": 1.0, "unit": "u", "configs": {
+                            "5": {"metric": "admission_requests_per_sec",
+                                  "value": 1000, "unit": "rps"}}})
+    # config 5 DID NOT RUN in round 2: an error record, not a zero —
+    # must be listed as such and must not flag a regression
+    _write_round(d, 2, {"metric": "full_audit_wall_clock_s",
+                        "value": 1.0, "unit": "u", "configs": {
+                            "5": {"config": 5,
+                                  "error": "loadgen crashed"}}})
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bt.main(["--dir", d, "--check"])
+    assert rc == 0, buf.getvalue()
+    out = buf.getvalue()
+    assert "Did not run" in out and "loadgen crashed" in out
+
+
+def test_bench_trend_recovers_truncated_tail():
+    """The r05 shape: parsed=null and the headline JSON line truncated
+    at the FRONT inside the captured tail — the loader recovers the
+    trailing top-level fields instead of dropping the round."""
+    bt = _bench_trend()
+    doc = bt._recover_fragment(
+        'path": "single", "mutate_audit_s": 1.132, "setup_s": 2.8, '
+        '"configs": {"3": {"config": 3, "metric": "audit_wall_clock_s", '
+        '"value": 7.666, "unit": "s (50000 pods)"}}}')
+    assert doc is not None
+    assert doc["mutate_audit_s"] == 1.132
+    assert doc["configs"]["3"]["value"] == 7.666
+    metrics, errors, units = bt.flatten_round(doc)
+    assert metrics["c3.audit_wall_clock_s"] == 7.666
+    assert units["c3.audit_wall_clock_s"] == "s (50000 pods)"
+
+
+def test_bench_trend_ended_series_never_gates(tmp_path):
+    """A metric whose series ended before the newest round (config
+    dropped/renamed) is immutable history — its old final regression
+    must not fail every future --check forever."""
+    import io
+    from contextlib import redirect_stdout
+
+    bt = _bench_trend()
+    d = str(tmp_path)
+    _write_round(d, 1, {"metric": "audit_wall_clock_s", "value": 1.0,
+                        "unit": "u"})
+    _write_round(d, 2, {"metric": "audit_wall_clock_s", "value": 2.0,
+                        "unit": "u"})  # regressed... in history
+    # newest round no longer carries the metric at all
+    _write_round(d, 3, {"metric": "other_wall_clock_s", "value": 5.0,
+                        "unit": "v"})
+    with redirect_stdout(io.StringIO()):
+        rc = bt.main(["--dir", d, "--check"])
+    assert rc == 0
+    # --all-history still SHOWS it in the report
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bt.main(["--dir", d, "--all-history"])
+    assert "audit_wall_clock_s" in buf.getvalue()
+
+
+def test_slo_engine_stop_zeroes_burn_gauges():
+    from gatekeeper_tpu.control.slo import SloEngine, default_objectives
+
+    reg = _slo_registry()
+    eng = SloEngine(default_objectives(availability_target=0.99),
+                    registry=reg, sample_interval_s=15)
+    eng.sample(now=0.0)
+    reg.counter_add("request_count", "c", 10, admission_status="shed")
+    eng.export(now=400.0)
+    m = re.search(r'gatekeeper_tpu_slo_burn_rate\{slo="availability",'
+                  r'window="5m"\} (\S+)', reg.render())
+    assert m and float(m.group(1)) > 0
+    eng.stop()
+    text = reg.render()
+    for line in text.splitlines():
+        if line.startswith("gatekeeper_tpu_slo_burn_rate"):
+            assert line.endswith(" 0"), line

@@ -1,0 +1,409 @@
+#!/usr/bin/env python
+"""Perf-trend watchdog over the committed BENCH_r*.json history.
+
+Until now, nothing watched the benchmark trajectory: a perf regression
+only surfaced when a human re-read old JSON. This tool parses every
+round's headline fields into one trajectory table, flags any metric
+whose LATEST round regressed more than --threshold (default 25%)
+against the best prior round, and renders a markdown report.
+
+    python tools/bench_trend.py                  # print the report
+    python tools/bench_trend.py --check          # exit 1 on regression
+    python tools/bench_trend.py --report trend.md
+    python bench.py --trend                      # same, via bench.py
+
+Wired as the non-blocking `bench-trend` CI job (report uploaded as an
+artifact). A config that recorded {"error": ...} instead of numbers is
+reported as DID NOT RUN — distinguishable from "regressed" (bench.py
+and bench_configs.py record per-config errors exactly for this).
+
+Robustness: BENCH files carry {"parsed": {...}} when the harness
+parsed the headline line, but older rounds hold only a truncated
+"tail" (r05's headline JSON is cut mid-line at the FRONT). The loader
+recovers those by re-wrapping the fragment at successive top-level
+key boundaries until it parses — recovered fields are real, missing
+ones stay missing rather than guessed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globmod
+import json
+import os
+import re
+import sys
+from typing import Optional
+
+DEFAULT_THRESHOLD = 0.25
+
+# explicit metric directions; anything the heuristic can't classify is
+# shown in the table but never gated
+_LOWER_BETTER = {
+    "full_audit_wall_clock_s", "audit_wall_clock_s", "sweep_wall_s",
+    "match_s", "materialize_s", "materialize_vs_sweep", "delta_audit_s",
+    "mutate_audit_s", "mutate_s", "warm_boot_s", "cold_boot_s",
+    "warm_first_audit_s", "cold_first_audit_s", "mesh_audit_s",
+    "whatif_preview_s", "first_audit_s", "first_call_s",
+    "violation_detection_p99_ms", "violation_detection_p50_ms",
+}
+_HIGHER_BETTER = {
+    "audit_cross_product_evals_per_sec_per_chip", "evals_per_sec_per_chip",
+    "admission_rps", "admission_requests_per_sec", "vs_baseline",
+    "detection_speedup_p99", "mesh_audit_vs_single_device",
+    "compile_widening_speedup", "general_library_compiled_fraction",
+    "engine_batched_reviews_per_sec",
+}
+
+# measured but NOT gated by --check: cold-start and first-call numbers
+# move with workload size and host weather; baseline_* measures the
+# Python reference, not us; setup is harness cost. They stay in the
+# table so a human can still read their trajectory.
+_NOISY = {
+    "first_audit_s", "first_call_s", "cold_first_audit_s",
+    "cold_boot_s", "setup_s", "vs_baseline", "mutate_audit_s",
+}
+
+# top-level headline fields bench.py COPIES out of the side configs —
+# the copy carries no unit string, so a config scale change would
+# false-flag it; the gated series is the unit-carrying c<N>.* twin
+_CONFIG_MIRRORS = {
+    "admission_rps", "mutate_s", "warm_boot_s",
+    "violation_detection_ms", "detection_speedup_p99",
+    "whatif_preview_s", "mesh_audit_s", "mesh_audit_vs_single_device",
+    "compile_widening_speedup", "general_library_compiled_fraction",
+    "warm_first_audit_s",
+}
+
+def _ungated(name: str) -> bool:
+    """True when `name` is shown in the table but never gated by
+    --check: noisy fields anywhere, config mirrors only at TOP level
+    (a c<N>.* twin with the same base name still gates)."""
+    base = name.split(".", 1)[-1]
+    return base in _NOISY or ("." not in name
+                              and base in _CONFIG_MIRRORS)
+_SKIP = {
+    "objects", "constraints", "violating_pairs",
+    "violations_materialized", "baseline_evals_per_sec",
+    "baseline_full_audit_s", "n_devices", "config", "violations",
+    "host_cores", "workers", "device_compiled_kinds", "total_kinds",
+    "slo_met", "setup_s",
+}
+
+
+def direction(name: str) -> Optional[str]:
+    """'lower' / 'higher' / None (untracked) for one metric name."""
+    base = name.split(".", 1)[-1]
+    if base in _SKIP:
+        return None
+    if base in _LOWER_BETTER:
+        return "lower"
+    if base in _HIGHER_BETTER:
+        return "higher"
+    if re.search(r"(_per_sec|_rps|speedup|fraction)s?$", base):
+        return "higher"
+    if re.search(r"(_s|_ms|_seconds)$", base):
+        return "lower"
+    return None
+
+
+# ----------------------------------------------------------- loading
+
+
+def _recover_fragment(line: str) -> Optional[dict]:
+    """Parse a (possibly front-truncated) JSON object line: drop
+    leading garbage up to successive top-level `, "` boundaries and
+    re-wrap in braces until json.loads succeeds. Recovers the TRAILING
+    fields of a headline line whose front was cut by tail capture."""
+    line = line.strip()
+    if not line:
+        return None
+    if line.startswith("{"):
+        try:
+            return json.loads(line)
+        except ValueError:
+            pass
+    pos = 0
+    for _ in range(64):
+        idx = line.find(', "', pos)
+        if idx < 0:
+            return None
+        candidate = "{" + line[idx + 2:]
+        try:
+            doc = json.loads(candidate)
+            if isinstance(doc, dict):
+                return doc
+        except ValueError:
+            pass
+        pos = idx + 1
+    return None
+
+
+def _headline_doc(raw: dict) -> Optional[dict]:
+    """The benchmark headline object of one BENCH_r*.json: the
+    harness-parsed copy when present, else recovered from the captured
+    output tail."""
+    parsed = raw.get("parsed")
+    if isinstance(parsed, dict):
+        return parsed
+    tail = raw.get("tail") or ""
+    best = None
+    for line in tail.splitlines():
+        if '"metric"' in line or '"configs"' in line or \
+                line.strip().endswith("}"):
+            doc = _recover_fragment(line)
+            # prefer the recovery with the most fields (the headline
+            # line dwarfs warning lines)
+            if doc and (best is None or len(doc) > len(best)):
+                best = doc
+    return best
+
+
+def flatten_round(doc: dict) -> tuple[dict, dict, dict]:
+    """(metrics, errors, units) of one round's headline doc. Metric
+    keys: top-level numeric fields by name, the headline `value` keyed
+    by its `metric` name, and each side config's `value` keyed
+    `c<N>.<metric>`. Errors: {key: message} for configs that recorded
+    {"error": ...} instead of numbers (DID NOT RUN, not regressed).
+    Units: the value's `unit` string — the bench encodes the workload
+    SCALE there, and two rounds are only comparable when it matches
+    (a scale or methodology change restarts the series baseline)."""
+    metrics: dict = {}
+    errors: dict = {}
+    units: dict = {}
+
+    def put(name, v, unit=None):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return
+        if direction(name) is None and name.split(".", 1)[-1] in _SKIP:
+            return
+        metrics[name] = float(v)
+        if isinstance(unit, str):
+            units[name] = unit
+
+    for k, v in doc.items():
+        if k == "value":
+            mname = doc.get("metric")
+            if isinstance(mname, str):
+                put(mname, v, doc.get("unit"))
+        elif k == "configs" and isinstance(v, dict):
+            for cnum, cdoc in v.items():
+                if not isinstance(cdoc, dict):
+                    continue
+                if cdoc.get("error"):
+                    errors[f"c{cnum}"] = str(cdoc["error"])[:200]
+                    continue
+                cm = cdoc.get("metric")
+                if isinstance(cm, str):
+                    put(f"c{cnum}.{cm}", cdoc.get("value"),
+                        cdoc.get("unit"))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            put(k, v)
+    if doc.get("error"):
+        errors["headline"] = str(doc["error"])[:200]
+    return metrics, errors, units
+
+
+def load_rounds(paths: list[str]) -> list[dict]:
+    """[{round, path, metrics, errors}] in round order."""
+    rounds = []
+    for path in sorted(paths):
+        name = os.path.basename(path)
+        m = re.search(r"r(\d+)", name)
+        label = f"r{int(m.group(1)):02d}" if m else name
+        try:
+            raw = json.load(open(path))
+        except (OSError, ValueError) as e:
+            rounds.append({"round": label, "path": path, "metrics": {},
+                           "errors": {"file": str(e)[:200]}})
+            continue
+        doc = _headline_doc(raw) or {}
+        metrics, errors, units = flatten_round(doc)
+        rounds.append({"round": label, "path": path,
+                       "metrics": metrics, "errors": errors,
+                       "units": units})
+    return rounds
+
+
+# ------------------------------------------------------------ analysis
+
+
+def find_regressions(rounds: list[dict],
+                     threshold: float = DEFAULT_THRESHOLD,
+                     latest_only: bool = True) -> list[dict]:
+    """Metrics regressing > threshold vs the best PRIOR round.
+    `latest_only` gates only each metric's newest data point (the
+    --check contract: history that already shipped can't fail CI
+    forever); False flags every historical regression for the report."""
+    series: dict[str, list[tuple[int, float, Optional[str]]]] = {}
+    for i, rnd in enumerate(rounds):
+        for name, v in rnd["metrics"].items():
+            series.setdefault(name, []).append(
+                (i, v, (rnd.get("units") or {}).get(name)))
+    out = []
+    for name, points in sorted(series.items()):
+        d = direction(name)
+        # only gate the UNIT-CARRYING series: top-level fields copied
+        # out of configs have no unit to restart on, so a config scale
+        # change would false-flag the copy (the c<N>.* twin gates)
+        if d is None or _ungated(name):
+            continue
+        if len(points) < 2:
+            continue
+        if latest_only:
+            # gate ONLY metrics present in the newest ROUND: a metric
+            # whose series ended earlier (config dropped/renamed) is
+            # immutable history — its old final point must not fail
+            # every future PR's --check forever
+            if points[-1][0] != len(rounds) - 1:
+                continue
+            checks = [len(points) - 1]
+        else:
+            checks = range(1, len(points))
+        for j in checks:
+            i, v, unit = points[j]
+            # a round is only comparable against priors measured at
+            # the SAME unit string — the bench encodes workload scale
+            # and methodology there (r04 configs ran reduced scale,
+            # r05 full: not a regression, a series restart)
+            prior = [pv for _pi, pv, pu in points[:j] if pu == unit]
+            if not prior:
+                continue
+            best = min(prior) if d == "lower" else max(prior)
+            if best <= 0:
+                continue
+            ratio = (v / best) if d == "lower" else (best / v if v > 0
+                                                    else float("inf"))
+            if ratio > 1.0 + threshold:
+                out.append({
+                    "metric": name, "direction": d,
+                    "round": rounds[i]["round"], "value": v,
+                    "best_prior": best,
+                    "regression_pct": round((ratio - 1.0) * 100, 1),
+                })
+    return out
+
+
+# ------------------------------------------------------------- report
+
+
+def _fmt_v(v: Optional[float]) -> str:
+    if v is None:
+        return "—"
+    if abs(v) >= 1000:
+        return f"{v:,.0f}"
+    if abs(v) >= 10:
+        return f"{v:.1f}"
+    return f"{v:.4g}"
+
+
+def render_markdown(rounds: list[dict], regressions: list[dict],
+                    threshold: float) -> str:
+    names = sorted({n for r in rounds for n in r["metrics"]},
+                   key=lambda n: (direction(n) is None, n))
+    lines = ["# Benchmark trend", ""]
+    lines.append(f"Rounds: {', '.join(r['round'] for r in rounds)}  ")
+    lines.append(f"Regression threshold: >{threshold:.0%} vs the best "
+                 "prior round (latest round gated; `↓` lower is "
+                 "better, `↑` higher is better, unmarked metrics are "
+                 "informational).")
+    lines.append("")
+    header = "| metric | " + " | ".join(r["round"] for r in rounds) + " |"
+    lines.append(header)
+    lines.append("|" + "---|" * (len(rounds) + 1))
+    flagged = {(r["metric"], r["round"]) for r in regressions}
+    for name in names:
+        d = direction(name)
+        arrow = {"lower": " ↓", "higher": " ↑", None: ""}[d]
+        noisy = " (info)" if _ungated(name) else ""
+        cells = []
+        for rnd in rounds:
+            v = rnd["metrics"].get(name)
+            cell = _fmt_v(v)
+            if (name, rnd["round"]) in flagged:
+                cell = f"**{cell}** ⚠"
+            cells.append(cell)
+        lines.append(f"| {name}{arrow}{noisy} | " + " | ".join(cells)
+                     + " |")
+    lines.append("")
+    ran_errors = [(r["round"], k, msg) for r in rounds
+                  for k, msg in sorted(r["errors"].items())]
+    if ran_errors:
+        lines.append("## Did not run")
+        lines.append("")
+        lines.append("Configs that recorded an error instead of "
+                     "numbers (NOT regressions):")
+        lines.append("")
+        for rnd, key, msg in ran_errors:
+            lines.append(f"- {rnd} `{key}`: {msg}")
+        lines.append("")
+    if regressions:
+        lines.append("## Regressions")
+        lines.append("")
+        for r in regressions:
+            lines.append(
+                f"- **{r['metric']}** ({r['round']}): "
+                f"{_fmt_v(r['value'])} vs best prior "
+                f"{_fmt_v(r['best_prior'])} — "
+                f"{r['regression_pct']}% worse "
+                f"({'lower' if r['direction'] == 'lower' else 'higher'}"
+                " is better)")
+    else:
+        lines.append("## Regressions")
+        lines.append("")
+        lines.append("None: no gated headline metric regressed "
+                     f">{threshold:.0%} vs its best prior round.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_trend",
+        description="perf-trend watchdog over BENCH_r*.json history")
+    p.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="directory holding the BENCH files (default: repo root)")
+    p.add_argument("--glob", default="BENCH_r*.json")
+    p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                   help="fractional regression beyond which a metric "
+                        "flags (default 0.25 = 25%%)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when any gated metric's LATEST round "
+                        "regressed vs its best prior round")
+    p.add_argument("--report", default="",
+                   help="also write the markdown report to this path")
+    p.add_argument("--all-history", action="store_true",
+                   help="flag historical (non-latest) regressions too "
+                        "(report only; --check always gates the "
+                        "latest round)")
+    args = p.parse_args(argv)
+    paths = globmod.glob(os.path.join(args.dir, args.glob))
+    if not paths:
+        print(f"no files match {args.glob} under {args.dir}",
+              file=sys.stderr)
+        return 2
+    rounds = load_rounds(paths)
+    gate = find_regressions(rounds, args.threshold, latest_only=True)
+    shown = find_regressions(rounds, args.threshold, latest_only=False) \
+        if args.all_history else gate
+    report = render_markdown(rounds, shown, args.threshold)
+    print(report)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(report)
+    if args.check and gate:
+        print(f"FAIL: {len(gate)} gated metric(s) regressed "
+              f">{args.threshold:.0%} vs best prior round",
+              file=sys.stderr)
+        return 1
+    if args.check:
+        print("OK: no gated regressions", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
