@@ -1008,11 +1008,20 @@ def _loadgen_child(port: int, rate: float, duration: float,
     for t in pool:
         t.start()
     t0 = time.time()
+    # loadgen honesty: track how far the SCHEDULER itself fell behind
+    # its arrival schedule. On a small host the generator shares cores
+    # with the server it probes; when the enqueue loop lags, "achieved
+    # < offered" is the GENERATOR's ceiling, not the serving plane's —
+    # the sweep records that explicitly instead of letting a loadgen
+    # limit masquerade as an edge limit (the BENCH_r05 1-core trap)
+    sched_lag = 0.0
     for j in range(n):
         t_sched = t0 + j / rate
         now = time.time()
         if t_sched > now:
             time.sleep(t_sched - now)
+        elif now - t_sched > sched_lag:
+            sched_lag = now - t_sched
         work.put((t_sched, payloads[j % len(payloads)]))
     deadline = time.time() + 30
     while not work.empty() and time.time() < deadline:
@@ -1029,6 +1038,7 @@ def _loadgen_child(port: int, rate: float, duration: float,
     with open(out_path, "w") as f:
         json.dump({"sent": n, "done": len(snap), "t0": t0,
                    "errors": n_err,
+                   "sched_lag_s": round(sched_lag, 4),
                    "latencies": [x[0] for x in snap],
                    "last_done": max((x[1] for x in snap), default=t0)}, f)
 
@@ -1114,6 +1124,7 @@ def _run_sweep(port, rates, n_procs, duration, here):
         lats: list = []
         sent = done = n_err = 0
         span = duration
+        sched_lag = 0.0
         for path in outs:
             try:
                 with open(path) as f:
@@ -1121,6 +1132,7 @@ def _run_sweep(port, rates, n_procs, duration, here):
                 sent += d["sent"]
                 done += d["done"]
                 n_err += d.get("errors", 0)
+                sched_lag = max(sched_lag, d.get("sched_lag_s", 0.0))
                 lats.extend(d["latencies"])
                 span = max(span, d["last_done"] - d["t0"])
             except ValueError:
@@ -1130,11 +1142,23 @@ def _run_sweep(port, rates, n_procs, duration, here):
         lats.sort()
         if not lats:
             break
+        achieved = round(done / span)
+        p99 = round(lats[int(len(lats) * 0.99)] * 1000, 1)
         entry = {"offered_rps": total_rate,
-                 "achieved_rps": round(done / span),
+                 "achieved_rps": achieved,
                  "p50_ms": round(lats[len(lats) // 2] * 1000, 1),
-                 "p99_ms": round(lats[int(len(lats) * 0.99)] * 1000, 1),
+                 "p99_ms": p99,
                  "completed": done, "sent": sent, "errors": n_err}
+        if sched_lag > 0.25:
+            entry["sched_lag_s"] = round(sched_lag, 3)
+        # the GENERATOR topped out, not the plane: everything it sent
+        # completed, fast, yet the achieved rate undershot the offer —
+        # the arrival schedule itself fell behind. An edge improvement
+        # must not be judged against (or masked by) this entry.
+        if (achieved < 0.9 * total_rate and n_err == 0
+                and done >= 0.95 * sent
+                and (p99 < 100 or sched_lag > 0.25)):
+            entry["loadgen_limited"] = True
         sweep.append(entry)
         # SLO: p99 under 100ms and the offered schedule kept up with
         if entry["p99_ms"] < 100 and done >= 0.95 * sent:
@@ -1207,8 +1231,12 @@ def config5():
 
     # the same pre-batched reviews over the REAL gRPC wire (the
     # production comm backend at the Driver seam): adds JSON + protobuf
-    # framing and the localhost round-trip
+    # framing and the localhost round-trip. The STREAM tier pipelines
+    # the same batches over one bidirectional HTTP/2 stream
+    # (ReviewStream) — no per-RPC round trip between batches; it is
+    # the bulk-ingest successor path the trend watchdog gates.
     grpc_rps = None
+    grpc_stream_rps = None
     server = rc = None
     try:
         from gatekeeper_tpu.service import RemoteClient, make_server
@@ -1229,8 +1257,25 @@ def config5():
                 rc.review_batch(wb)
                 n_wire += len(wb)
         grpc_rps = n_wire / (time.time() - t0)
+
+        def stream_batches(stop_at):
+            while time.time() < stop_at:
+                for wb in driver_batches:
+                    yield wb
+
+        for _ in rc.review_stream(stream_batches(time.time() + 0.5)):
+            pass  # warm the stream path
+        n_stream = 0
+        t0 = time.time()
+        for resp in rc.review_stream(stream_batches(t0 + 3.0)):
+            n_stream += len(resp)
+        grpc_stream_rps = n_stream / (time.time() - t0)
     except Exception as e:
-        grpc_rps = f"unavailable: {e}"[:120]
+        err = f"unavailable: {e}"[:120]
+        if grpc_rps is None:
+            grpc_rps = err
+        if grpc_stream_rps is None:
+            grpc_stream_rps = err
     finally:
         # leaked server/channel threads would skew every later tier;
         # stop() returns an event — WAIT for teardown to finish
@@ -1416,12 +1461,44 @@ def config5():
                              "BENCH_C5_WORKERS",
                              "pre-forked frontend + engine + loadgen "
                              "processes")
+    bulk_rps = None
     if mw_skip is not None:
         mw_sweep.append(mw_skip)
+        bulk_rps = mw_skip.get("skipped")
     else:
         engine_procs: list = []
         try:
             engine_procs, socks, mports = _spawn_engines(1, "w")
+            # BULK binary ingest tier: pre-framed reviews over the
+            # backplane B frame straight into the engine child's
+            # MicroBatcher — the edge path with no HTTP at all (what a
+            # CI scanner speaks). Cross-process, unlike the in-process
+            # engine tier above.
+            try:
+                from gatekeeper_tpu.control.backplane import (
+                    BackplaneClient as _BC)
+
+                bulk_payloads = [json.dumps({
+                    "apiVersion": "admission.k8s.io/v1beta1",
+                    "kind": "AdmissionReview",
+                    "request": dict(r, uid=f"bk{k}",
+                                    userInfo={"username": "bench"})},
+                ).encode() for k, r in enumerate(reviews[:256])]
+                bulk_chunks = [bulk_payloads[i:i + 64]
+                               for i in range(0, len(bulk_payloads), 64)]
+                bc = _BC(socks[0], worker_id="bulk")
+                for ch in bulk_chunks:  # warm
+                    bc.review_bulk(ch, timeout_s=30.0)
+                n_bulk = 0
+                t0 = time.time()
+                while time.time() - t0 < 3.0:
+                    for ch in bulk_chunks:
+                        bc.review_bulk(ch, timeout_s=30.0)
+                        n_bulk += len(ch)
+                bulk_rps = round(n_bulk / (time.time() - t0))
+                bc.close()
+            except Exception as e:
+                bulk_rps = f"unavailable: {e}"[:120]
             for n_workers in worker_counts:
                 fronts = FrontendSupervisor(n_workers, socks[0],
                                             port=0, addr="127.0.0.1")
@@ -1549,9 +1626,27 @@ def config5():
         "host_cores": cores,
         "worker_counts": worker_counts,
         "engine_batched_reviews_per_sec": round(engine_rps),
+        # the ISSUE-14 headline gap: best open-loop edge rate as a
+        # fraction of the engine's pre-batched ceiling (acceptance:
+        # >= 0.5 on the bench host). Tracked by bench_trend.
+        "edge_vs_engine_ratio": (
+            round(best.get("achieved_rps", 0) / engine_rps, 3)
+            if engine_rps else None),
+        # the generator topped out on the headline entry: the edge
+        # number is a loadgen floor, not a serving-plane ceiling
+        "loadgen_limited": bool(best.get("loadgen_limited", False)),
         "grpc_batched_reviews_per_sec": (round(grpc_rps)
                                          if isinstance(grpc_rps, float)
                                          else grpc_rps),
+        # pipelined ReviewStream over one HTTP/2 stream — the bulk-
+        # ingest successor of the unary batched tier (gated >= r04's
+        # 5,067/s by bench_trend once two rounds carry it)
+        "grpc_stream_reviews_per_sec": (
+            round(grpc_stream_rps)
+            if isinstance(grpc_stream_rps, float) else grpc_stream_rps),
+        # length-prefixed B frames over the backplane socket into a
+        # separate engine process — the no-HTTP binary ingest path
+        "backplane_bulk_reviews_per_sec": bulk_rps,
         "batcher_closed_loop": closed_loop,
         "tiers_note": "engine = pre-batched driver.review_batch (the "
                       "gRPC pre-batched ingest path); closed_loop = "
@@ -1561,7 +1656,11 @@ def config5():
                       "they measure the serving plane sharing cores "
                       "with the load generators; multi_worker_sweep = "
                       "pre-forked frontends over the shared batching "
-                      "backplane (--admission-workers)",
+                      "backplane (--admission-workers). The bulk and "
+                      "HTTP tiers ride the engine's generation-keyed "
+                      "decision cache on repeated shapes (the "
+                      "DaemonSet-storm case they model); the engine "
+                      "and gRPC tiers evaluate every review",
         # the attribution read (ISSUE 13 acceptance): seal-reason /
         # fill / queue-depth / duty-cycle deltas across one topology's
         # open-loop sweep — the topology whose sweep actually drove

@@ -28,16 +28,43 @@ Wire protocol, length-prefixed frames over a Unix domain socket
     payload  := type(1 byte) + body
     'Q'      := id u32be, timeout_s f64be (0 = absent), tflags u8,
                 [tflags&1: trace_id 16 bytes, t_recv f64be,
-                t_fwd f64be], path_len u16be,
-                path bytes, review bytes            (frontend -> engine)
+                t_fwd f64be], path_len u16be, path bytes,
+                [tflags&2: ring_off u32be, ring_len u32be — the review
+                lives in the frontend's request RING; else:] review
+                bytes                               (frontend -> engine)
     'R'      := id u32be, http_status u16be, body   (engine -> frontend)
-    'H'      := hello JSON {"worker": id}           (frontend -> engine)
+    'r'      := id u32be, http_status u16be, ring_off u32be,
+                ring_len u32be (response bytes live in the frontend's
+                reply RING)                         (engine -> frontend)
+    'H'      := hello JSON {"worker": id, "rings": {"q":..., "r":...}}
+                                                    (frontend -> engine)
+    'A'      := ack JSON {"rings": bool} — whether the engine attached
+                the hello's rings; descriptors flow only after a true
+                ack                                 (engine -> frontend)
     'S'      := stats JSON (aggregated forward-latency histogram delta
                 + failure-stance answer count + per-stage span-duration
                 histogram deltas for sampled requests) (frontend -> engine)
     'L'      := id u32be, library-op JSON           (primary -> engine)
     'M'      := id u32be (stats poll; engine answers R with its
                 relayed-metrics snapshot JSON)      (primary -> engine)
+    'B'      := id u32be, timeout_s f64be, count u32be, count x
+                (u32be len, review bytes) — BULK binary ingest: the
+                whole batch feeds the MicroBatcher pre-parsed and the
+                answer is an R frame of count x (u32be len, envelope
+                bytes). The streaming path for CI scanners / service-
+                mesh authorizers that skip HTTP framing entirely
+                                                    (caller -> engine)
+
+Shared-memory rings (tflags&2 / 'r' frames, control/shm.py): each
+frontend owns a request ring + a reply ring; review bytes are written
+ring-side at accept time and the frames carry (offset, length)
+descriptors, so the socket — which remains the ordering and wakeup
+channel — moves ~40 bytes per review instead of the payload. The
+engine parses reviews out of the mapped ring (zero payload copies
+across the backplane) and writes response envelopes into the reply
+ring the same way. A burst that outruns the reader falls back to
+inline-payload frames per request (alloc returns None past the
+watermark); the accept loop never blocks on ring space.
 
 N-engine plane (--admission-engines > 1): one engine PROCESS per chip,
 each with its own Client/MicroBatcher/device and its own socket
@@ -88,6 +115,7 @@ from typing import Callable, Optional
 
 from ..utils import faults
 from . import jsonio
+from . import shm
 from . import trace as gtrace
 from .logging import logger
 from .webhook import (
@@ -104,7 +132,14 @@ log = logger("backplane")
 _Q_HEADER = struct.Struct("!Id")   # request id, timeout seconds
 _Q_TRACE = struct.Struct("!16sdd")  # trace id, t_recv, t_fwd (monotonic)
 _Q_PATHLEN = struct.Struct("!H")
+_Q_RING = struct.Struct("!II")     # request-ring offset, payload length
 _R_HEADER = struct.Struct("!IH")   # request id, http status
+_R_RING = struct.Struct("!IHII")   # id, status, reply-ring offset, length
+_B_HEADER = struct.Struct("!IdI")  # request id, timeout seconds, count
+_B_LEN = struct.Struct("!I")
+# tflags bits on Q frames
+TF_TRACE = 0x1   # span context follows
+TF_RING = 0x2    # body is a request-ring descriptor, not inline bytes
 
 # frontends bucket forward latencies with the same bounds the engine
 # registry renders — one constant, no drift into mislabeled buckets
@@ -137,6 +172,13 @@ class BackplaneError(Exception):
     the frontend answers per the failure stance."""
 
 
+# Q-frame body sentinels on the engine side: the review either arrived
+# pre-parsed off the request ring, is still raw bytes, or was a ring
+# payload that failed to parse (a torn slot after a cancel — answer 400)
+_UNPARSED = object()
+_BAD = object()
+
+
 def default_socket_path() -> str:
     import tempfile
 
@@ -161,11 +203,34 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _send_frame(sock: socket.socket, lock: threading.Lock,
-                *parts: bytes) -> None:
-    payload = b"".join(parts)
-    msg = struct.pack("!I", len(payload)) + payload
+                *parts) -> None:
+    """Send one length-prefixed frame as a vectored write.
+
+    The previous implementation concatenated `struct.pack("!I", n) +
+    b"".join(parts)` — a full extra copy of every payload per frame, on
+    top of the kernel's own. `sendmsg` hands the header and payload
+    buffers to the kernel as an iovec instead; the rare partial send
+    (payload larger than the socket buffer under backpressure) falls
+    back to flattening just the unsent remainder."""
+    plen = sum(len(p) for p in parts)
+    header = struct.pack("!I", plen)
+    bufs = (header, *parts)
+    if len(bufs) > 1000:
+        # sendmsg is capped at IOV_MAX (1024) iovecs — a bulk B frame
+        # of >=500 reviews would hit EMSGSIZE and be misread as
+        # connection loss; flatten once instead
+        bufs = (header, b"".join(parts))
     with lock:
-        sock.sendall(msg)
+        try:
+            sent = sock.sendmsg(bufs)
+        except (AttributeError, NotImplementedError):
+            # pragma: no cover - TLS/odd sockets (ssl raises
+            # NotImplementedError, not AttributeError)
+            sock.sendall(header + b"".join(parts))
+            return
+        if sent < 4 + plen:
+            rest = b"".join(bufs)
+            sock.sendall(memoryview(rest)[sent:])
 
 
 # ----------------------------------------------------------------- engine
@@ -354,6 +419,7 @@ class BackplaneEngine:
 
     def _read_loop(self, conn: socket.socket, wlock: threading.Lock) -> None:
         fd = conn.fileno()
+        rings = None  # this frontend's shm ring pair, attached on hello
         try:
             while not self._stop.is_set():
                 (length,) = struct.unpack("!I", _recv_exact(conn, 4))
@@ -372,7 +438,7 @@ class BackplaneEngine:
                     tflags = payload[off]
                     off += 1
                     tr = gtrace.NOOP
-                    if tflags & 1:
+                    if tflags & TF_TRACE:
                         # sampled: reconstruct the frontend-side spans
                         # from the carried span context (same-host
                         # CLOCK_MONOTONIC). frontend_parse is remote —
@@ -396,7 +462,37 @@ class BackplaneEngine:
                     (plen,) = _Q_PATHLEN.unpack_from(payload, off)
                     off += _Q_PATHLEN.size
                     path = payload[off:off + plen].decode("ascii", "replace")
-                    body = payload[off + plen:]
+                    off += plen
+                    review = _UNPARSED
+                    body = b""
+                    if tflags & TF_RING and rings is not None:
+                        # descriptor frame: the review lives in this
+                        # frontend's request ring. Parse it HERE, zero-
+                        # copy off the mapped segment, so the slot
+                        # releases in FIFO order with the descriptors —
+                        # the engine's only per-review byte work is the
+                        # JSON decode it had to do anyway.
+                        roff, rlen = _Q_RING.unpack_from(payload, off)
+                        t_ring0 = time.monotonic() if tr.sampled else 0.0
+                        try:
+                            review = jsonio.loads(
+                                rings.req.view(roff, rlen))
+                        except ValueError:
+                            review = _BAD
+                        finally:
+                            rings.req.release(roff)
+                        if tr.sampled:
+                            tr.add_span("ring_read", t_ring0,
+                                        time.monotonic())
+                        if review is not _BAD \
+                                and route_path(path) == "preview":
+                            # previews consume raw body bytes (the
+                            # client avoids the ring for them; this is
+                            # the defensive path)
+                            body = jsonio.dumps_bytes(review)
+                            review = _UNPARSED
+                    else:
+                        body = payload[off:]
                     # deadline pinned HERE: queueing ahead of the serve
                     # call spends the request's own budget
                     deadline = request_deadline(
@@ -409,7 +505,8 @@ class BackplaneEngine:
                     # reuses the already-parsed review).
                     try:
                         inline = self._try_inline(timeout_s, deadline,
-                                                  path, body, tr)
+                                                  path, body, tr,
+                                                  review=review)
                     except Exception as e:
                         log.error("backplane inline serve error",
                                   details=str(e))
@@ -418,9 +515,8 @@ class BackplaneEngine:
                         # a failed/partial send desyncs the stream:
                         # close and let the frontend reconnect
                         t_send = time.monotonic()
-                        _send_frame(conn, wlock, b"R",
-                                    _R_HEADER.pack(rid, inline[0]),
-                                    inline[1])
+                        self._respond_frame(conn, wlock, rings, rid,
+                                            inline[0], inline[1])
                         if tr.sampled:
                             tr.add_span("respond", t_send,
                                         time.monotonic())
@@ -436,7 +532,27 @@ class BackplaneEngine:
                             else self._pool)
                     pool.submit(self._serve, conn, wlock, rid,
                                 timeout_s, deadline, path, body,
-                                inline[1], tr, time.monotonic())
+                                inline[1], tr, time.monotonic(), rings)
+                elif kind == b"B":
+                    # BULK binary ingest: one frame, many pre-framed
+                    # reviews, fed to the MicroBatcher as one submit —
+                    # the streaming path for callers that skip HTTP
+                    rid, timeout_b, count = _B_HEADER.unpack_from(
+                        payload, 1)
+                    if self.ready_check is not None \
+                            and not self.ready_check():
+                        _send_frame(conn, wlock, b"R",
+                                    _R_HEADER.pack(rid,
+                                                   STATUS_NOT_READY),
+                                    b"engine awaiting library sync")
+                        continue
+                    deadline = request_deadline(
+                        {"timeoutSeconds": timeout_b} if timeout_b > 0
+                        else {}, self.default_timeout)
+                    with self._inflight_lock:
+                        self._inflight += 1
+                    self._pool.submit(self._serve_bulk, conn, wlock,
+                                      rid, deadline, payload, count)
                 elif kind == b"H":
                     info = jsonio.loads(payload[1:]) or {}
                     worker = str(info.get("worker", "?"))
@@ -444,8 +560,22 @@ class BackplaneEngine:
                         if fd in self._conns:
                             self._conns[fd] = (conn, wlock, worker)
                     self._report_workers()
+                    ring_names = info.get("rings")
+                    if ring_names:
+                        ack = False
+                        try:
+                            rings = shm.EngineRings(ring_names)
+                            ack = True
+                        except Exception as e:
+                            rings = None
+                            log.warning(
+                                "ring attach failed; inline payloads",
+                                details=str(e))
+                        _send_frame(conn, wlock, b"A",
+                                    jsonio.dumps_bytes({"rings": ack}))
                     log.info("frontend connected",
-                             details={"worker": worker})
+                             details={"worker": worker,
+                                      "rings": rings is not None})
                 elif kind == b"S":
                     self._merge_stats(jsonio.loads(payload[1:]) or {})
                 elif kind == b"L":
@@ -497,8 +627,15 @@ class BackplaneEngine:
                 try:
                     from . import metrics
                     metrics.report_backplane_inflight(worker, 0)
+                    if rings is not None:
+                        metrics.report_ring_fill(worker, 0.0)
                 except Exception:
                     pass
+            if rings is not None:
+                # engine-side DETACH on connection loss: the frontend
+                # (or its supervisor) owns unlinking; any in-flight
+                # descriptors already failed with their waiters
+                rings.close()
             try:
                 conn.close()
             except OSError:
@@ -530,6 +667,17 @@ class BackplaneEngine:
             # that separates "frontends backed up" from "engine idle"
             metrics.report_backplane_inflight(
                 worker, int(stats.get("inflight") or 0))
+        # ring-path accounting: how many of this frontend's forwards
+        # rode the shm ring vs fell back to inline payloads (burst
+        # outran the reader / oversized review), plus the request
+        # ring's sampled fill fraction — the "is the ring sized right"
+        # read off one scrape
+        for pth, n in (stats.get("ring_paths") or {}).items():
+            if n:
+                metrics.report_backplane_ring(worker, str(pth), int(n))
+        if "ring_fill" in stats:
+            metrics.report_ring_fill(
+                worker, float(stats.get("ring_fill") or 0.0))
         # frontend-side span deltas (sampled requests only): each
         # frontend ships aggregated histograms for the stages it owns
         # (frontend_parse) — the engine's trace sink skips those
@@ -561,26 +709,35 @@ class BackplaneEngine:
         return deadline
 
     def _try_inline(self, timeout_s: float, deadline: float, path: str,
-                    body: bytes, tr=gtrace.NOOP) -> tuple:
+                    body: bytes, tr=gtrace.NOOP, review=_UNPARSED) -> tuple:
         """(status, payload) when the verdict needs no blocking work
         (cache hit / short-circuit / namespace-label check / 404);
-        ("eval", parsed_review_or_None) hands it to the worker pool."""
+        ("eval", parsed_review_or_None) hands it to the worker pool.
+        `review` carries the pre-parsed review when the body arrived as
+        a ring descriptor (the read loop decodes it zero-copy)."""
         route = route_path(path)
+        if review is _BAD:
+            # a ring slot that failed to parse: torn by a cancel (the
+            # waiter is already gone) or a corrupt writer — 400, never
+            # a handler call on garbage
+            return (400, b"")
         if route == "admitlabel":
             if self.ns_label is None:
                 return (404, b"")
-            try:
-                review = jsonio.loads(body)
-            except ValueError:
-                return (400, b"")
+            if review is _UNPARSED:
+                try:
+                    review = jsonio.loads(body)
+                except ValueError:
+                    return (400, b"")
             return (200, encode_envelope(self.ns_label.handle(review)))
         if route == "admit":
             if self.validation is None:
                 return (404, b"")
-            try:
-                review = jsonio.loads(body)
-            except ValueError:
-                return (400, b"")
+            if review is _UNPARSED:
+                try:
+                    review = jsonio.loads(body)
+                except ValueError:
+                    return (400, b"")
             eff_deadline = self._fold_timeout(review, timeout_s, deadline)
             out = self.validation.handle(review, deadline=eff_deadline,
                                          fast=True, trace=tr)
@@ -594,17 +751,49 @@ class BackplaneEngine:
                 payload = encode_envelope(out)
             return (200, payload)
         if route == "mutate":
-            return ("eval", None) if self.mutation is not None \
-                else (404, b"")
+            if self.mutation is None:
+                return (404, b"")
+            if review is _UNPARSED:
+                # inline payload: parse on the pool thread, off the
+                # read loop (mutation payloads can be large)
+                return ("eval", None)
+            return ("eval",
+                    (review, self._fold_timeout(review, timeout_s,
+                                                deadline)))
         if route == "preview":
             return ("eval-preview", None) if self.preview is not None \
                 else (404, b"")
         return (404, b"")
 
+    def _respond_frame(self, conn, wlock, rings, rid: int, status: int,
+                       out: bytes) -> None:
+        """Answer one Q frame: descriptor over the reply ring when the
+        frontend has one and the payload fits (zero payload copies on
+        the socket), else the inline R frame. Raises OSError upward —
+        the caller owns desync handling."""
+        if rings is not None and out:
+            try:
+                roff = rings.reply.append(out)
+            except (TypeError, ValueError):  # ring torn down mid-serve
+                roff = None
+            if roff is not None:
+                try:
+                    _send_frame(conn, wlock, b"r",
+                                _R_RING.pack(rid, status, roff,
+                                             len(out)))
+                except OSError:
+                    try:
+                        rings.reply.cancel(roff)
+                    except (TypeError, ValueError):
+                        pass
+                    raise
+                return
+        _send_frame(conn, wlock, b"R", _R_HEADER.pack(rid, status), out)
+
     def _serve(self, conn: socket.socket, wlock: threading.Lock,
                rid: int, timeout_s: float, deadline: float, path: str,
                body: bytes, handoff=None, tr=gtrace.NOOP,
-               t_queued: float = 0.0) -> None:
+               t_queued: float = 0.0, rings=None) -> None:
         review = None
         if handoff is not None:
             review, deadline = handoff
@@ -617,8 +806,8 @@ class BackplaneEngine:
                                        review=review, tr=tr)
             t_send = time.monotonic()
             try:
-                _send_frame(conn, wlock, b"R",
-                            _R_HEADER.pack(rid, status), out)
+                self._respond_frame(conn, wlock, rings, rid, status,
+                                    out)
             except OSError:
                 # frontend died or the send timed out mid-frame — the
                 # stream may be desynced, so close it (the supervisor
@@ -630,6 +819,59 @@ class BackplaneEngine:
             if tr.sampled:
                 tr.add_span("respond", t_send, time.monotonic())
                 tr.finish()
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    def _serve_bulk(self, conn: socket.socket, wlock: threading.Lock,
+                    rid: int, deadline: float, payload: bytes,
+                    count: int) -> None:
+        """One B frame: parse every length-prefixed review, feed the
+        whole batch to the MicroBatcher via handle_bulk (one enqueue
+        pass, shared seals), answer count x (len, envelope) in one R
+        frame."""
+
+        def send(*parts):
+            # any partial/failed send desyncs the multiplexed stream:
+            # close so the caller reconnects clean (same contract as
+            # _serve)
+            try:
+                _send_frame(conn, wlock, *parts)
+            except OSError:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+        try:
+            reviews = []
+            off = 1 + _B_HEADER.size
+            try:
+                for _ in range(count):
+                    (n,) = _B_LEN.unpack_from(payload, off)
+                    off += _B_LEN.size
+                    reviews.append(
+                        jsonio.loads(memoryview(payload)[off:off + n]))
+                    off += n
+            except (ValueError, struct.error):
+                send(b"R", _R_HEADER.pack(rid, 400), b"")
+                return
+            if self.validation is None:
+                send(b"R", _R_HEADER.pack(rid, 404), b"")
+                return
+            try:
+                outs = self.validation.handle_bulk(reviews, deadline)
+            except Exception as e:
+                log.error("bulk ingest failed", details=str(e))
+                send(b"R", _R_HEADER.pack(rid, 500),
+                     str(e).encode("utf-8", "replace")[:512])
+                return
+            parts = [_R_HEADER.pack(rid, 200), _B_LEN.pack(len(outs))]
+            for env in outs:
+                item = encode_envelope(env)
+                parts.append(_B_LEN.pack(len(item)))
+                parts.append(item)
+            send(b"R", *parts)
         finally:
             with self._inflight_lock:
                 self._inflight -= 1
@@ -685,10 +927,19 @@ class _Waiter:
 class BackplaneClient:
     """Frontend-side connection to the engine: one multiplexed UDS
     socket, a reader thread resolving verdicts by request id. Thread-
-    safe; every HTTP handler thread calls `call()` concurrently."""
+    safe; every HTTP handler thread calls `call()` concurrently.
+
+    With `ring_mb` > 0 the client owns a shared-memory ring pair
+    (control/shm.py): review bytes are written into the request ring
+    and Q frames carry descriptors; responses come back as reply-ring
+    descriptors resolved to zero-copy RingSlice payloads. The ring is
+    an optimization with an always-available inline fallback — ring
+    creation failure, a missing engine ack, an oversized review, or an
+    exhausted ring all degrade to the original inline frames."""
 
     def __init__(self, socket_path: str, worker_id: str = "0",
-                 connect_timeout: float = 1.0):
+                 connect_timeout: float = 1.0, ring_mb: float = 0.0,
+                 ring_prefix: str = ""):
         self.socket_path = socket_path
         self.worker_id = worker_id
         self.connect_timeout = connect_timeout
@@ -699,6 +950,22 @@ class BackplaneClient:
         self._pending_lock = threading.Lock()
         self._next_id = 0
         self._closed = False
+        # optional hooks the FrontendServer installs: per-forward ring
+        # path counts ("ring"/"inline") and sampled ring_write stage
+        # durations, both shipped engine-side over S frames
+        self.stats_hook = None
+        self.stage_hook = None
+        self._rings = None
+        self._ring_ok = threading.Event()
+        if ring_mb > 0 and shm.supported():
+            prefix = ring_prefix \
+                or f"gk-bp-{os.getpid()}-{worker_id}"
+            try:
+                self._rings = shm.ClientRings(
+                    prefix, max(1, int(ring_mb * 1024 * 1024)))
+            except OSError as e:
+                log.warning("shm ring unavailable; inline payloads",
+                            details=str(e))
 
     # connection -----------------------------------------------------
 
@@ -726,9 +993,15 @@ class BackplaneClient:
             threading.Thread(target=self._read_loop, args=(sock,),
                              name="backplane-client-read",
                              daemon=True).start()
+            hello = {"worker": self.worker_id}
+            if self._rings is not None:
+                # descriptors flow only after the engine's A-frame ack
+                # confirms it attached this pair
+                self._ring_ok.clear()
+                hello["rings"] = self._rings.hello()
             try:
-                _send_frame(sock, self._wlock, b"H", jsonio.dumps_bytes(
-                    {"worker": self.worker_id}))
+                _send_frame(sock, self._wlock, b"H",
+                            jsonio.dumps_bytes(hello))
             except OSError as e:
                 self._drop(sock)
                 raise BackplaneError(
@@ -739,6 +1012,7 @@ class BackplaneClient:
         with self._conn_lock:
             if self._sock is sock:
                 self._sock = None
+        self._ring_ok.clear()
         try:
             sock.close()
         except OSError:
@@ -752,21 +1026,48 @@ class BackplaneClient:
         for w in waiters:
             w.status = -1
             w.event.set()
+        if self._rings is not None:
+            # the engine detached: free every outstanding request-ring
+            # slot (their waiters just failed) so the ring cannot silt
+            self._rings.on_disconnect()
 
     def _read_loop(self, sock: socket.socket) -> None:
         try:
             while True:
                 (length,) = struct.unpack("!I", _recv_exact(sock, 4))
                 payload = _recv_exact(sock, length)
-                if payload[:1] != b"R":
-                    continue
-                rid, status = _R_HEADER.unpack_from(payload, 1)
-                with self._pending_lock:
-                    waiter = self._pending.pop(rid, None)
-                if waiter is not None:
+                kind = payload[:1]
+                if kind == b"R":
+                    rid, status = _R_HEADER.unpack_from(payload, 1)
+                    with self._pending_lock:
+                        waiter = self._pending.pop(rid, None)
+                    if waiter is not None:
+                        waiter.status = status
+                        waiter.body = payload[1 + _R_HEADER.size:]
+                        waiter.event.set()
+                elif kind == b"r":
+                    # reply-ring descriptor: the payload never crossed
+                    # the socket — hand the waiter a zero-copy slice it
+                    # releases after the final HTTP send
+                    rid, status, roff, rlen = _R_RING.unpack_from(
+                        payload, 1)
+                    rings = self._rings  # close() may null it mid-loop
+                    with self._pending_lock:
+                        waiter = self._pending.pop(rid, None)
+                    if waiter is None:
+                        # abandoned waiter (deadline fired): release
+                        # the slot NOW or the reply ring silts up
+                        if rings is not None:
+                            rings.reply.release(roff)
+                        continue
                     waiter.status = status
-                    waiter.body = payload[1 + _R_HEADER.size:]
+                    waiter.body = rings.reply_slice(roff, rlen) \
+                        if rings is not None else b""
                     waiter.event.set()
+                elif kind == b"A":
+                    ack = jsonio.loads(payload[1:]) or {}
+                    if ack.get("rings") and self._rings is not None:
+                        self._ring_ok.set()
         except (ConnectionError, OSError, struct.error):
             pass
         finally:
@@ -791,6 +1092,19 @@ class BackplaneClient:
         sock = self._sock
         if sock is not None:
             self._drop(sock)
+        if self._rings is not None:
+            rings, self._rings = self._rings, None
+            rings.close(unlink_segments=True)
+
+    def ring_fill(self) -> Optional[float]:
+        """Request-ring used fraction (None when no ring) — shipped in
+        the S-frame stats as the ring-sizing saturation read."""
+        if self._rings is None:
+            return None
+        try:
+            return self._rings.req.used_fraction()
+        except (TypeError, ValueError):
+            return None
 
     # calls ----------------------------------------------------------
 
@@ -816,16 +1130,39 @@ class BackplaneClient:
             # per the failure stance instead of dropping the socket
             raise BackplaneError(f"injected engine fault: {e}") from e
         sock = self._ensure_connected()
+        # ring write FIRST (before the waiter registers): the review
+        # bytes land in the shared segment and only a ~40-byte
+        # descriptor rides the socket. None (ring full / oversized /
+        # unacked) falls back to the inline frame for THIS request.
+        # Local ref: a concurrent close() nulls self._rings mid-call.
+        rings = self._rings
+        roff = None
+        if rings is not None and self._ring_ok.is_set() \
+                and not path.startswith("/v1/preview"):
+            t_w0 = time.monotonic()
+            try:
+                roff = rings.req.append(body)
+            except (TypeError, ValueError):  # torn down concurrently
+                roff = None
+            if roff is not None and trace_ctx is not None \
+                    and self.stage_hook is not None:
+                self.stage_hook("ring_write", time.monotonic() - t_w0)
+        if rings is not None and self.stats_hook is not None:
+            self.stats_hook("ring" if roff is not None else "inline")
         # trace block built BEFORE the waiter registers: nothing
         # between registration and the send may raise anything but the
         # handled OSError, or the pending entry leaks forever
+        flags = (TF_RING if roff is not None else 0) \
+            | (TF_TRACE if trace_ctx is not None else 0)
         if trace_ctx is None:
-            tblock = b"\x00"
+            tblock = bytes((flags,))
         else:
             tid_hex, t_recv = trace_ctx
-            tblock = b"\x01" + _Q_TRACE.pack(
+            tblock = bytes((flags,)) + _Q_TRACE.pack(
                 bytes.fromhex(tid_hex)[:16].ljust(16, b"\x00"),
                 t_recv, time.monotonic())
+        tail = _Q_RING.pack(roff, len(body)) if roff is not None \
+            else body
         waiter = _Waiter()
         with self._pending_lock:
             self._next_id = (self._next_id + 1) & 0xFFFFFFFF
@@ -835,10 +1172,15 @@ class BackplaneClient:
             _send_frame(sock, self._wlock, b"Q",
                         _Q_HEADER.pack(rid, timeout_s or 0.0), tblock,
                         _Q_PATHLEN.pack(len(path)), path.encode("ascii"),
-                        body)
+                        tail)
         except OSError as e:
             with self._pending_lock:
                 self._pending.pop(rid, None)
+            if roff is not None:
+                try:
+                    rings.req.cancel(roff)
+                except (TypeError, ValueError):
+                    pass  # ring torn down concurrently
             self._drop(sock)
             raise BackplaneError(
                 f"admission engine connection lost: {e}") from e
@@ -849,6 +1191,14 @@ class BackplaneClient:
                                  + 0.5):
             with self._pending_lock:
                 self._pending.pop(rid, None)
+            if roff is not None:
+                # nobody will consume the slot; free it (a wedged-but-
+                # alive engine may later parse the reused bytes and
+                # 400 a request id nobody waits on — harmless)
+                try:
+                    rings.req.cancel(roff)
+                except (TypeError, ValueError):
+                    pass  # ring torn down concurrently
             raise BackplaneError("admission engine verdict timed out")
         if waiter.status < 0:
             raise BackplaneError("admission engine connection lost")
@@ -911,6 +1261,54 @@ class BackplaneClient:
         except ValueError as e:
             raise BackplaneError(f"stats poll unparseable: {e}") from e
 
+    def review_bulk(self, payloads: list, timeout_s: float = 30.0
+                    ) -> list[bytes]:
+        """STREAMING binary ingest: ship a whole batch of serialized
+        AdmissionReviews as one length-prefixed B frame (no HTTP/1.1
+        framing, no per-review frames) and get the envelope bytes back
+        in order. The engine parses once and feeds the MicroBatcher in
+        one enqueue pass — the bulk-caller path for CI scanners and
+        service-mesh authorizers. Raises BackplaneError on loss or
+        timeout."""
+        sock = self._ensure_connected()
+        waiter = _Waiter()
+        with self._pending_lock:
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+            rid = self._next_id
+            self._pending[rid] = waiter
+        parts = [_B_HEADER.pack(rid, timeout_s or 0.0, len(payloads))]
+        for b in payloads:
+            parts.append(_B_LEN.pack(len(b)))
+            parts.append(b)
+        try:
+            _send_frame(sock, self._wlock, b"B", *parts)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            self._drop(sock)
+            raise BackplaneError(
+                f"bulk ingest connection lost: {e}") from e
+        if not waiter.event.wait((timeout_s or 30.0) + 5.0):
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise BackplaneError("bulk ingest timed out")
+        if waiter.status < 0:
+            raise BackplaneError("bulk ingest connection lost")
+        if waiter.status != 200:
+            raise BackplaneError(
+                f"bulk ingest refused ({waiter.status}): "
+                f"{bytes(waiter.body)[:200].decode('utf-8', 'replace')}")
+        body = bytes(waiter.body)
+        (count,) = _B_LEN.unpack_from(body, 0)
+        off = _B_LEN.size
+        outs = []
+        for _ in range(count):
+            (n,) = _B_LEN.unpack_from(body, off)
+            off += _B_LEN.size
+            outs.append(body[off:off + n])
+            off += n
+        return outs
+
 
 # ----------------------------------------------------------------- router
 
@@ -928,13 +1326,42 @@ class BackplaneRouter:
     (call / send_stats / connected / close)."""
 
     def __init__(self, socket_paths, worker_id: str = "0",
-                 connect_timeout: float = 1.0):
+                 connect_timeout: float = 1.0, ring_mb: float = 0.0,
+                 ring_prefix: str = ""):
         paths = list(socket_paths)
         if not paths:
             raise ValueError("router needs at least one engine socket")
+        # one ring pair per ENGINE connection (each engine process maps
+        # its own pair); names stay unique per (worker, engine index)
+        base = ring_prefix or f"gk-bp-{os.getpid()}-{worker_id}"
         self.clients = [BackplaneClient(p, worker_id=worker_id,
-                                        connect_timeout=connect_timeout)
-                        for p in paths]
+                                        connect_timeout=connect_timeout,
+                                        ring_mb=ring_mb,
+                                        ring_prefix=f"{base}-e{i}")
+                        for i, p in enumerate(paths)]
+
+    @property
+    def stats_hook(self):
+        return self.clients[0].stats_hook
+
+    @stats_hook.setter
+    def stats_hook(self, fn) -> None:
+        for c in self.clients:
+            c.stats_hook = fn
+
+    @property
+    def stage_hook(self):
+        return self.clients[0].stage_hook
+
+    @stage_hook.setter
+    def stage_hook(self, fn) -> None:
+        for c in self.clients:
+            c.stage_hook = fn
+
+    def ring_fill(self) -> Optional[float]:
+        fills = [f for f in (c.ring_fill() for c in self.clients)
+                 if f is not None]
+        return max(fills) if fills else None
 
     def connected(self) -> bool:
         return any(c.connected() for c in self.clients)
@@ -1027,6 +1454,9 @@ class _StatsAccumulator:
         # the frontend-side spans of SAMPLED requests, merged into
         # gatekeeper_tpu_stage_duration_seconds engine-side
         self._stages: dict[str, list] = {}
+        # shm-ring path counts ("ring" forwarded as a descriptor,
+        # "inline" fell back) -> gatekeeper_tpu_backplane_ring_total
+        self._ring: dict[str, int] = {}
 
     def observe(self, seconds: float) -> None:
         with self._lock:
@@ -1048,9 +1478,14 @@ class _StatsAccumulator:
         with self._lock:
             self._errors += 1
 
+    def ring_path(self, path: str) -> None:
+        with self._lock:
+            self._ring[path] = self._ring.get(path, 0) + 1
+
     def drain(self, worker: str) -> Optional[dict]:
         with self._lock:
-            if not self._n and not self._errors and not self._stages:
+            if not self._n and not self._errors and not self._stages \
+                    and not self._ring:
                 return None
             out = {"worker": worker, "buckets": self._counts,
                    "sum": round(self._sum, 6), "count": self._n,
@@ -1061,6 +1496,9 @@ class _StatsAccumulator:
                             "sum": round(ent[1], 6), "count": ent[2]}
                     for stage, ent in self._stages.items()}
                 self._stages = {}
+            if self._ring:
+                out["ring_paths"] = self._ring
+                self._ring = {}
             self._counts = [0] * (len(STATS_BUCKETS) + 1)
             self._sum = 0.0
             self._n = 0
@@ -1092,6 +1530,11 @@ class FrontendServer:
         self.default_timeout = default_timeout
         self.worker_id = worker_id
         self.stats = _StatsAccumulator()
+        # the client reports ring-path usage and sampled ring_write
+        # durations into this frontend's stats accumulator (both ride
+        # the S-frame deltas to the engine's registry)
+        client.stats_hook = self.stats.ring_path
+        client.stage_hook = self.stats.observe_stage
         self.http = FastHTTPServer((addr, port), self._dispatch,
                                    reuse_port=reuse_port,
                                    certfile=certfile, keyfile=keyfile)
@@ -1206,6 +1649,9 @@ class FrontendServer:
                 stats = {"worker": self.worker_id}
             stats["inflight"] = inflight
             self._last_inflight = inflight
+            fill = getattr(self.client, "ring_fill", lambda: None)()
+            if fill is not None:
+                stats["ring_fill"] = round(fill, 4)
             self.client.send_stats(stats)
 
     def stop(self, drain_timeout: float = 10.0) -> None:
@@ -1239,9 +1685,13 @@ class FrontendSupervisor:
                  mutation_fail_closed: Optional[bool] = None,
                  default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S,
                  ready_timeout: float = 30.0,
-                 trace_sample_rate: float = 0.0):
+                 trace_sample_rate: float = 0.0,
+                 shm_ring_mb: float = 8.0):
         self.n = n
         self.trace_sample_rate = trace_sample_rate
+        # shared-memory ring size per frontend (MB); 0 disables the
+        # rings and every review rides inline frames
+        self.shm_ring_mb = shm_ring_mb
         # one socket (single engine) or a list (the N-engine plane:
         # each frontend connects to every engine and routes)
         if not isinstance(socket_path, str):
@@ -1271,7 +1721,21 @@ class FrontendSupervisor:
         self._stopping = threading.Event()
         self._monitor: Optional[threading.Thread] = None
 
+    def _ring_prefix(self, k: int) -> str:
+        # deterministic per worker SLOT (not per child pid): the
+        # supervisor can sweep a SIGKILLed child's stale segments
+        # before handing the name to its replacement
+        return f"gk-bp-{os.getpid()}-w{k}"
+
+    def _sweep_rings(self, k: int) -> None:
+        prefix = self._ring_prefix(k)
+        shm.sweep_stale(prefix)
+        # router mode: one ring pair per engine connection
+        for i in range(len(self.socket_path.split(","))):
+            shm.sweep_stale(f"{prefix}-e{i}")
+
     def _spawn(self, k: int) -> subprocess.Popen:
+        self._sweep_rings(k)
         cmd = [sys.executable, "-m", "gatekeeper_tpu.control.backplane",
                "--socket", self.socket_path,
                "--port", str(self.port),
@@ -1279,7 +1743,9 @@ class FrontendSupervisor:
                "--worker-id", str(k),
                "--serve", ",".join(self.serve),
                "--default-timeout", str(self.default_timeout),
-               "--trace-sample-rate", str(self.trace_sample_rate)]
+               "--trace-sample-rate", str(self.trace_sample_rate),
+               "--shm-ring-mb", str(self.shm_ring_mb),
+               "--shm-ring-name", self._ring_prefix(k)]
         if self.certfile:
             cmd += ["--certfile", self.certfile]
             if self.keyfile:
@@ -1387,6 +1853,10 @@ class FrontendSupervisor:
         if self._holder is not None:
             self._holder.close()
             self._holder = None
+        # a gracefully-exited frontend unlinked its own rings; sweep
+        # anyway so a kill -9'd child cannot leak /dev/shm segments
+        for k in range(self.n):
+            self._sweep_rings(k)
 
 
 # ------------------------------------------------------ engine supervisor
@@ -1673,15 +2143,30 @@ def frontend_main(argv=None) -> int:
                    help="fraction of requests traced at this edge "
                         "(stride-sampled; an inbound sampled "
                         "traceparent always traces)")
+    p.add_argument("--shm-ring-mb", type=float, default=0.0,
+                   help="shared-memory ring size (MB) for the zero-"
+                        "copy backplane: review bytes ride a per-"
+                        "frontend /dev/shm ring and the socket carries "
+                        "descriptors only. 0 = inline payload frames")
+    p.add_argument("--shm-ring-name", default="",
+                   help="ring segment name prefix (the supervisor "
+                        "passes a per-worker-slot name it can sweep "
+                        "after a kill -9)")
     p.add_argument("--no-reuse-port", action="store_true")
     args = p.parse_args(argv)
     # the frontend is a sampling edge only — span context forwards to
     # the engine, which owns the recorder/metrics sinks
     gtrace.TRACER.configure(args.trace_sample_rate)
     sockets = [s for s in args.socket.split(",") if s]
-    client = (BackplaneClient(sockets[0], worker_id=args.worker_id)
+    ring_prefix = args.shm_ring_name \
+        or f"gk-bp-{os.getpid()}-{args.worker_id}"
+    client = (BackplaneClient(sockets[0], worker_id=args.worker_id,
+                              ring_mb=args.shm_ring_mb,
+                              ring_prefix=ring_prefix)
               if len(sockets) == 1 else
-              BackplaneRouter(sockets, worker_id=args.worker_id))
+              BackplaneRouter(sockets, worker_id=args.worker_id,
+                              ring_mb=args.shm_ring_mb,
+                              ring_prefix=ring_prefix))
     server = FrontendServer(
         client, port=args.port, addr=args.addr,
         certfile=args.certfile or None, keyfile=args.keyfile or None,

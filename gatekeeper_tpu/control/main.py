@@ -209,6 +209,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "0 = one engine per visible device. Values > 1 "
                         "imply the backplane even with "
                         "--admission-workers 1")
+    p.add_argument("--admission-shm-ring-mb", type=float, default=8.0,
+                   help="shared-memory ring size (MB) per admission "
+                        "frontend: review bytes ride a /dev/shm ring "
+                        "and the backplane socket carries (offset, "
+                        "length) descriptors only — zero payload "
+                        "copies across the backplane on the happy "
+                        "path, with automatic inline-frame fallback "
+                        "when a burst outruns the engine. 0 disables "
+                        "the rings (inline payload frames as before)")
+    p.add_argument("--ingest-grpc", action="store_true",
+                   help="serve the bulk gRPC/HTTP2 streaming ingest "
+                        "endpoint (gatekeeper.v1.Policy ReviewStream/"
+                        "ReviewBatch, evaluation-only surface) on "
+                        "--ingest-port: CI scanners and service-mesh "
+                        "authorizers pipeline pre-batched reviews "
+                        "straight into the micro-batcher, skipping "
+                        "HTTP/1.1 framing entirely")
+    p.add_argument("--ingest-port", type=int, default=50061,
+                   help="port for the --ingest-grpc streaming ingest "
+                        "listener")
     p.add_argument("--admission-decision-cache", type=int, default=4096,
                    help="entries in the generation-keyed admission "
                         "decision cache (identical retries and object "
@@ -684,13 +704,37 @@ class Runtime:
                     mutation_fail_closed=mut_fail_closed,
                     default_timeout=default_timeout,
                     trace_sample_rate=getattr(args, "trace_sample_rate",
-                                              0.01))
+                                              0.01),
+                    shm_ring_mb=getattr(args, "admission_shm_ring_mb",
+                                        8.0))
             else:
                 self.webhook = WebhookServer(
                     validation, ns_label, port=args.port,
                     certfile=certfile, keyfile=keyfile,
                     reuse_port=getattr(args, "webhook_reuse_port", False),
                     mutation=mutation, preview=self.preview_engine)
+        # bulk gRPC/HTTP2 streaming ingest (--ingest-grpc): the
+        # service/ layer's evaluation-only surface over THIS process's
+        # client, so streamed batches share the library, caches, and
+        # device programs with the admission plane
+        self.ingest_server = None
+        if getattr(args, "ingest_grpc", False):
+            try:
+                from ..service import INGEST_METHODS, make_server
+
+                self.ingest_server, ingest_port = make_server(
+                    client=self.opa,
+                    address="0.0.0.0:%d" % getattr(args, "ingest_port",
+                                                   50061),
+                    expose=INGEST_METHODS)
+                log.info("grpc streaming ingest configured",
+                         details={"port": ingest_port})
+            except Exception as e:
+                # a missing grpcio / occupied port degrades the ingest
+                # endpoint, never the admission plane
+                log.warning("grpc streaming ingest unavailable",
+                            details=str(e))
+                self.ingest_server = None
         preview_port = getattr(args, "preview_port", 0) or 0
         if preview_port and self.preview_engine is not None:
             # dedicated plaintext preview listener: audit-only pods
@@ -1089,6 +1133,14 @@ class Runtime:
             metrics.report_admission_workers(
                 self.backplane.configured_workers,
                 self.backplane.connected)
+        if self.ingest_server is not None:
+            try:
+                self.ingest_server.start()
+                log.info("grpc streaming ingest serving")
+            except Exception as e:
+                log.warning("grpc streaming ingest failed to start",
+                            details=str(e))
+                self.ingest_server = None
         if self.snapshots is not None:
             self.snapshots.start()
         if self.slo is not None:
@@ -1126,6 +1178,11 @@ class Runtime:
             self.elector.stop()
         if self.webhook:
             self.webhook.stop()
+        if self.ingest_server is not None:
+            try:
+                self.ingest_server.stop(grace=2.0).wait(timeout=10)
+            except Exception:
+                pass
         if self.preview_server is not None:
             self.preview_server.stop(drain_timeout=1.0)
         if self.backplane is not None:

@@ -737,6 +737,10 @@ ENGINE_RELAY_METRICS = (
     # child that received them relays the merged result up
     "gatekeeper_tpu_backplane_forward_duration_seconds",
     "gatekeeper_tpu_backplane_errors_total",
+    # shm-ring path counts (counter) + per-frontend request-ring fill
+    # (gauge, merged by last value like the other saturation gauges)
+    "gatekeeper_tpu_backplane_ring_total",
+    "gatekeeper_tpu_backplane_ring_fill_ratio",
 )
 
 
@@ -772,6 +776,30 @@ def report_backplane_error(worker: str, n: int = 1) -> None:
         "gatekeeper_tpu_backplane_errors_total",
         "Reviews a frontend answered per the failure stance because the "
         "engine backplane was unreachable", n, worker=worker)
+
+
+def report_backplane_ring(worker: str, path: str, n: int = 1) -> None:
+    """Shared-memory ring usage per forwarded review: path=ring means
+    the review crossed the backplane as a descriptor (zero payload
+    copies on the socket), path=inline means it fell back to the
+    payload frame (ring exhausted by a burst, oversized review, or the
+    engine declined the attach). A rising inline share under load is
+    the 'grow --admission-shm-ring-mb' signal."""
+    REGISTRY.counter_add(
+        "gatekeeper_tpu_backplane_ring_total",
+        "Backplane forwards by payload path (ring descriptor vs inline "
+        "fallback)", n, worker=worker, path=path)
+
+
+def report_ring_fill(worker: str, fill: float) -> None:
+    """Sampled used fraction of one frontend's request ring (shipped
+    in its S-frame stats; zeroed when the frontend's connection dies).
+    Sustained values near the allocation watermark mean bursts are
+    spilling to the inline path."""
+    REGISTRY.gauge_set(
+        "gatekeeper_tpu_backplane_ring_fill_ratio",
+        "Used fraction of a frontend's request ring (sampled per stats "
+        "interval)", min(1.0, max(0.0, fill)), worker=worker)
 
 
 _BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}

@@ -217,6 +217,62 @@ class MicroBatcher:
         # up in _sealed instead
         self._pending = 0
 
+    def submit_many(self, reviews: list, timeout: float = 60.0,
+                    deadline: Optional[float] = None) -> list:
+        """Bulk enqueue (streaming ingest): every review joins the
+        queue under ONE lock pass and one collector wake-up, so a
+        whole B-frame batch seals together instead of trickling in
+        submit-by-submit. Returns one entry per review — the results
+        list, or an AdmissionShed / AdmissionDeadline / evaluation
+        Exception INSTANCE for that review (bulk callers need every
+        verdict, so per-item failures never raise out)."""
+        now = time.monotonic()
+        dl = deadline if deadline is not None else now + timeout
+        entries: list = []
+        with self._cv:
+            stopping = self._stop.is_set()
+            for review in reviews:
+                if stopping:
+                    entries.append(AdmissionShed(
+                        "admission batcher is shutting down"))
+                    continue
+                if self.max_queue and self._pending >= self.max_queue:
+                    self.shed += 1
+                    metrics.report_admission_shed()
+                    entries.append(AdmissionShed(
+                        f"admission queue full ({self.max_queue} "
+                        "pending)"))
+                    continue
+                p = _Pending(review, dl)
+                p.t_submit = now
+                self._pending += 1
+                self._queue.append(p)
+                entries.append(p)
+            if self._queue:
+                self._cv.notify()
+        outs: list = []
+        for p in entries:
+            if not isinstance(p, _Pending):
+                outs.append(p)  # shed at enqueue
+                continue
+            if not p.done.wait(max(0.0, dl - time.monotonic())):
+                with self._cv:
+                    try:
+                        self._queue.remove(p)
+                        self._pending -= 1
+                    except ValueError:
+                        pass  # already sealed / mid-flush
+                self.timeouts += 1
+                metrics.report_batch_timeout()
+                outs.append(AdmissionDeadline(
+                    "admission deadline expired before the micro-batch "
+                    "verdict"))
+            elif p.error is not None:
+                outs.append(p.error)
+            else:
+                outs.append(p.results)
+        return outs
+
     def submit(self, review: dict, timeout: float = 60.0,
                deadline: Optional[float] = None, trace=None) -> list:
         """Enqueue and wait for the batched verdict. `deadline` is an
@@ -649,56 +705,158 @@ class ValidationHandler:
         flight recorder after the fact."""
         t0 = time.time()
         request = admission_review.get("request") or {}
-        uid = request.get("uid") or ""
         if deadline is None:
             deadline = request_deadline(request, self.default_timeout)
-        status = None
         try:
             response = self._decide(request, deadline, fast=fast,
                                     trace=trace)
         except NeedsEvaluation:
             return None
-        except AdmissionShed as e:
-            status = "shed"
-            response = {"allowed": not self.fail_closed,
-                        "status": {"code": 429, "message": str(e)}}
-        except AdmissionDeadline as e:
+        except Exception as e:
+            return self._failure(admission_review, request, e, t0,
+                                 trace)
+        return self._complete(admission_review, request, response, t0,
+                              trace)
+
+    def handle_bulk(self, reviews: list, deadline: float) -> list:
+        """STREAMING ingest: many pre-parsed AdmissionReviews in, one
+        response envelope (dict) per review out, in order — the
+        backplane B-frame path for CI scanners and bulk authorizers.
+
+        One prelude pass per review (short-circuits, decision cache,
+        target mapping), then everything that needs evaluation joins
+        the shared MicroBatcher in ONE submit_many enqueue, so a bulk
+        batch seals together with whatever the HTTP frontends have in
+        flight. Per-review failures map to the failure stance exactly
+        as on the HTTP path; this method never raises per review."""
+        outs: list = [None] * len(reviews)
+        pend: list = []
+        for i, ar in enumerate(reviews):
+            if not isinstance(ar, dict):
+                ar = {}
+            t0 = time.time()
+            request = ar.get("request") or {}
+            try:
+                pre = self._prelude(request)
+            except Exception as e:
+                outs[i] = self._failure(ar, request, e, t0)
+                continue
+            if pre.response is not None:
+                outs[i] = self._complete(ar, request, pre.response, t0)
+            elif pre.want_trace:
+                # traced requests keep their per-request path
+                outs[i] = self.handle(ar, deadline=deadline)
+            else:
+                pend.append((i, ar, request, pre, t0))
+        if pend:
+            results = self.batcher.submit_many(
+                [entry[3].gk_review for entry in pend],
+                deadline=deadline)
+            for (i, ar, request, pre, t0), res in zip(pend, results):
+                if isinstance(res, Exception):
+                    outs[i] = self._failure(ar, request, res, t0)
+                    continue
+                try:
+                    response = self._finish(request, pre, res)
+                    outs[i] = self._complete(ar, request, response, t0)
+                except Exception as e:
+                    outs[i] = self._failure(ar, request, e, t0)
+        return outs
+
+    # outcome mapping shared by handle() and handle_bulk() ------------
+
+    def _failure(self, admission_review: dict, request: dict, e,
+                 t0: float, trace=gtrace.NOOP) -> dict:
+        if isinstance(e, AdmissionShed):
+            status, code = "shed", 429
+        elif isinstance(e, AdmissionDeadline):
             # answer per the failure stance BEFORE the API server's own
             # timeout fires — the caller gets our verdict, not a
             # connection error it has to map through failurePolicy
-            status = "timeout"
-            response = {"allowed": not self.fail_closed,
-                        "status": {"code": 504, "message": str(e)}}
-        except Exception as e:
+            status, code = "timeout", 504
+        else:
             log.error("admission error", details=str(e))
-            status = "error"
-            response = {"allowed": not self.fail_closed,
-                        "status": {"code": 500, "message": str(e)}}
+            status, code = "error", 500
+        response = {"allowed": not self.fail_closed,
+                    "status": {"code": code, "message": str(e)}}
+        return self._complete(admission_review, request, response, t0,
+                              trace, status=status)
+
+    def _complete(self, admission_review: dict, request: dict,
+                  response: dict, t0: float, trace=gtrace.NOOP,
+                  status: Optional[str] = None) -> dict:
         if status is None:
             status = "allow" if response.get("allowed") else "deny"
         metrics.report_request(status, time.time() - t0)
         trace.set_status(status)
-        response["uid"] = uid
+        response["uid"] = request.get("uid") or ""
         return _envelope(admission_review, response)
+
+    # decision pipeline: prelude -> evaluate -> finish ----------------
+
+    class _Prelim:
+        __slots__ = ("response", "gk_review", "cache_key", "want_trace",
+                     "want_dump", "ns_obj", "review")
+
+        def __init__(self):
+            self.response = None
+            self.gk_review = None
+            self.cache_key = None
+            self.want_trace = False
+            self.want_dump = False
+            self.ns_obj = None
+            self.review = None
 
     def _decide(self, request: dict,
                 deadline: Optional[float] = None,
                 fast: bool = False, trace=gtrace.NOOP) -> dict:
+        pre = self._prelude(request, fast=fast, trace=trace)
+        if pre.response is not None:
+            return pre.response
+        if pre.want_trace:
+            # traced requests bypass the batcher: the trace is per-request
+            # (reference policy.go:290-309)
+            resps = self.opa.review(AugmentedReview(pre.review,
+                                                    pre.ns_obj),
+                                    tracing=True)
+            for name, resp in sorted(resps.by_target.items()):
+                log.info("request trace", target=name,
+                         trace=resp.trace_dump())
+            if pre.want_dump:
+                log.info("state dump", dump=self.opa.dump())
+            results = resps.results()
+        else:
+            results = self.batcher.submit(pre.gk_review,
+                                          deadline=deadline,
+                                          trace=trace)
+        return self._finish(request, pre, results)
+
+    def _prelude(self, request: dict, fast: bool = False,
+                 trace=gtrace.NOOP) -> "_Prelim":
+        """Everything before (possibly blocking) evaluation: short-
+        circuits, gatekeeper-resource validation, DELETE mapping, the
+        namespace sideload, target mapping, and the decision cache.
+        Either `.response` is the finished verdict or `.gk_review` is
+        ready for the batcher."""
+        pre = self._Prelim()
         username = (request.get("userInfo") or {}).get("username")
         t_dec0 = time.monotonic() if trace.sampled else 0.0
         if username == SERVICE_ACCOUNT:
-            return {"allowed": True}
+            pre.response = {"allowed": True}
+            return pre
         kind = request.get("kind") or {}
         group = kind.get("group") or ""
         if group in (TEMPLATE_GROUP, CONSTRAINT_GROUP):
-            return self._validate_gatekeeper_resource(request, group)
+            pre.response = self._validate_gatekeeper_resource(request,
+                                                              group)
+            return pre
         review = dict(request)
         if (request.get("operation") == "DELETE"
                 and not request.get("object")
                 and request.get("oldObject") is not None):
             # evaluate what is being deleted (policy.go:126-141)
             review["object"] = request.get("oldObject")
-        ns_obj = None
+        pre.review = review
         ns_name = request.get("namespace")
         if ns_name and self.kube is not None:
             if fast:
@@ -707,33 +865,37 @@ class ValidationHandler:
                 # lift this)
                 raise NeedsEvaluation()
             try:
-                ns_obj = self.kube.get(("", "v1", "Namespace"), ns_name)
+                pre.ns_obj = self.kube.get(("", "v1", "Namespace"),
+                                           ns_name)
             except NotFound:
-                ns_obj = None
+                pre.ns_obj = None
         handled, gk_review = self.opa.targets[
             "admission.k8s.gatekeeper.sh"].handle_review(
-                AugmentedReview(review, ns_obj))
+                AugmentedReview(review, pre.ns_obj))
         if not handled:
-            return {"allowed": True}
-        want_trace, want_dump = trace_enabled(
+            pre.response = {"allowed": True}
+            return pre
+        pre.gk_review = gk_review
+        pre.want_trace, pre.want_dump = trace_enabled(
             self.traces_provider(), username,
             (group, kind.get("version") or "", kind.get("kind") or ""))
-        cache_key = None
-        if self.cache is not None and not want_trace:
+        if self.cache is not None and not pre.want_trace:
             # generation read BEFORE evaluation: a library update racing
             # the eval stores the old verdict under the old generation,
             # which no future lookup consults
-            cache_key = (DecisionCache.request_key(request),
-                         self.opa.generation,
-                         DecisionCache.ns_key(ns_obj))
-            cached = self.cache.get(cache_key)
+            pre.cache_key = (DecisionCache.request_key(request),
+                             self.opa.generation,
+                             DecisionCache.ns_key(pre.ns_obj))
+            cached = self.cache.get(pre.cache_key)
             if cached is not None and (cached.get("allowed")
                                        or not self.log_denies):
                 metrics.report_decision_cache("hit")
                 if trace.sampled:
-                    trace.add_span("cache_hit", t_dec0, time.monotonic())
+                    trace.add_span("cache_hit", t_dec0,
+                                   time.monotonic())
                 # shallow copy: the caller patches uid into the response
-                return dict(cached)
+                pre.response = dict(cached)
+                return pre
             if fast:
                 raise NeedsEvaluation()  # miss reported by the re-issue
             metrics.report_decision_cache("miss")
@@ -743,20 +905,11 @@ class ValidationHandler:
             metrics.report_decision_cache("bypass")
         if fast:
             raise NeedsEvaluation()  # cache disabled: evaluation ahead
-        if want_trace:
-            # traced requests bypass the batcher: the trace is per-request
-            # (reference policy.go:290-309)
-            resps = self.opa.review(AugmentedReview(review, ns_obj),
-                                    tracing=True)
-            for name, resp in sorted(resps.by_target.items()):
-                log.info("request trace", target=name,
-                         trace=resp.trace_dump())
-            if want_dump:
-                log.info("state dump", dump=self.opa.dump())
-            results = resps.results()
-        else:
-            results = self.batcher.submit(gk_review, deadline=deadline,
-                                          trace=trace)
+        return pre
+
+    def _finish(self, request: dict, pre: "_Prelim",
+                results: list) -> dict:
+        username = (request.get("userInfo") or {}).get("username")
         denies = []
         warns = []
         for r in results:
@@ -789,11 +942,12 @@ class ValidationHandler:
             response = {"allowed": True}
         if warns:
             response["warnings"] = sorted(warns)
-        if cache_key is not None and (not self.log_denies or not results):
+        if pre.cache_key is not None and (not self.log_denies
+                                          or not results):
             # under --log-denies a cached answer must not swallow audit
             # log lines: only violation-FREE responses are cached (deny,
             # warn, and dryrun results all log per request)
-            self.cache.put(cache_key, dict(response))
+            self.cache.put(pre.cache_key, dict(response))
         return response
 
     def _validate_gatekeeper_resource(self, request: dict,
@@ -1150,7 +1304,29 @@ class FastHTTPServer:
                 "Content-Length: %d\r\n%s%s\r\n"
                 % (status, _HTTP_REASONS.get(status, "OK"), len(payload),
                    extra, "Connection: close\r\n" if close else ""))
-        conn.sendall(head.encode("ascii") + payload)
+        release = getattr(payload, "release", None)
+        if release is None:
+            conn.sendall(head.encode("ascii") + payload)
+            return
+        # reply-ring payload (control/shm.RingSlice): vectored write
+        # straight from the shared segment, then release the slot back
+        # to the engine's allocator — even when the client vanished
+        try:
+            mv = payload.mv
+            try:
+                # ssl.SSLSocket raises NotImplementedError (not
+                # AttributeError) for sendmsg — TLS copies into its
+                # encryption buffer anyway, so concat there
+                sent = conn.sendmsg((head.encode("ascii"), mv))
+            except (AttributeError, NotImplementedError):
+                conn.sendall(head.encode("ascii") + bytes(mv))
+                return
+            total = len(head) + len(mv)
+            if sent < total:
+                conn.sendall(
+                    memoryview(head.encode("ascii") + bytes(mv))[sent:])
+        finally:
+            release()
 
     def inflight(self) -> int:
         with self._inflight_lock:

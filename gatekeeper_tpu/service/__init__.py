@@ -7,13 +7,20 @@ server.py for the wire contract and the rationale for JSON payloads.
 """
 
 from .client import RemoteClient, RemoteTransportError
-from .server import SERVICE_NAME, PolicyService, make_server, serve
+from .server import (
+    INGEST_METHODS,
+    SERVICE_NAME,
+    PolicyService,
+    make_server,
+    serve,
+)
 
 __all__ = [
     "RemoteClient",
     "RemoteTransportError",
     "PolicyService",
     "SERVICE_NAME",
+    "INGEST_METHODS",
     "make_server",
     "serve",
 ]

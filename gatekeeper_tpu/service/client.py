@@ -178,6 +178,43 @@ class RemoteClient:
         return [_responses_from_wire(r)
                 for r in self._rpc("ReviewBatch", req)["responses"]]
 
+    def review_stream(self, batches, tracing: bool = False):
+        """STREAMING ingest: iterate over batches (each a list of
+        review objects) and yield one list[Responses] per batch, in
+        order, over a single pipelined HTTP/2 stream — no per-RPC
+        round trip between batches. A per-batch server error raises
+        the mapped ClientError for THAT batch when its result is
+        consumed; the stream itself stays usable only up to the raise
+        (iterate defensively for scan workloads)."""
+        call = self._call.get("ReviewStream")
+        if call is None:
+            call = self._channel.stream_stream(
+                f"/{SERVICE_NAME}/ReviewStream",
+                request_serializer=_dumps,
+                response_deserializer=_loads,
+            )
+            self._call["ReviewStream"] = call
+
+        def requests():
+            for objs in batches:
+                req = {"reviews": [_review_to_wire(o) for o in objs]}
+                if tracing:
+                    req["tracing"] = True
+                yield req
+
+        try:
+            for resp in call(requests()):
+                err = resp.get("error")
+                if err:
+                    cls = _ERRORS.get(err.get("error"), ClientError)
+                    if cls is UnrecognizedConstraintError:
+                        raise cls(err.get("kind") or "?")
+                    raise cls(err.get("message") or "stream batch failed")
+                yield [_responses_from_wire(r)
+                       for r in resp.get("responses") or []]
+        except grpc.RpcError as e:
+            _raise_remote(e)
+
     def audit(self, tracing: bool = False) -> Responses:
         req = {"tracing": True} if tracing else {}
         return _responses_from_wire(self._rpc("Audit", req))

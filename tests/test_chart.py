@@ -137,3 +137,29 @@ def test_chart_streaming_and_preview_values_reach_deployments():
     assert all(p.get("name") != "preview" for p in ac["ports"])
     assert "--stream-audit=False" in ac["args"]
     assert "--audit-incremental=True" in ac["args"]
+
+
+def test_chart_ring_and_ingest_values_reach_webhook_deployment():
+    vals = default_values()
+    vals["admission"]["shmRingMb"] = 16
+    vals["ingest"]["port"] = 51000
+    docs = [d for d in yaml.safe_load_all(render(vals)) if d is not None]
+    wc = {d["metadata"]["name"]: d for d in docs
+          if d["kind"] == "Deployment"}["gatekeeper-controller-manager"][
+        "spec"]["template"]["spec"]["containers"][0]
+    assert "--admission-shm-ring-mb=16" in wc["args"]
+    assert "--ingest-grpc" in wc["args"]
+    assert "--ingest-port=51000" in wc["args"]
+    assert any(p.get("name") == "grpc-ingest"
+               and p["containerPort"] == 51000 for p in wc["ports"])
+    # disabling the ingest endpoint drops BOTH the flags and the port
+    # (no invalid containerPort, no dangling --ingest-grpc)
+    vals["ingest"]["enabled"] = False
+    docs = [d for d in yaml.safe_load_all(render(vals)) if d is not None]
+    wc = {d["metadata"]["name"]: d for d in docs
+          if d["kind"] == "Deployment"}["gatekeeper-controller-manager"][
+        "spec"]["template"]["spec"]["containers"][0]
+    assert "--ingest-grpc" not in wc["args"]
+    assert all(p.get("name") != "grpc-ingest" for p in wc["ports"])
+    # rings stay on independently of the ingest endpoint
+    assert "--admission-shm-ring-mb=16" in wc["args"]

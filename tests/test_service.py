@@ -82,6 +82,86 @@ def test_review_batch_rpc(remote):
             assert msgs == ['missing: {"owner"}']
 
 
+def test_review_stream_pipelined_batches(remote):
+    """Streaming ingest (ISSUE 14): batches pipeline over ONE
+    bidirectional stream, responses come back per batch in order, and
+    a bad batch answers an in-stream error without killing the
+    stream's earlier results."""
+    remote.add_template(TEMPLATE)
+    remote.add_constraint(CONSTRAINT)
+    batches = [
+        [AugmentedUnstructured(ns("a0")),
+         AugmentedUnstructured(ns("a1", {"owner": "x"}))],
+        [AugmentedUnstructured(ns("b0", {"owner": "y"}))],
+        [AugmentedUnstructured(ns("c0"))],
+    ]
+    out = list(remote.review_stream(batches))
+    assert len(out) == 3
+    assert [[len(r.results()) for r in b] for b in out] == \
+        [[1, 0], [0], [1]]
+    assert out[0][0].results()[0].msg == 'missing: {"owner"}'
+
+
+def test_review_stream_bad_batch_survives_on_the_wire(remote):
+    """A malformed batch answers an in-stream {"error": ...} message;
+    the batches before AND after it still evaluate — one bad manifest
+    must not kill a million-manifest scan's stream."""
+    import grpc as grpc_mod  # noqa: F401 - importorskip'd above
+
+    from gatekeeper_tpu.service.server import (
+        SERVICE_NAME,
+        _dumps,
+        _loads,
+    )
+
+    remote.add_template(TEMPLATE)
+    remote.add_constraint(CONSTRAINT)
+    call = remote._channel.stream_stream(
+        f"/{SERVICE_NAME}/ReviewStream",
+        request_serializer=_dumps, response_deserializer=_loads)
+    msgs = [
+        {"reviews": [{"object": ns("ok", {"owner": "x"})}]},
+        {"reviews": [{"bogus": 1}]},  # no object/admissionRequest/raw
+        {"reviews": [{"object": ns("bad")}]},
+    ]
+    out = list(call(iter(msgs)))
+    assert len(out) == 3
+    assert "responses" in out[0]
+    assert out[1].get("error", {}).get("error") == "ClientError"
+    # the stream SURVIVED the bad batch and kept evaluating
+    results = out[2]["responses"][0]["byTarget"][
+        "admission.k8s.gatekeeper.sh"]["results"]
+    assert len(results) == 1
+
+
+def test_ingest_surface_excludes_library_lifecycle():
+    """--ingest-grpc serves the evaluation-only method set: bulk
+    callers can stream reviews but can never rewrite the serving
+    library through the ingest port."""
+    from gatekeeper_tpu.client import Backend, RegoDriver
+    from gatekeeper_tpu.service import INGEST_METHODS
+    from gatekeeper_tpu.service.client import RemoteTransportError
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    client = Backend(RegoDriver()).new_client([K8sValidationTarget()])
+    client.add_template(TEMPLATE)
+    client.add_constraint(CONSTRAINT)
+    server, port = make_server(client=client, expose=INGEST_METHODS)
+    server.start()
+    rc = RemoteClient(f"127.0.0.1:{port}")
+    try:
+        out = list(rc.review_stream(
+            [[AugmentedUnstructured(ns("x"))]]))
+        assert len(out) == 1 and len(out[0][0].results()) == 1
+        with pytest.raises(RemoteTransportError):
+            rc.add_template(TEMPLATE)
+        with pytest.raises(RemoteTransportError):
+            rc.reset()
+    finally:
+        rc.close()
+        server.stop(grace=None)
+
+
 def test_audit_over_wire(remote):
     remote.add_template(TEMPLATE)
     remote.add_constraint(CONSTRAINT)

@@ -53,6 +53,14 @@ _HIGHER_BETTER = {
     "detection_speedup_p99", "mesh_audit_vs_single_device",
     "compile_widening_speedup", "general_library_compiled_fraction",
     "engine_batched_reviews_per_sec",
+    # serving-plane wire tiers (ISSUE 14): the gRPC batched tier fell
+    # 5,067 (r04, per VERDICT) -> 3,517 (r05) with nothing watching —
+    # these are now first-class gated series so the NEXT wire-path
+    # regression fails --check instead of surfacing in a verdict
+    "grpc_batched_reviews_per_sec",
+    "grpc_stream_reviews_per_sec",
+    "backplane_bulk_reviews_per_sec",
+    "edge_vs_engine_ratio",
 }
 
 # measured but NOT gated by --check: cold-start and first-call numbers
@@ -63,6 +71,21 @@ _NOISY = {
     "first_audit_s", "first_call_s", "cold_first_audit_s",
     "cold_boot_s", "setup_s", "vs_baseline", "mutate_audit_s",
 }
+
+# per-config fields (beyond the headline `value`) lifted into the
+# trajectory as c<N>.<field>: the serving-plane wire tiers live INSIDE
+# config 5's record, not as its headline value, and were invisible to
+# the watchdog (exactly how the gRPC batched-tier regression shipped
+# unflagged in r05). Non-numeric entries ("unavailable: ...") are
+# skipped by the numeric filter, so a tier that failed to run never
+# poisons its series.
+_CONFIG_EXTRA_FIELDS = (
+    "grpc_batched_reviews_per_sec",
+    "grpc_stream_reviews_per_sec",
+    "backplane_bulk_reviews_per_sec",
+    "engine_batched_reviews_per_sec",
+    "edge_vs_engine_ratio",
+)
 
 # top-level headline fields bench.py COPIES out of the side configs —
 # the copy carries no unit string, so a config scale change would
@@ -197,6 +220,9 @@ def flatten_round(doc: dict) -> tuple[dict, dict, dict]:
                 if isinstance(cm, str):
                     put(f"c{cnum}.{cm}", cdoc.get("value"),
                         cdoc.get("unit"))
+                for f in _CONFIG_EXTRA_FIELDS:
+                    if f in cdoc:
+                        put(f"c{cnum}.{f}", cdoc.get(f))
         elif isinstance(v, (int, float)) and not isinstance(v, bool):
             put(k, v)
     if doc.get("error"):
