@@ -1,3 +1,16 @@
 """gatekeeper_tpu: TPU-native Kubernetes admission/audit policy engine."""
 
 __version__ = "0.1.0"
+
+# Lockset tracing (GATEKEEPER_TPU_LOCKTRACE=1) arms HERE, before any
+# submodule import constructs a lock — so spawned engine children and
+# frontend workers (`python -m gatekeeper_tpu.control.engine` / `.
+# control.backplane`), which inherit the env var, trace their locks
+# exactly like the pytest process does. A no-op when unarmed.
+import os as _os
+
+if _os.environ.get("GATEKEEPER_TPU_LOCKTRACE", "") not in ("", "0",
+                                                           "false"):
+    from .utils import locktrace as _locktrace
+
+    _locktrace.install()

@@ -682,9 +682,14 @@ class BackplaneEngine:
         # frontend ships aggregated histograms for the stages it owns
         # (frontend_parse) — the engine's trace sink skips those
         # remote spans so they are counted exactly once
+        from .stages import STAGE_NAMES
         for stage, d in (stats.get("stages") or {}).items():
             n = int(d.get("count") or 0)
-            if n:
+            if n and str(stage) in STAGE_NAMES:
+                # wire-supplied names bounded against the central
+                # stage registry: a version-skewed frontend cannot
+                # mint label series the dashboards don't know
+                # gklint: allow(stage) reason=runtime-folded against control/stages.py STAGE_NAMES on the line above
                 metrics.report_stage_bucketed(
                     "admission", str(stage), d.get("buckets") or [],
                     float(d.get("sum") or 0.0), n)
@@ -2013,6 +2018,13 @@ class EngineSupervisor:
                     if old is not None:
                         old.close()
                     self._prev_stats.pop(k, None)
+                    # the dead child's relayed engine-labeled gauges
+                    # must not export its last depth/duty while it is
+                    # down (respawn's first poll would eventually
+                    # overwrite them — or never, if respawn keeps
+                    # failing)
+                    from . import metrics as _metrics
+                    _metrics.zero_engine_gauges(str(k))
                     try:
                         spawned.append((k, self._spawn(k)))
                     except Exception as e:
@@ -2110,6 +2122,11 @@ class EngineSupervisor:
                 proc.wait(max(0.1, end - time.monotonic()))
             except subprocess.TimeoutExpired:
                 proc.kill()
+        # stopped children's relayed engine-labeled gauges must not
+        # outlive them on the primary's exposition
+        from . import metrics
+        for k in self.engine_ids:
+            metrics.zero_engine_gauges(str(k))
 
 
 # ------------------------------------------------------- frontend process

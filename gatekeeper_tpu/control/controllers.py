@@ -149,14 +149,14 @@ class TemplateController:
             self._handle_delete_by_name(name)
             self._remove_finalizer(obj)
             return
-        t0 = time.time()
+        t0 = time.monotonic()
         try:
             crd = self.opa.create_crd(obj)
             self.opa.add_template(obj)
         except ClientError as e:
             log.error("template ingestion failed", template_name=name,
                       details=str(e))
-            metrics.report_template_ingestion("error", time.time() - t0)
+            metrics.report_template_ingestion("error", time.monotonic() - t0)
             self._write_status(obj, created=False, errors=[str(e)])
             return
         kind = crd["spec"]["names"]["kind"]
@@ -178,7 +178,7 @@ class TemplateController:
             self.kube.register_kind(gvk, namespaced=False)
         self._tracked[name] = gvk
         self.constraint_ctrl.registrar.add_watch(gvk)
-        metrics.report_template_ingestion("ok", time.time() - t0)
+        metrics.report_template_ingestion("ok", time.monotonic() - t0)
         metrics.report_constraint_templates("active", len(self._tracked))
         self._write_status(obj, created=True)
 
@@ -421,7 +421,7 @@ class SyncController:
         kind = obj.get("kind") or ""
         meta = obj.get("metadata") or {}
         uid = f"{kind}/{meta.get('namespace') or ''}/{meta.get('name')}"
-        t0 = time.time()
+        t0 = time.monotonic()
         if event.type == "DELETED":
             try:
                 self.opa.remove_data(obj)
@@ -435,7 +435,7 @@ class SyncController:
             except ClientError as e:
                 log.error("sync failed", resource_kind=kind, details=str(e))
                 return
-        metrics.report_sync_duration(time.time() - t0)
+        metrics.report_sync_duration(time.monotonic() - t0)
         metrics.report_last_sync()
         for k, bucket in self._synced.items():
             metrics.report_sync("active", k, len(bucket))
@@ -486,16 +486,16 @@ class MutatorController:
             log.info("mutator deleted", mutator_kind=kind,
                      mutator_name=name)
             return
-        t0 = time.time()
+        t0 = time.monotonic()
         try:
             mutator, changed = self.system.upsert(obj)
         except MutationError as e:
-            metrics.report_mutator_ingestion("error", time.time() - t0)
+            metrics.report_mutator_ingestion("error", time.monotonic() - t0)
             log.error("mutator ingestion failed", mutator_kind=kind,
                       mutator_name=name, details=str(e))
             self._status(obj, enforced=False, errors=[str(e)])
             return
-        metrics.report_mutator_ingestion("ok", time.time() - t0)
+        metrics.report_mutator_ingestion("ok", time.monotonic() - t0)
         metrics.report_mutators(self.system.counts())
         reason = self.system.conflicts().get(mutator.id)
         self._status(obj, enforced=reason is None,
@@ -574,13 +574,13 @@ class ControllerManager:
         mid-pass — require two consecutive idle passes: a cascade in
         that window leaves its source task unfinished into the second
         pass, or its target queued."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         workers = [self.template_ctrl.worker, self.constraint_ctrl.worker,
                    self.sync_ctrl.worker, self.config_ctrl.worker]
         if self.mutator_ctrl is not None:
             workers.append(self.mutator_ctrl.worker)
         stable = 0
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             if all(w.idle() for w in workers):
                 stable += 1
                 if stable >= 2:

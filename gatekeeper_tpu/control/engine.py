@@ -259,8 +259,24 @@ def main(argv=None) -> int:
     gc.collect()
     gc.freeze()
     print("READY", flush=True)
-    stop.wait()
-    engine.stop()
+    try:
+        stop.wait()
+    finally:
+        engine.stop()
+        # mirror teardown for the probes registered above: a final
+        # stats poll racing shutdown must relay zeros, not the last
+        # burst's depth/duty (the supervisor also zeroes the relayed
+        # series on child death — this covers the graceful path)
+        for probe in ("admission-queue", "mutation-queue",
+                      "engine-duty-cycle"):
+            metrics.unregister_saturation_probe(probe)
+        if validation is not None:
+            metrics.report_queue_depth("admission", 0,
+                                       engine=args.engine_id)
+        if mutation is not None:
+            metrics.report_queue_depth("mutation", 0,
+                                       engine=args.engine_id)
+        metrics.report_duty_cycle(0.0)
     return 0
 
 

@@ -1279,9 +1279,9 @@ def warm_cache_main(argv=None) -> int:
     violations = None
     audit_s = None
     if objects:
-        t0 = time.time()
+        t0 = time.monotonic()
         violations = len(client.audit().results())
-        audit_s = round(time.time() - t0, 2)
+        audit_s = round(time.monotonic() - t0, 2)
     else:
         log.warning("no inventory snapshot to sweep; only ingestion-"
                     "time programs were prepacked — run against a "

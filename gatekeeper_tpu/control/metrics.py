@@ -626,6 +626,20 @@ def report_duty_cycle(duty: float, engine: Optional[str] = None) -> None:
                        or "0")
 
 
+def zero_engine_gauges(engine: str) -> None:
+    """Zero one engine child's relayed engine-labeled saturation
+    gauges (queue depth, duty cycle). The child's own registry dies
+    with its process, but the PRIMARY keeps the last relayed values —
+    a dead engine would export its final (possibly saturated) depth
+    and duty until its respawn's first stats poll, or forever if the
+    respawn keeps failing. The EngineSupervisor calls this on death
+    detection and at stop (the PR 13 stale-export discipline applied
+    to the relay path)."""
+    for queue in ("admission", "mutation", "backplane_engine"):
+        report_queue_depth(queue, 0, engine=str(engine))
+    report_duty_cycle(0.0, engine=str(engine))
+
+
 def report_stream_pending(pending: int) -> None:
     """Streaming-audit backlog: tracker dirty keys buffered ahead of
     the next flush (refreshed per flush AND per scrape) — growth here
@@ -675,11 +689,36 @@ def report_admission_shed(n: int = 1) -> None:
                          "micro-batch queue", n)
 
 
+# bounded label-value sets: every reporter that takes a label value
+# from a caller folds unknowns to a stable bucket, so a bug (or a
+# version-skewed peer over the wire) can never mint an unbounded
+# series set — the registry never forgets a label set. Enforced
+# statically by gklint's metrics_hygiene checker.
+DECISION_CACHE_OUTCOMES = ("hit", "miss", "bypass")
+RING_PATHS = ("ring", "inline")
+KUBE_WRITE_OUTCOMES = ("ok", "retried_ok", "failed", "breaker_open",
+                       "budget_exhausted", "not_leader")
+INGESTION_STATUSES = ("ok", "error", "active")
+DEMOTION_REASONS = ("audit-eval", "review-eval", "join-eval",
+                    "lowering", "join-lowering")
+COMPILE_OUTCOMES = ("ok", "error")
+AUDIT_SWEEP_PATHS = ("incremental", "full_resync", "full", "stream")
+MATERIALIZE_PATHS = ("vectorized", "exact", "capped")
+CACHE_OUTCOMES = ("hit", "miss")
+STREAM_FLUSH_OUTCOMES = ("ok", "error", "skipped")
+PREVIEW_OUTCOMES = ("ok", "error", "invalid")
+SNAPSHOT_OUTCOMES = ("ok", "error", "missing", "fallback")
+
+LABEL_FOLD = "other"
+
+
 def report_decision_cache(outcome: str, n: int = 1) -> None:
     """One admission decision-cache consultation: hit (verdict served
     without evaluation), miss (evaluated and cached), or bypass (the
     request is uncacheable — traced, or a deny under --log-denies where
     every denial must re-log)."""
+    if outcome not in DECISION_CACHE_OUTCOMES:
+        outcome = LABEL_FOLD
     REGISTRY.counter_add("gatekeeper_tpu_admission_decision_cache_total",
                          "Admission decision cache lookups by outcome",
                          n, outcome=outcome)
@@ -785,6 +824,8 @@ def report_backplane_ring(worker: str, path: str, n: int = 1) -> None:
     payload frame (ring exhausted by a burst, oversized review, or the
     engine declined the attach). A rising inline share under load is
     the 'grow --admission-shm-ring-mb' signal."""
+    if path not in RING_PATHS:
+        path = LABEL_FOLD
     REGISTRY.counter_add(
         "gatekeeper_tpu_backplane_ring_total",
         "Backplane forwards by payload path (ring descriptor vs inline "
@@ -822,6 +863,8 @@ def report_kube_write(outcome: str) -> None:
     """One guarded kube write by outcome: ok, retried_ok, failed,
     breaker_open (refused locally), budget_exhausted (retry budget
     empty)."""
+    if outcome not in KUBE_WRITE_OUTCOMES:
+        outcome = LABEL_FOLD
     REGISTRY.counter_add("gatekeeper_tpu_kube_writes_total",
                          "Guarded kube API writes by outcome",
                          outcome=outcome)
@@ -850,6 +893,8 @@ def report_mutation_request(admission_status: str, seconds: float) -> None:
 
 
 def report_mutator_ingestion(status: str, seconds: float) -> None:
+    if status not in INGESTION_STATUSES:
+        status = LABEL_FOLD
     REGISTRY.counter_add("mutator_ingestion_count",
                          "Count of mutator ingestion actions by outcome",
                          status=status)
@@ -877,18 +922,24 @@ def report_constraints(action: str, count: int) -> None:
 
 
 def report_constraint_templates(status: str, count: int) -> None:
+    if status not in INGESTION_STATUSES:
+        status = LABEL_FOLD
     REGISTRY.gauge_set("constraint_templates",
                        "Number of observed constraint templates", count,
                        status=status)
 
 
 def report_template_ingestion(status: str, seconds: float) -> None:
+    if status not in INGESTION_STATUSES:
+        status = LABEL_FOLD
     REGISTRY.observe("constraint_template_ingestion_duration_seconds",
                      "Latency of constraint template ingestion", seconds,
                      status=status)
 
 
 def report_sync(status: str, kind: str, count: int) -> None:
+    if status not in INGESTION_STATUSES:
+        status = LABEL_FOLD
     REGISTRY.gauge_set("sync", "Total number of resources replicated into "
                        "OPA", count, status=status, kind=kind)
 
@@ -909,6 +960,7 @@ def report_compile_fallback(kind: str, reason: str) -> None:
     REASON_CODES — a bounded set, never free prose). Operators read
     this next to /debug/templates' per-kind fallback detail to see WHY
     a kind audits at Python speed instead of the device path."""
+    # gklint: allow(metrics) reason=reason is Uncompilable.code, folded to the bounded REASON_CODES set in ir/compile.py (unknown->internal)
     REGISTRY.counter_add("gatekeeper_tpu_compile_fallback_total",
                          "Template kinds that fell back to the "
                          "interpreter at ingestion, by Uncompilable "
@@ -916,6 +968,8 @@ def report_compile_fallback(kind: str, reason: str) -> None:
 
 
 def report_device_demotion(kind: str, reason: str) -> None:
+    if reason not in DEMOTION_REASONS:
+        reason = LABEL_FOLD
     REGISTRY.counter_add("gatekeeper_tpu_device_demotions_total",
                          "Templates demoted from the device path to the "
                          "interpreter (a ~10^4x per-eval slowdown; should "
@@ -937,6 +991,8 @@ def report_compile(source: str, outcome: str, seconds: float) -> None:
     """One device-program acquisition: source "aot" (deserialized from
     the AOT program store), "cache" (lower+compile answered by the
     persistent XLA cache), or "fresh" (cold XLA compile)."""
+    if outcome not in COMPILE_OUTCOMES:
+        outcome = LABEL_FOLD
     REGISTRY.counter_add("gatekeeper_tpu_compile_total",
                          "Device program acquisitions by source "
                          "(aot=deserialized executable, cache=persistent-"
@@ -972,6 +1028,8 @@ def report_audit_sweep(path: str) -> None:
     inventory), "full_resync" (the periodic from-scratch re-encode
     backstop), or "full" (discovery / cache sweep without delta
     tracking)."""
+    if path not in AUDIT_SWEEP_PATHS:
+        path = LABEL_FOLD
     REGISTRY.counter_add("gatekeeper_tpu_audit_sweeps_total",
                          "Audit sweeps by evaluation path", path=path)
 
@@ -982,6 +1040,8 @@ def report_materialize_pairs(path: str, n: int) -> None:
     "exact" (per-pair evaluator — plan-less kinds and vetoed pairs),
     "capped" (past the per-constraint status cap: counted, message
     skipped)."""
+    if path not in MATERIALIZE_PATHS:
+        path = LABEL_FOLD
     if n > 0:
         REGISTRY.counter_add("gatekeeper_tpu_audit_materialize_pairs_total",
                              "Materialized firing pairs by message path",
@@ -991,6 +1051,8 @@ def report_materialize_pairs(path: str, n: int) -> None:
 def report_msg_template_cache(outcome: str) -> None:
     """Message-plan cache lookup for one materialize batch: "hit" (plan
     reused), "miss" (plan compiled from the template head this call)."""
+    if outcome not in CACHE_OUTCOMES:
+        outcome = LABEL_FOLD
     REGISTRY.counter_add("gatekeeper_tpu_audit_msg_template_cache_total",
                          "Message-template plan cache lookups",
                          outcome=outcome)
@@ -1038,6 +1100,8 @@ def report_stream_flush(outcome: str, n: int = 1) -> None:
     """One streaming-audit flush by outcome: ok (evaluated + statuses
     current), error (evaluation or write failed; the interval backstop
     repairs), or skipped (follower replica drained without writing)."""
+    if outcome not in STREAM_FLUSH_OUTCOMES:
+        outcome = LABEL_FOLD
     REGISTRY.counter_add("gatekeeper_tpu_stream_flushes_total",
                          "Streaming-audit dirty-row flushes by outcome",
                          n, outcome=outcome)
@@ -1058,6 +1122,8 @@ def report_backstop_drift(writes: int) -> None:
 def report_preview(outcome: str, seconds: float) -> None:
     """One what-if preview evaluation (candidate template/constraint
     swept against the full encoded inventory) by outcome."""
+    if outcome not in PREVIEW_OUTCOMES:
+        outcome = LABEL_FOLD
     REGISTRY.counter_add("gatekeeper_tpu_preview_requests_total",
                          "What-if preview evaluations by outcome",
                          outcome=outcome)
@@ -1086,6 +1152,8 @@ def report_snapshot(op: str, outcome: str) -> None:
     (no snapshot; plain cold start) | fallback (snapshot present but
     corrupt/stale/unusable — the pod proceeds down the cold path, never
     crash-loops)."""
+    if outcome not in SNAPSHOT_OUTCOMES:
+        outcome = LABEL_FOLD
     name = ("gatekeeper_tpu_snapshot_save_total" if op == "save"
             else "gatekeeper_tpu_snapshot_restore_total")
     REGISTRY.counter_add(name, f"State snapshot {op}s by outcome",

@@ -161,7 +161,7 @@ class PreviewEngine:
            "template": {...},              # optional (else: ingested)
            "limit": 20}                    # sample cap
         """
-        t0 = time.time()
+        t0 = time.monotonic()
         constraint = payload.get("constraint")
         if not isinstance(constraint, dict):
             raise PreviewError('payload needs a "constraint" object')
@@ -222,7 +222,7 @@ class PreviewEngine:
                 else:
                     results = self._interp_eval(ent["alias"], [alias_con])
                     path = "interp"
-        dt = time.time() - t0
+        dt = time.monotonic() - t0
         metrics.report_preview("ok", dt)
         out = {
             "kind": kind,

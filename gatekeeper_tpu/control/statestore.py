@@ -226,6 +226,7 @@ class StateStore:
                 header.get("schema") != SCHEMA_VERSION:
             raise SnapshotError(
                 f"schema {header.get('schema') if isinstance(header, dict) else header!r} != {SCHEMA_VERSION}")
+        # gklint: allow(clock) reason=created is a persisted epoch from another process lifetime; monotonic cannot span it
         age = time.time() - float(header.get("created") or 0)
         if self.max_age_s and age > self.max_age_s:
             raise SnapshotError(f"snapshot stale ({age:.0f}s old)")
@@ -275,6 +276,7 @@ class StateStore:
         try:
             with open(self.path(section), "rb") as fp:
                 head = fp.readline()
+            # gklint: allow(clock) reason=persisted epoch stamp from a prior process lifetime; wall clock is the only shared base
             return time.time() - float(json.loads(head).get("created") or 0)
         except Exception:
             return None
@@ -359,7 +361,7 @@ class SnapshotManager:
                 saved += one("vocab", self.providers["vocab"],
                              self.store.save)
         if saved:
-            self.last_saved = time.time()
+            self.last_saved = time.monotonic()
             metrics.report_snapshot_age(0.0)
             log.info("state snapshot saved",
                      details={"sections": saved, "dir": self.store.dir})
@@ -376,7 +378,8 @@ class SnapshotManager:
             except Exception as e:  # the snapshot loop must never die
                 log.error("snapshot pass failed", details=str(e))
             if self.last_saved is not None:
-                metrics.report_snapshot_age(time.time() - self.last_saved)
+                metrics.report_snapshot_age(
+                    time.monotonic() - self.last_saved)
 
 
 def restore_section(store: StateStore, section: str,

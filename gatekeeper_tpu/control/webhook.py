@@ -703,7 +703,7 @@ class ValidationHandler:
         and the OUTCOME — allow/deny/shed/timeout/error — lands on the
         trace either way, so shed storms are diagnosable from the
         flight recorder after the fact."""
-        t0 = time.time()
+        t0 = time.monotonic()
         request = admission_review.get("request") or {}
         if deadline is None:
             deadline = request_deadline(request, self.default_timeout)
@@ -734,7 +734,7 @@ class ValidationHandler:
         for i, ar in enumerate(reviews):
             if not isinstance(ar, dict):
                 ar = {}
-            t0 = time.time()
+            t0 = time.monotonic()
             request = ar.get("request") or {}
             try:
                 pre = self._prelude(request)
@@ -787,7 +787,7 @@ class ValidationHandler:
                   status: Optional[str] = None) -> dict:
         if status is None:
             status = "allow" if response.get("allowed") else "deny"
-        metrics.report_request(status, time.time() - t0)
+        metrics.report_request(status, time.monotonic() - t0)
         trace.set_status(status)
         response["uid"] = request.get("uid") or ""
         return _envelope(admission_review, response)
@@ -1036,7 +1036,7 @@ class MutationHandler:
     def handle(self, admission_review: dict,
                deadline: Optional[float] = None,
                trace=gtrace.NOOP) -> dict:
-        t0 = time.time()
+        t0 = time.monotonic()
         request = admission_review.get("request") or {}
         uid = request.get("uid") or ""
         if deadline is None:
@@ -1057,7 +1057,7 @@ class MutationHandler:
             status = "error"
             response = {"allowed": not self.fail_closed,
                         "status": {"code": 500, "message": str(e)}}
-        metrics.report_mutation_request(status, time.time() - t0)
+        metrics.report_mutation_request(status, time.monotonic() - t0)
         trace.set_status(status)
         response["uid"] = uid
         return _envelope(admission_review, response)

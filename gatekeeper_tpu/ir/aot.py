@@ -576,13 +576,13 @@ class AotJit:
         with self._lock:
             if asig in self._compiled:
                 return True
-        t0 = time.time()
+        t0 = time.monotonic()
         comp = store.load(key)
         if comp is None:
             return False
         with self._lock:
             self._compiled.setdefault(asig, comp)
-        store.note("aot", time.time() - t0, kind=self._kind,
+        store.note("aot", time.monotonic() - t0, kind=self._kind,
                    tag=self._tag, key=self._static + (asig,))
         return True
 
@@ -617,10 +617,10 @@ class AotJit:
         store = self._store
         key = store.entry_key(self._fingerprint, self._tag,
                               self._static, asig)
-        t0 = time.time()
+        t0 = time.monotonic()
         comp = store.load(key)
         if comp is not None:
-            store.note("aot", time.time() - t0, kind=self._kind,
+            store.note("aot", time.monotonic() - t0, kind=self._kind,
                        tag=self._tag, key=self._static + (asig,))
             with self._lock:
                 self._compiled.setdefault(asig, comp)
@@ -632,16 +632,16 @@ class AotJit:
             # the serving thread on XLA
             raise WouldCompile(self._kind, self._tag)
         hits0 = xla_cache_hits()
-        t0 = time.time()
+        t0 = time.monotonic()
         try:
             with profiling.timers().phase("compile"):
                 comp = self._jit.lower(*args).compile()
         except Exception as e:
-            store.note("fresh", time.time() - t0, kind=self._kind,
+            store.note("fresh", time.monotonic() - t0, kind=self._kind,
                        tag=self._tag, key=self._static + (asig,),
                        outcome="error")
             raise e
-        dt = time.time() - t0
+        dt = time.monotonic() - t0
         source = "cache" if xla_cache_hits() > hits0 else "fresh"
         store.note(source, dt, kind=self._kind, tag=self._tag,
                    key=self._static + (asig,))
@@ -664,7 +664,7 @@ class AotJit:
         CLI so serving boots never have to."""
         import jax
 
-        t0 = time.time()
+        t0 = time.monotonic()
         try:
             # two process-wide caches would silently hand the same
             # unserializable executable back: jax memoizes (a) its
@@ -689,7 +689,7 @@ class AotJit:
             log.warning("durable recompile for %s/%s failed: %s: %s",
                         self._kind, self._tag, type(e).__name__, e)
             return None
-        store.note("fresh", time.time() - t0, kind=self._kind,
+        store.note("fresh", time.monotonic() - t0, kind=self._kind,
                    tag=self._tag, key=self._static + (asig,))
         store.save(key, comp, self._fingerprint, self._tag,
                    self._static, asig)

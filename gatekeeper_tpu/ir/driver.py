@@ -1460,7 +1460,7 @@ class TpuDriver(RegoDriver):
         def run():
             import time as _time
 
-            t0 = _time.time()
+            t0 = _time.monotonic()
             try:
                 with self._warm_sem:
                     run_fn()
@@ -1470,7 +1470,7 @@ class TpuDriver(RegoDriver):
                     self.aot.record_sig(fingerprint, sig)
                 log.info("device program for %s warm after %.1fs "
                          "(%s); next audit hot-swaps off the host "
-                         "path", kind, _time.time() - t0,
+                         "path", kind, _time.monotonic() - t0,
                          what or "sweep")
             except Exception as e:
                 # do NOT demote from here: the warm sweep runs
@@ -1584,7 +1584,7 @@ class TpuDriver(RegoDriver):
                 self._audit_used_mesh = True
             self.note_eval(kind, "device")
             return ("h", mask, cand, cand_reviews, handle, c_dev,
-                    _time.time())
+                    _time.monotonic())
         except DriverError:
             raise
         except Exception as e:
@@ -1762,13 +1762,13 @@ class TpuDriver(RegoDriver):
             it = iter(labeled()) if labeled is not None \
                 else iter(handle.pairs())
             while True:
-                t0 = _time.time()
+                t0 = _time.monotonic()
                 try:
                     item = next(it)
                 except StopIteration:
-                    t_dev += _time.time() - t0
+                    t_dev += _time.monotonic() - t0
                     break
-                t_dev += _time.time() - t0
+                t_dev += _time.monotonic() - t0
                 shard = None
                 if labeled is not None:
                     shard, rows, cols = item
@@ -1784,16 +1784,16 @@ class TpuDriver(RegoDriver):
                     if not getattr(self, "_lat_sampled", True):
                         self._lat_sampled = True
                         self._observe("_dev_batch_lat_s",
-                                      _time.time() - t_dispatch)
+                                      _time.monotonic() - t_dispatch)
                     first_sync = False
-                t0 = _time.time()
+                t0 = _time.monotonic()
                 rows, cols = _expand_parameterless(rows, cols, c_dev,
                                                    len(cons))
                 keep = mask[cand[rows], cols]
                 res = self.materialize_pairs(
                     target, cons, cand_reviews, rows[keep], cols[keep],
                     inventory, cand=cand)
-                dt = _time.time() - t0
+                dt = _time.monotonic() - t0
                 t_mat += dt
                 if shard is None:
                     out.extend(res)
@@ -2037,7 +2037,7 @@ class TpuDriver(RegoDriver):
         mask = self._match_mask(target, kind, cons, reviews, lookup_ns,
                                 sig_cache)
         n_masked = 0
-        t0 = _time.time()
+        t0 = _time.monotonic()
         for r, review in enumerate(reviews):
             for c, constraint in enumerate(cons):
                 if not mask[r, c]:
@@ -2049,7 +2049,7 @@ class TpuDriver(RegoDriver):
                 out.extend(self._eval_template_violations(
                     target, constraint, review, enforcement, inventory, trace))
         # feed the cost model in its own units (masked pairs per second)
-        el = _time.time() - t0
+        el = _time.monotonic() - t0
         if el > 0:
             profiling.timers().add("interp_eval", el)
             self.note_busy(el)
@@ -2353,15 +2353,30 @@ class TpuDriver(RegoDriver):
             rows = np.concatenate(
                 [rows, np.broadcast_to(rows[m - 1:m],
                                        (pad,) + rows.shape[1:])])
-        fn = getattr(self, "_rows_update_fn", None)
-        if fn is None:
+        fns = getattr(self, "_rows_update_fns", None)
+        if fns is None:
+            from .aot import AotJit
+
             def upd(d, r, p):
                 return d.at[p].set(r)
-            fn = self._rows_update_fn = jax.jit(upd)
+            # rides the AOT store like every other ir/ program (the
+            # fingerprint is a constant: the program text is fixed, so
+            # identity is its version tag + the arg signature). One
+            # wrapper PER LAYOUT: arg_sig ignores sharding, so the
+            # single-device and mesh-sharded resident copies — same
+            # shapes — would otherwise collide on one executable key
+            # and permanently bounce the loser to the plain jit.
+            fns = self._rows_update_fns = tuple(
+                AotJit(upd, store=self.aot,
+                       fingerprint="rows-update-v1",
+                       tag="rows_update", static=(layout,),
+                       kind="__rows_update__")
+                for layout in ("single", "mesh"))
         if hit:
-            self._dev_cache[id(arr)] = (ent[0], fn(ent[1], rows, pos))
+            self._dev_cache[id(arr)] = (ent[0],
+                                        fns[0](ent[1], rows, pos))
         if mhit:
-            d = fn(ment[1], rows, pos)
+            d = fns[1](ment[1], rows, pos)
             if d.sharding != ment[1].sharding:
                 d = jax.device_put(d, ment[1].sharding)
             self._dev_mesh_cache[(id(arr), True)] = (ment[0], d)
@@ -2497,7 +2512,7 @@ class TpuDriver(RegoDriver):
                 raise _ServeHostThisRound()
         # latency EMA measured from DISPATCH (post-warm): folding a
         # compile wait into it would steer batches to the host for ages
-        t0 = _time.time()
+        t0 = _time.monotonic()
         handle = self._dispatch_guarded(sig, ct, feats, enc, table,
                                         derived, len(cand_reviews),
                                         use_mesh, n_cons)
@@ -2519,7 +2534,7 @@ class TpuDriver(RegoDriver):
         first = True
         for rows, cols in handle.pairs():
             if first:
-                self._observe("_dev_batch_lat_s", _time.time() - t0)
+                self._observe("_dev_batch_lat_s", _time.monotonic() - t0)
                 first = False
             rows, cols = _expand_parameterless(rows, cols, c_dev,
                                                len(cons))
@@ -2640,12 +2655,12 @@ class TpuDriver(RegoDriver):
                         pairs = self._review_batch_sparse(
                             ct, kind, cand, cand_reviews, cons, mask)
                     else:
-                        t0 = _time.time()
+                        t0 = _time.monotonic()
                         fires = self._eval_compiled_gated(ct, kind,
                                                           cand_reviews,
                                                           cons)
                         self._observe("_dev_batch_lat_s",
-                                      _time.time() - t0)
+                                      _time.monotonic() - t0)
                         hits = np.logical_and(fires, mask[cand])
                         pairs = [(int(cand[ri]), int(ci))
                                  for ri, ci in zip(*np.nonzero(hits))]
@@ -2658,7 +2673,7 @@ class TpuDriver(RegoDriver):
             if pairs is None:
                 pairs = [(r, c) for r in range(len(reviews))
                          for c in range(len(cons)) if mask[r, c]]
-                t0 = _time.time()
+                t0 = _time.monotonic()
             else:
                 t0 = None
             for r, ci in pairs:
@@ -2673,7 +2688,7 @@ class TpuDriver(RegoDriver):
                     touched.setdefault(r, set()).add(id(constraint))
                     acc.setdefault((r, id(constraint)), []).extend(res)
             if t0 is not None and pairs:
-                host_s = _time.time() - t0
+                host_s = _time.monotonic() - t0
                 if host_s > 0:
                     self._observe("_host_pair_rate", len(pairs) / host_s)
         # assemble per review over only the POPULATED constraints (the

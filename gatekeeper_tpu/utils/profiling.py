@@ -70,11 +70,11 @@ class PhaseTimers:
 
     @contextlib.contextmanager
     def phase(self, name: str):
-        t0 = time.time()
+        t0 = time.monotonic()
         try:
             yield
         finally:
-            self.add(name, time.time() - t0)
+            self.add(name, time.monotonic() - t0)
 
     def add(self, name: str, seconds: float, n: int = 1) -> None:
         """Accumulate an externally-timed interval (slab pipelines time

@@ -28,6 +28,15 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 # flip driver.async_warm back on)
 os.environ.setdefault("GATEKEEPER_TPU_ASYNC_COMPILE", "0")
 
+# lockset tracer (GATEKEEPER_TPU_LOCKTRACE=1): must install BEFORE any
+# serving module constructs a lock, so the chaos/concurrency suites run
+# with every Lock/RLock traced for order inversions and cycles — the
+# runtime companion to tools/gklint's static no-block checker. A no-op
+# unless the env var arms it (the CI locktrace job does).
+from gatekeeper_tpu.utils import locktrace  # noqa: E402
+
+locktrace.install()
+
 # a sitecustomize hook (PYTHONPATH site injection) may have imported jax at
 # interpreter startup and captured JAX_PLATFORMS from the outer environment
 # (e.g. a remote-TPU plugin); the env assignments above are then too late.
@@ -58,3 +67,28 @@ requires_reference = pytest.mark.skipif(
     not reference_available(),
     reason="reference corpus not mounted at /root/reference",
 )
+
+
+@pytest.fixture(autouse=True)
+def _dump_stacks_on_wedge(request):
+    """All-thread stack dumps for wedged tests.
+
+    The chaos/concurrency/serving suites each run under a hard
+    per-test SIGALRM (their module-level PER_TEST_TIMEOUT_S): an
+    injected hang fails that test fast — but the alarm handler only
+    shows the MAIN thread's stack, and the wedged thread (a stuck
+    flusher, a deadlocked pair) is exactly the one not shown. This
+    arms faulthandler.dump_traceback_later one second BEFORE the
+    alarm, so a timeout failure ships every thread's stack to stderr
+    first — the runtime companion to gklint's deadlock checkers."""
+    import faulthandler
+
+    timeout = getattr(request.module, "PER_TEST_TIMEOUT_S", None)
+    if not timeout or timeout <= 2:
+        yield
+        return
+    faulthandler.dump_traceback_later(timeout - 1, exit=False)
+    try:
+        yield
+    finally:
+        faulthandler.cancel_dump_traceback_later()

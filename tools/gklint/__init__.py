@@ -1,0 +1,33 @@
+"""gklint — repo-invariant static analysis for gatekeeper_tpu.
+
+One checker module per invariant class the repo's review history keeps
+re-fixing by hand (see ISSUE 15 / CHANGES.md):
+
+  * ``block_zone``       — blocking operations reachable from declared
+                           no-block entry points (frame reader, batch
+                           seal loop, scrape probes)
+  * ``gauge_teardown``   — lifecycle-bound SET gauges must zero (or
+                           unregister their probe) on a teardown path
+                           in the same class
+  * ``clock_discipline`` — ``time.time()`` / naive ``datetime.now()``
+                           forbidden in duration/deadline arithmetic
+  * ``metrics_hygiene``  — ``_total`` counters, ``_seconds``
+                           histograms, no interpolated label values,
+                           bounded reason/outcome label sets
+  * ``jit_discipline``   — every ``jax.jit`` in ``ir/`` rides AotJit;
+                           trace-stage literals must be declared in
+                           ``control/stages.py``
+
+Run as ``python -m tools.gklint`` (report) or ``--check`` (CI gate
+against the committed ``gklint_baseline.json`` ratchet: new findings
+fail, and so do stale suppressions — fixed findings must shrink the
+baseline in the same PR).
+
+Escape hatch, on the finding's line or the line above::
+
+    # gklint: allow(block-zone) reason=why this is safe
+
+The reason is mandatory; a reasonless allow is itself a finding.
+"""
+
+from .core import Finding, Project, load_baseline, run_checkers  # noqa: F401

@@ -1,0 +1,136 @@
+"""CLI: python -m tools.gklint [--check | --write-baseline | ...]
+
+Modes:
+  (default)          print findings not covered by the baseline
+  --check            CI gate: exit 1 on new findings OR stale
+                     suppressions (the two-way ratchet)
+  --write-baseline   regenerate gklint_baseline.json from the current
+                     tree (review the diff — shrinking is progress,
+                     growing needs a reason)
+  --all              print every finding, baselined or not
+  --stages-md        render the README stage table from
+                     control/stages.py and exit
+  --locktrace-report FILE
+                     gate on a locktrace JSONL dump (utils/locktrace
+                     written by GATEKEEPER_TPU_LOCKTRACE=1 runs):
+                     exit 1 on lock-order cycles / inversions;
+                     held-across-blocking events print as advisory
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import Project, load_baseline, ratchet, run_checkers, \
+    write_baseline
+
+
+def _repo_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def locktrace_gate(report_path: str) -> int:
+    """Read a locktrace JSONL dump (one finding per line, possibly
+    appended by several processes) and fail on cycles/inversions."""
+    if not os.path.exists(report_path):
+        print(f"gklint: no locktrace dump at {report_path} "
+              "(no traced process ran, or none found anything)")
+        return 0
+    bad = advisory = 0
+    with open(report_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ent = json.loads(line)
+            except ValueError:
+                continue
+            kind = ent.get("kind")
+            if kind in ("cycle", "inversion"):
+                bad += 1
+                print(f"LOCKTRACE {kind}: {ent.get('detail')}")
+            elif kind == "held_across_blocking":
+                # advisory: a bounded sleep under a lock is a smell,
+                # not a deadlock — report, never gate
+                advisory += 1
+                print(f"LOCKTRACE advisory held-across-blocking: "
+                      f"{ent.get('detail')}")
+    if bad:
+        print(f"gklint: {bad} locktrace cycle/inversion finding(s) — "
+              "potential deadlock under the chaos suite")
+        return 1
+    print(f"gklint: locktrace clean ({advisory} advisory "
+          "held-across-blocking event(s))")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="gklint")
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--stages-md", action="store_true")
+    ap.add_argument("--locktrace-report", metavar="FILE")
+    ap.add_argument("--root", default=_repo_root())
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default <root>/"
+                         "gklint_baseline.json)")
+    args = ap.parse_args(argv)
+
+    if args.locktrace_report:
+        return locktrace_gate(args.locktrace_report)
+
+    if args.stages_md:
+        import runpy
+
+        mod = runpy.run_path(os.path.join(
+            args.root, "gatekeeper_tpu/control/stages.py"))
+        print(mod["stages_markdown"]())
+        return 0
+
+    baseline_path = args.baseline or os.path.join(
+        args.root, "gklint_baseline.json")
+    project = Project(args.root)
+    findings = run_checkers(project)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"gklint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    if args.all:
+        for f in findings:
+            print(f.render())
+        print(f"gklint: {len(findings)} finding(s) "
+              f"({len(project.files)} files analyzed)")
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    new, stale = ratchet(findings, baseline)
+    for line in new:
+        print(f"NEW: {line}")
+    if args.check:
+        for line in stale:
+            print(f"STALE SUPPRESSION: {line}")
+    if new or (args.check and stale):
+        if new:
+            print(f"gklint: {len(new)} new finding(s) — fix them or "
+                  "allow() them with a reason")
+        if args.check and stale:
+            print(f"gklint: {len(stale)} stale suppression(s) — the "
+                  "findings are fixed, shrink gklint_baseline.json "
+                  "(python -m tools.gklint --write-baseline)")
+        return 1
+    print(f"gklint: clean ({len(findings)} baselined finding(s), "
+          f"{len(project.files)} files analyzed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

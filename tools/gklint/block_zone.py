@@ -1,0 +1,234 @@
+"""block_zone — blocking operations reachable from no-block entry points.
+
+The serving plane has a handful of loops whose stall is a whole-plane
+stall: the backplane frame reader (every frontend's requests serialize
+through it), the HTTP connection handler, the micro-batch seal loop,
+and the /metrics scrape probes. The invariant — re-fixed by hand in
+PRs 3, 13, and 14 — is that no unbounded blocking operation (sleep,
+subprocess, kube I/O, inline XLA compile, device sync, foreign waits)
+may be reachable from them.
+
+Each entry point declares its *intrinsic* operation categories (a
+frame reader's own socket recv is its job, not a violation). An allow
+comment on a call site prunes traversal through that edge — used where
+a guard the analyzer cannot see (e.g. ``fast=True`` raising
+``NeedsEvaluation``) makes a path unreachable; the mandatory reason
+documents the guard.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import CallGraph, FuncInfo
+from .core import Finding, Project, dotted
+
+# (qualname, intrinsic categories, description)
+ENTRY_POINTS = [
+    ("gatekeeper_tpu/control/backplane.py::BackplaneEngine._read_loop",
+     {"socket", "lock"},
+     "backplane frame-reader inline path"),
+    ("gatekeeper_tpu/control/webhook.py::FastHTTPServer"
+     "._serve_connection",
+     {"socket", "lock"},
+     "HTTP accept/connection loop"),
+    ("gatekeeper_tpu/control/webhook.py::MicroBatcher._loop",
+     {"lock"},
+     "micro-batch seal path"),
+    ("gatekeeper_tpu/control/metrics.py::run_saturation_probes",
+     {"lock"},
+     "/metrics scrape-time saturation probes"),
+]
+
+REGISTER_PROBE = "register_saturation_probe"
+
+_SOCKET_ATTRS = {"accept", "recv", "recv_into", "recvfrom", "sendall",
+                 "sendmsg", "connect", "connect_ex"}
+_SOCKETISH_RECV = {"send", "read", "readline", "makefile"}
+_SOCKET_HINTS = ("sock", "conn", "rfile", "wfile", "listener")
+_LOCK_HINTS = ("lock", "mutex", "sem", "cv", "cond")
+_THREAD_HINTS = ("thread", "proc", "_t", "worker")
+MAX_DEPTH = 12
+
+
+def _classify_call(call: ast.Call) -> tuple:
+    """(category, op label) for a blocking call, or (None, '')."""
+    name = dotted(call.func)
+    if not name:
+        return None, ""
+    low = name.lower()
+    leaf = name.split(".")[-1]
+    recv = ".".join(name.split(".")[:-1]).lower()
+    if name == "sleep" or name.endswith("time.sleep"):
+        return "sleep", name
+    if low.startswith("subprocess.") or ".subprocess." in low:
+        return "subprocess", name
+    if leaf == "block_until_ready":
+        return "device-sync", name
+    if leaf == "compile" and not call.args and not call.keywords:
+        return "xla-compile", name
+    if ".kube." in f".{low}" or low.startswith("kube."):
+        return "kube", name
+    if leaf in _SOCKET_ATTRS:
+        return "socket", name
+    if leaf in _SOCKETISH_RECV and any(h in recv for h in _SOCKET_HINTS):
+        return "socket", name
+    if leaf == "acquire" and any(h in recv for h in _LOCK_HINTS):
+        for kw in call.keywords:
+            if kw.arg == "blocking" and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is False:
+                return None, ""
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is False:
+            return None, ""
+        return "lock", name
+    if leaf == "wait":
+        if any(h in recv for h in _LOCK_HINTS):
+            return "lock", name
+        return "wait", name
+    if leaf == "join" and any(h in recv for h in _THREAD_HINTS):
+        return "wait", name
+    return None, ""
+
+
+def _scan_function(project: Project, graph: CallGraph, entry_label: str,
+                   intrinsic: set, fn: FuncInfo, chain: list,
+                   visited: set, findings: list) -> None:
+    if fn.qual in visited or len(chain) > MAX_DEPTH:
+        return
+    visited.add(fn.qual)
+    sf = project.files[fn.path]
+    nested: set = set()
+    for sub in ast.walk(fn.node):
+        if sub is not fn.node and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            for inner in ast.walk(sub):
+                nested.add(inner)
+    # `with <lock>:` blocks
+    for sub in ast.walk(fn.node):
+        if sub in nested or not isinstance(sub, (ast.With, ast.AsyncWith)):
+            continue
+        for item in sub.items:
+            name = dotted(item.context_expr)
+            if any(h in name.lower() for h in _LOCK_HINTS):
+                if "lock" in intrinsic or sf.allowed(sub.lineno,
+                                                     "block_zone"):
+                    continue
+                findings.append(Finding(
+                    "block_zone", fn.path, sub.lineno,
+                    f"{entry_label}->{_short(fn)}",
+                    f"lock:{name}",
+                    f"`with {name}` reachable from no-block entry "
+                    f"{entry_label} (via {' -> '.join(chain)})"))
+    for call in graph.calls_in(fn):
+        cat, op = _classify_call(call)
+        if cat is not None and cat not in intrinsic \
+                and not sf.allowed(call.lineno, "block_zone"):
+            findings.append(Finding(
+                "block_zone", fn.path, call.lineno,
+                f"{entry_label}->{_short(fn)}",
+                f"{cat}:{op}",
+                f"blocking op `{op}` ({cat}) reachable from no-block "
+                f"entry {entry_label} (via {' -> '.join(chain)})"))
+        callee = graph.resolve_call(fn, call)
+        if callee is not None and not sf.allowed(call.lineno,
+                                                 "block_zone"):
+            target = graph.funcs[callee]
+            _scan_function(project, graph, entry_label, intrinsic,
+                           target, chain + [_short(target)], visited,
+                           findings)
+
+
+def _short(fn: FuncInfo) -> str:
+    return f"{fn.cls}.{fn.name}" if fn.cls else fn.name
+
+
+def _probe_entries(project: Project, graph: CallGraph):
+    """Callables registered as saturation probes become entry points
+    themselves: they run inline on every /metrics scrape."""
+    for path, sf in project.files.items():
+        for fn in graph.funcs.values():
+            if fn.path != path:
+                continue
+            for call in graph.calls_in(fn):
+                name = dotted(call.func)
+                if not name.endswith(REGISTER_PROBE) or len(call.args) < 2:
+                    continue
+                arg = call.args[1]
+                if isinstance(arg, ast.Lambda):
+                    pseudo = FuncInfo(
+                        f"{fn.qual}.<probe-lambda@{arg.lineno}>",
+                        path, _LambdaShim(arg), fn.cls)
+                    yield pseudo, f"probe@{_short(fn)}"
+                elif isinstance(arg, ast.Name):
+                    # nested def registered by name
+                    for sub in ast.walk(fn.node):
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)) \
+                                and sub.name == arg.id:
+                            pseudo = FuncInfo(
+                                f"{fn.qual}.{sub.name}", path, sub,
+                                fn.cls)
+                            yield pseudo, f"probe@{_short(fn)}"
+                elif isinstance(arg, ast.Attribute):
+                    q = graph.resolve_call(
+                        fn, ast.Call(func=arg, args=[], keywords=[]))
+                    if q is not None:
+                        yield graph.funcs[q], f"probe@{_short(fn)}"
+
+
+class _LambdaShim:
+    """Duck-typed FunctionDef stand-in so calls_in/ast.walk work on a
+    lambda body."""
+
+    def __init__(self, lam: ast.Lambda):
+        self.name = f"<lambda@{lam.lineno}>"
+        self.body = [ast.Expr(value=lam.body)]
+        self._lam = lam
+
+    def __getattr__(self, item):
+        return getattr(self._lam, item)
+
+
+# ast.walk needs iter_child_nodes to work on the shim: walk the lambda
+def _walk_shim(node):
+    return ast.walk(node._lam if isinstance(node, _LambdaShim) else node)
+
+
+def check(project: Project) -> list[Finding]:
+    graph = CallGraph(project)
+    findings: list[Finding] = []
+    entries = []
+    for qual, intrinsic, label in ENTRY_POINTS:
+        fn = graph.funcs.get(qual)
+        if fn is None:
+            findings.append(Finding(
+                "block_zone", qual.split("::")[0], 1, qual,
+                "missing-entry",
+                f"declared no-block entry point {qual} not found — "
+                "update tools/gklint/block_zone.py ENTRY_POINTS"))
+            continue
+        entries.append((fn, label, set(intrinsic)))
+    for fn, label in _probe_entries(project, graph):
+        entries.append((fn, label, {"lock"}))
+    for fn, label, intrinsic in entries:
+        node = fn.node
+        if isinstance(node, _LambdaShim):
+            # direct ops only for lambdas (their receivers are bound
+            # defaults the graph can't type)
+            sf = project.files[fn.path]
+            for sub in _walk_shim(node):
+                if isinstance(sub, ast.Call):
+                    cat, op = _classify_call(sub)
+                    if cat is not None and cat not in intrinsic \
+                            and not sf.allowed(sub.lineno, "block_zone"):
+                        findings.append(Finding(
+                            "block_zone", fn.path, sub.lineno,
+                            label, f"{cat}:{op}",
+                            f"blocking op `{op}` ({cat}) in scrape "
+                            f"probe lambda"))
+            continue
+        _scan_function(project, graph, label, intrinsic, fn,
+                       [_short(fn)], set(), findings)
+    return findings

@@ -1,0 +1,246 @@
+"""Name-resolution call graph over the analyzed package.
+
+Deliberately conservative: an edge is added only when the callee
+resolves — ``self.m()`` to a method of the same class (or a repo base
+class), bare names to same-module functions or ``from x import name``
+imports, ``mod.fn()`` through module imports, and ``self.attr.m()``
+through ``self.attr = ClassName(...)`` assignments seen anywhere in
+the class. Unresolvable calls are silently not followed (the checkers
+flag *operations*, so an unfollowed edge can only under-report, never
+false-positive).
+
+Callables passed as arguments (``Thread(target=fn)``,
+``pool.submit(fn)``) are NOT edges: they run on another thread, which
+is exactly what the no-block checker must not conflate with the
+caller's inline path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Project, dotted
+
+
+class FuncInfo:
+    __slots__ = ("qual", "path", "node", "cls", "name")
+
+    def __init__(self, qual: str, path: str, node, cls: Optional[str]):
+        self.qual = qual          # "path::Class.method" / "path::func"
+        self.path = path
+        self.node = node
+        self.cls = cls
+        self.name = node.name
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.project = project
+        self.funcs: dict[str, FuncInfo] = {}
+        # path -> {local name -> module path or "path::func"} imports
+        self._imports: dict[str, dict[str, str]] = {}
+        # "path::Class" -> {attr -> "path::Class2"} for self.attr = C()
+        self._attr_types: dict[str, dict[str, str]] = {}
+        # "path::Class" -> base "path::Class" chain (single level deep
+        # is enough for this codebase)
+        self._bases: dict[str, list[str]] = {}
+        self._classes: dict[str, ast.ClassDef] = {}
+        for path, sf in project.files.items():
+            self._index_file(path, sf)
+        for path, sf in project.files.items():
+            self._index_attr_types(path, sf)
+
+    # ------------------------------------------------------------ index
+
+    def _mod_path(self, module: str) -> Optional[str]:
+        """'gatekeeper_tpu.control.metrics' -> its repo-relative path."""
+        rel = module.replace(".", "/") + ".py"
+        if rel in self.project.files:
+            return rel
+        rel = module.replace(".", "/") + "/__init__.py"
+        return rel if rel in self.project.files else None
+
+    def _index_file(self, path: str, sf) -> None:
+        imports: dict[str, str] = {}
+        pkg_dir = "/".join(path.split("/")[:-1])
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mp = self._mod_path(a.name)
+                    if mp:
+                        imports[a.asname or a.name.split(".")[0]] = mp
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = path.split("/")[:-1]
+                    if node.level > 1:
+                        base = base[: -(node.level - 1)]
+                    prefix = "/".join(base)
+                else:
+                    prefix = (node.module or "").replace(".", "/")
+                mod = node.module or ""
+                for a in node.names:
+                    # from .mod import name  (module or symbol)
+                    if node.level and mod:
+                        mp = f"{prefix}/{mod.replace('.', '/')}.py"
+                    elif node.level:
+                        mp = f"{prefix}/{a.name}.py"
+                    else:
+                        mp = self._mod_path(mod) or ""
+                    local = a.asname or a.name
+                    if node.level and not mod and mp in self.project.files:
+                        imports[local] = mp  # from . import sibling
+                        continue
+                    if mp in self.project.files:
+                        imports[local] = f"{mp}::{a.name}"
+                    else:
+                        # from .mod import name where mod is the module
+                        mp2 = self._mod_path(
+                            f"{mod}") if not node.level else None
+                        if mp2:
+                            imports[local] = f"{mp2}::{a.name}"
+        self._imports[path] = imports
+        del pkg_dir
+
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{path}::{node.name}"
+                self.funcs[qual] = FuncInfo(qual, path, node, None)
+            elif isinstance(node, ast.ClassDef):
+                cqual = f"{path}::{node.name}"
+                self._classes[cqual] = node
+                bases = []
+                for b in node.bases:
+                    bq = self._resolve_class(path, dotted(b))
+                    if bq:
+                        bases.append(bq)
+                self._bases[cqual] = bases
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        qual = f"{path}::{node.name}.{item.name}"
+                        self.funcs[qual] = FuncInfo(qual, path, item,
+                                                    node.name)
+
+    def _resolve_class(self, path: str, name: str) -> Optional[str]:
+        if not name:
+            return None
+        if "." in name:
+            head, _, tail = name.partition(".")
+            target = self._imports.get(path, {}).get(head)
+            if target and "::" not in target:
+                cq = f"{target}::{tail}"
+                return cq if cq in self._classes else None
+            return None
+        cq = f"{path}::{name}"
+        if cq in self._classes:
+            return cq
+        target = self._imports.get(path, {}).get(name)
+        if target and "::" in target and target in [
+                f"{p}::{c.name}" for p, c in (
+                    (q.split("::")[0], cls)
+                    for q, cls in self._classes.items())]:
+            return target
+        if target and target in self._classes:
+            return target
+        return None
+
+    def _index_attr_types(self, path: str, sf) -> None:
+        for node in sf.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cqual = f"{path}::{node.name}"
+            attrs: dict[str, str] = {}
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign) or len(sub.targets) != 1:
+                    continue
+                tgt = sub.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and isinstance(sub.value, ast.Call)):
+                    continue
+                cls = self._resolve_class(path, dotted(sub.value.func))
+                if cls:
+                    attrs[tgt.attr] = cls
+            if attrs:
+                self._attr_types[cqual] = attrs
+
+    # ---------------------------------------------------------- resolve
+
+    def _method_of(self, cqual: str, name: str) -> Optional[str]:
+        seen = set()
+        stack = [cqual]
+        while stack:
+            cq = stack.pop()
+            if cq in seen:
+                continue
+            seen.add(cq)
+            q = f"{cq.split('::')[0]}::{cq.split('::')[1]}.{name}"
+            if q in self.funcs:
+                return q
+            stack.extend(self._bases.get(cq, ()))
+        return None
+
+    def resolve_call(self, caller: FuncInfo, call: ast.Call
+                     ) -> Optional[str]:
+        """Qualname of the callee, or None when unresolvable."""
+        f = call.func
+        path = caller.path
+        if isinstance(f, ast.Name):
+            q = f"{path}::{f.id}"
+            if q in self.funcs:
+                return q
+            target = self._imports.get(path, {}).get(f.id)
+            if target and "::" in target and target in self.funcs:
+                return target
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        base = f.value
+        if isinstance(base, ast.Name) and base.id == "self" and caller.cls:
+            return self._method_of(f"{path}::{caller.cls}", f.attr)
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self" and caller.cls:
+            cls = self._attr_types.get(
+                f"{path}::{caller.cls}", {}).get(base.attr)
+            if cls:
+                return self._method_of(cls, f.attr)
+            return None
+        if isinstance(base, ast.Name):
+            target = self._imports.get(path, {}).get(base.id)
+            if target and "::" not in target:
+                q = f"{target}::{f.attr}"
+                return q if q in self.funcs else None
+            # local var of a known class: Name assigned from ClassName()
+            cls = self._local_type(caller, base.id)
+            if cls:
+                return self._method_of(cls, f.attr)
+        return None
+
+    def _local_type(self, caller: FuncInfo, name: str) -> Optional[str]:
+        for sub in ast.walk(caller.node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and sub.targets[0].id == name \
+                    and isinstance(sub.value, ast.Call):
+                cls = self._resolve_class(caller.path,
+                                          dotted(sub.value.func))
+                if cls:
+                    return cls
+        return None
+
+    def calls_in(self, fn: FuncInfo):
+        """Call nodes in fn's own body (nested defs excluded — they run
+        when called, on whatever thread calls them)."""
+        nested = set()
+        for sub in ast.walk(fn.node):
+            if sub is not fn.node and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+                for inner in ast.walk(sub):
+                    nested.add(inner)
+        for sub in ast.walk(fn.node):
+            if isinstance(sub, ast.Call) and sub not in nested:
+                yield sub

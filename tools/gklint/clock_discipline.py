@@ -1,0 +1,115 @@
+"""clock_discipline — wall clocks forbidden in duration arithmetic.
+
+``time.time()`` (and naive ``datetime.now()``) jumps under NTP steps
+and leap adjustments; every duration, deadline, or duty-cycle
+computation built on it mis-attributes exactly when the system is
+under stress. PR 13's review pass converted the duty accounting to
+``time.monotonic()`` by hand — this checker makes the conversion
+stick.
+
+Flagged: a wall-clock call participating in +/- arithmetic or an
+ordered comparison, directly or through a variable assigned from one
+inside the same function. Pure timestamp *storage* (log fields,
+epoch stamps persisted for other processes) is not arithmetic and
+passes; genuinely cross-process epoch math (snapshot age) carries an
+allow comment explaining why wall clock is correct there.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, dotted
+
+_ORDERED = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+
+
+def _is_wall_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func)
+    if name.endswith("time.time") or name == "time":
+        # `time.time()` / `_time.time()` / bare `time()` via
+        # `from time import time`
+        return name != "time" or isinstance(node.func, ast.Name)
+    if name.endswith("datetime.now") or name == "now":
+        # naive now(); tz-aware now(tz) is a labeled wall timestamp
+        return not node.args and not node.keywords
+    return False
+
+
+def _functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            yield node
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for path, sf in project.files.items():
+        funcs = list(_functions(sf.tree))
+        in_any_func: set = set()
+        for f in funcs:
+            in_any_func |= set(ast.walk(f)) - {f}
+        scopes = funcs + [sf.tree]
+        seen_lines: set = set()
+        for scope in scopes:
+            own = set(ast.walk(scope))
+            if isinstance(scope, ast.Module):
+                own -= in_any_func  # module scope: top-level only
+            else:
+                for sub in ast.walk(scope):
+                    if sub is not scope and isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                        own -= set(ast.walk(sub)) - {sub}
+            # names assigned (directly) from a wall-clock call
+            wall_names: set = set()
+            for sub in own:
+                if isinstance(sub, ast.Assign) and \
+                        _is_wall_call(sub.value):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            wall_names.add(tgt.id)
+                        elif isinstance(tgt, ast.Attribute):
+                            wall_names.add(f"@{tgt.attr}")
+
+            def _tainted(node) -> bool:
+                if _is_wall_call(node):
+                    return True
+                if isinstance(node, ast.Name):
+                    return node.id in wall_names
+                if isinstance(node, ast.Attribute):
+                    return f"@{node.attr}" in wall_names
+                return False
+
+            for sub in own:
+                operands = []
+                if isinstance(sub, ast.BinOp) and \
+                        isinstance(sub.op, (ast.Add, ast.Sub)):
+                    operands = [sub.left, sub.right]
+                elif isinstance(sub, ast.Compare) and any(
+                        isinstance(op, _ORDERED) for op in sub.ops):
+                    operands = [sub.left] + list(sub.comparators)
+                elif isinstance(sub, ast.AugAssign) and \
+                        isinstance(sub.op, (ast.Add, ast.Sub)):
+                    operands = [sub.value]
+                if not operands:
+                    continue
+                if not any(_tainted(o) for o in operands):
+                    continue
+                line = sub.lineno
+                if line in seen_lines:
+                    continue
+                if sf.allowed(line, "clock_discipline"):
+                    seen_lines.add(line)
+                    continue
+                seen_lines.add(line)
+                findings.append(Finding(
+                    "clock_discipline", path, line, sf.scope_of(sub),
+                    f"wall-arith@{sf.scope_of(sub)}",
+                    "wall-clock value in duration/deadline arithmetic "
+                    "— use time.monotonic() (NTP steps corrupt "
+                    "durations built on time.time())"))
+    return findings

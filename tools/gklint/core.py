@@ -1,0 +1,225 @@
+"""gklint core: source model, allow-comment parsing, findings, baseline.
+
+Finding identity (the baseline key) is ``checker:path:scope:code`` —
+deliberately line-free, so unrelated edits above a pinned finding don't
+churn the ratchet file. Multiple findings may share a key (the baseline
+stores counts); the ratchet compares per-key counts both ways.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+# checker name -> allow-comment code (what goes inside allow(...))
+ALLOW_CODES = {
+    "block_zone": "block-zone",
+    "gauge_teardown": "gauge-teardown",
+    "clock_discipline": "clock",
+    "metrics_hygiene": "metrics",
+    "jit_discipline": "jit",
+    "stage_registry": "stage",
+}
+
+_ALLOW_RE = re.compile(
+    r"#\s*gklint:\s*allow\(([a-z\-,\s]+)\)(?:\s+reason=(.*))?")
+
+
+class Finding:
+    __slots__ = ("checker", "path", "line", "scope", "code", "message")
+
+    def __init__(self, checker: str, path: str, line: int, scope: str,
+                 code: str, message: str):
+        self.checker = checker
+        self.path = path
+        self.line = line
+        self.scope = scope
+        self.code = code
+        self.message = message
+
+    def key(self) -> str:
+        return f"{self.checker}:{self.path}:{self.scope}:{self.code}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.checker}] "
+                f"{self.scope}: {self.message}")
+
+
+class SourceFile:
+    """One parsed module: AST + allow-comment map + parent links."""
+
+    def __init__(self, root: str, path: str):
+        self.path = path  # repo-relative, forward slashes
+        with open(os.path.join(root, path), encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=path)
+        self.parents: dict = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        # line -> set of allowed codes; malformed allows become findings
+        self.allows: dict[int, set] = {}
+        self.allow_errors: list[int] = []
+        for i, line in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(line)
+            if not m:
+                continue
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                self.allow_errors.append(i)
+                continue
+            codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+            self.allows.setdefault(i, set()).update(codes)
+
+    def allowed(self, line: int, checker: str) -> bool:
+        """An allow comment suppresses on its own line or the line it
+        precedes (comment-above style)."""
+        code = ALLOW_CODES.get(checker, checker)
+        for ln in (line, line - 1):
+            if code in self.allows.get(ln, ()):  # exact code only
+                return True
+        return False
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Dotted qualname of the enclosing class/function chain."""
+        parts = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+
+class Project:
+    """All analyzed sources, loaded once and shared by every checker."""
+
+    def __init__(self, root: str, package: str = "gatekeeper_tpu",
+                 paths: Optional[Iterable[str]] = None):
+        self.root = root
+        self.package = package
+        self.files: dict[str, SourceFile] = {}
+        if paths is None:
+            paths = sorted(self._discover(root, package))
+        for rel in paths:
+            try:
+                self.files[rel] = SourceFile(root, rel)
+            except (SyntaxError, UnicodeDecodeError) as e:
+                raise SystemExit(f"gklint: cannot parse {rel}: {e}")
+
+    @staticmethod
+    def _discover(root: str, package: str) -> Iterable[str]:
+        pkg_root = os.path.join(root, package)
+        for dirpath, _dirs, names in os.walk(pkg_root):
+            for name in names:
+                if name.endswith(".py"):
+                    full = os.path.join(dirpath, name)
+                    yield os.path.relpath(full, root).replace(os.sep, "/")
+
+
+def run_checkers(project: Project, checkers=None) -> list[Finding]:
+    """Run every checker (or the named subset) and fold in malformed
+    allow comments as findings."""
+    from . import (block_zone, clock_discipline, gauge_teardown,
+                   jit_discipline, metrics_hygiene)
+
+    registry = {
+        "block_zone": block_zone.check,
+        "gauge_teardown": gauge_teardown.check,
+        "clock_discipline": clock_discipline.check,
+        "metrics_hygiene": metrics_hygiene.check,
+        "jit_discipline": jit_discipline.check,
+    }
+    findings: list[Finding] = []
+    for name, fn in registry.items():
+        if checkers and name not in checkers:
+            continue
+        findings.extend(fn(project))
+    for sf in project.files.values():
+        for ln in sf.allow_errors:
+            findings.append(Finding(
+                "allow", sf.path, ln, "<comment>", f"line{ln}",
+                "gklint allow comment without a reason= (the escape "
+                "hatch requires one)"))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.code))
+    return findings
+
+
+# ------------------------------------------------------------ baseline
+
+def load_baseline(path: str) -> dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in (data.get("findings") or {}).items()}
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({
+            "comment": "gklint suppression ratchet: new findings fail "
+                       "CI; fixed findings must shrink this file "
+                       "(python -m tools.gklint --write-baseline). "
+                       "Values are finding counts per stable key.",
+            "findings": dict(sorted(counts.items())),
+        }, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def ratchet(findings: list[Finding], baseline: dict[str, int]
+            ) -> tuple[list[str], list[str]]:
+    """(new_findings, stale_suppressions): both must be empty to pass.
+
+    New = a key's current count exceeds its baselined count (each
+    excess occurrence is listed). Stale = a baselined key whose count
+    shrank — the fix landed, so the suppression must shrink too."""
+    counts: dict[str, int] = {}
+    by_key: dict[str, list[Finding]] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+        by_key.setdefault(f.key(), []).append(f)
+    new: list[str] = []
+    for key, n in sorted(counts.items()):
+        allowed = baseline.get(key, 0)
+        if n > allowed:
+            for f in by_key[key][allowed:]:
+                new.append(f.render())
+    stale = [f"{key} (baseline {n}, now {counts.get(key, 0)})"
+             for key, n in sorted(baseline.items())
+             if counts.get(key, 0) < n]
+    return new, stale
+
+
+# ------------------------------------------------------- AST utilities
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('self.kube.get');
+    empty string for anything unresolvable."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        base = dotted(node.func)
+        return f"{base}()" if base else ""
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted(call.func)
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,135 @@
+"""gauge_teardown — lifecycle-bound SET gauges must zero on teardown.
+
+The PR 13 stale-export bug class: a gauge that is only ever SET (queue
+depth, duty cycle, per-worker in-flight, ring fill, burn rates) keeps
+exporting its last value after the thing it measures dies — a dead
+frontend's in-flight, a stopped engine's burn rate — unless a teardown
+path writes zero or unregisters the scrape probe.
+
+Rule: a class (or module) that writes one of the lifecycle gauge
+families outside a teardown context must ALSO touch that family inside
+one — a method whose name matches the teardown pattern, or a
+``finally`` block (the read-loop-finally idiom). Probe registrations
+must pair with an unregister the same way.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, dotted, str_const
+
+# metrics.py reporter functions that SET lifecycle-bound gauges
+LIFECYCLE_REPORTERS = {
+    "report_queue_depth",
+    "report_duty_cycle",
+    "report_backplane_inflight",
+    "report_ring_fill",
+    "report_stream_pending",
+}
+
+# direct gauge_set(...) first-arg name literals that are lifecycle-bound
+LIFECYCLE_GAUGE_NAMES = {
+    "gatekeeper_tpu_queue_depth",
+    "gatekeeper_tpu_device_duty_cycle",
+    "gatekeeper_tpu_backplane_inflight",
+    "gatekeeper_tpu_backplane_ring_fill_ratio",
+    "gatekeeper_tpu_audit_stream_pending_events",
+    "gatekeeper_tpu_slo_burn_rate",
+}
+
+_TEARDOWN_PAT = ("stop", "close", "shutdown", "abort", "teardown",
+                 "detach", "drop", "unregister", "fail", "__exit__",
+                 "finish")
+
+
+def _is_teardown_name(name: str) -> bool:
+    low = name.lower()
+    return any(p in low for p in _TEARDOWN_PAT)
+
+
+def _family_of(call: ast.Call) -> str:
+    """The lifecycle gauge family a call touches, or ''."""
+    name = dotted(call.func)
+    leaf = name.split(".")[-1]
+    if leaf in LIFECYCLE_REPORTERS:
+        return leaf
+    if leaf == "gauge_set" and call.args:
+        lit = str_const(call.args[0])
+        if lit in LIFECYCLE_GAUGE_NAMES:
+            return lit
+    if leaf == "register_saturation_probe" and call.args:
+        lit = str_const(call.args[0])
+        return f"probe:{lit}" if lit else "probe:?"
+    return ""
+
+
+def _is_release(call: ast.Call) -> str:
+    name = dotted(call.func)
+    leaf = name.split(".")[-1]
+    if leaf == "unregister_saturation_probe":
+        lit = str_const(call.args[0]) if call.args else None
+        return f"probe:{lit}" if lit else "probe:?"
+    return ""
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for path, sf in project.files.items():
+        if path.endswith("control/metrics.py"):
+            continue  # the reporter definitions themselves
+        scopes: list[tuple[str, list]] = []
+        module_body = [n for n in sf.tree.body
+                       if not isinstance(n, ast.ClassDef)]
+        scopes.append(("<module>", module_body))
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                scopes.append((node.name, node.body))
+        for scope_name, body in scopes:
+            writes: dict[str, ast.Call] = {}
+            torn: set = set()
+            for item in body:
+                is_fn = isinstance(item, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                fn_teardown = is_fn and _is_teardown_name(item.name)
+                finally_nodes: set = set()
+                for sub in ast.walk(item):
+                    if isinstance(sub, ast.Try):
+                        for fnode in sub.finalbody:
+                            for inner in ast.walk(fnode):
+                                finally_nodes.add(inner)
+                for sub in ast.walk(item):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    fam = _family_of(sub)
+                    rel = _is_release(sub)
+                    in_teardown = fn_teardown or sub in finally_nodes
+                    if rel:
+                        torn.add(rel)
+                        continue
+                    if not fam:
+                        continue
+                    if in_teardown:
+                        torn.add(fam)
+                    else:
+                        writes.setdefault(fam, sub)
+            for fam, call in sorted(writes.items()):
+                if fam in torn:
+                    continue
+                if fam.startswith("probe:") and "probe:?" in torn:
+                    continue  # dynamic unregister name covers it
+                if sf.allowed(call.lineno, "gauge_teardown"):
+                    continue
+                what = ("saturation probe registration"
+                        if fam.startswith("probe:")
+                        else f"SET gauge family `{fam}`")
+                fix = ("an unregister_saturation_probe"
+                       if fam.startswith("probe:")
+                       else "a zeroing write")
+                findings.append(Finding(
+                    "gauge_teardown", path, call.lineno, scope_name,
+                    fam,
+                    f"{what} has no matching {fix} on a stop()/"
+                    f"teardown path (or finally block) in {scope_name}"
+                    " — the last value exports forever after teardown"))
+    return findings

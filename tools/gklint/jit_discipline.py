@@ -1,0 +1,124 @@
+"""jit_discipline — AotJit coverage in ir/ and the trace-stage registry.
+
+* Every ``jax.jit`` in ``gatekeeper_tpu/ir/`` must flow through
+  ``AotJit`` (ir/aot.py) so the program rides the serialized-
+  executable store and a warm boot deserializes instead of
+  recompiling (the PR 8 contract). A bare ``jax.jit`` outside aot.py
+  is a cold-start regression waiting for a restart to find it.
+* Every stage/phase name literal passed to the span recorders must be
+  declared in ``gatekeeper_tpu/control/stages.py`` — the bounded
+  ``stage`` label set, which also renders the README stage table.
+  Dynamic stage names need an allow comment naming where the values
+  are bounded.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, Project, dotted, str_const
+
+STAGES_MODULE = "gatekeeper_tpu/control/stages.py"
+
+# call-leaf -> index of the stage-name argument
+_STAGE_SINKS = {
+    "span": 0,          # tr.span("encode")
+    "add_span": 0,      # tr.add_span("frontend_parse", t0, t1)
+    "add_phase": 0,     # tr.add_phase(name, secs)
+    "observe_stage": 0,  # frontend stats accumulator
+    "stage_hook": 0,    # frontend stage relay
+    "report_stage": 1,  # metrics.report_stage(plane, stage, ...)
+    "report_stage_bucketed": 1,
+    "report_audit_shard": 0,
+    "phase": 0,         # profiling.timers().phase("compile")
+    "add": 0,           # profiling.timers().add("device_sweep", s)
+}
+
+# receivers that make a bare .phase()/.add() a PhaseTimers call and a
+# bare .span()/.add_span() a trace call — everything else (set.add,
+# argparse groups, ...) is ignored
+_TIMERS_HINTS = ("timers", "phase_timers")
+_TRACE_HINTS = ("tr", "trace", "self", "p.trace")
+
+
+def load_stage_names(root: str) -> frozenset:
+    """Parse STAGES keys out of stages.py without importing the
+    package (the linter must run without jax on the path)."""
+    path = os.path.join(root, STAGES_MODULE)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "STAGES" \
+                        and isinstance(node.value, ast.Dict):
+                    return frozenset(
+                        k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str))
+    raise SystemExit(f"gklint: no STAGES dict literal in {path}")
+
+
+def _stage_receiver_ok(leaf: str, recv: str) -> bool:
+    recv_low = recv.lower()
+    if leaf in ("phase", "add"):
+        return any(h in recv_low for h in _TIMERS_HINTS)
+    if leaf in ("span", "add_span", "add_phase"):
+        return any(recv_low == h or recv_low.startswith(h)
+                   for h in _TRACE_HINTS) or "trace" in recv_low \
+            or recv_low in ("tr", "t")
+    return True  # uniquely-named sinks
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    stage_names = load_stage_names(project.root)
+
+    for path, sf in project.files.items():
+        in_ir = path.startswith("gatekeeper_tpu/ir/") and \
+            not path.endswith("ir/aot.py")
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            leaf = name.split(".")[-1]
+            # --- bare jax.jit in ir/ -------------------------------
+            if in_ir and name.endswith("jax.jit") \
+                    and not sf.allowed(node.lineno, "jit_discipline"):
+                findings.append(Finding(
+                    "jit_discipline", path, node.lineno,
+                    sf.scope_of(node), "bare-jax-jit",
+                    "bare jax.jit in ir/ — wrap in AotJit (ir/aot.py) "
+                    "so the executable rides the AOT store and warm "
+                    "boots deserialize instead of recompiling"))
+                continue
+            # --- stage-name registry -------------------------------
+            idx = _STAGE_SINKS.get(leaf)
+            if idx is None or len(node.args) <= idx:
+                continue
+            recv = ".".join(name.split(".")[:-1])
+            if not _stage_receiver_ok(leaf, recv):
+                continue
+            # x.span(...) used as a context manager or via TRACER etc.
+            lit = str_const(node.args[idx])
+            if sf.allowed(node.lineno, "stage_registry"):
+                continue
+            if lit is None:
+                findings.append(Finding(
+                    "stage_registry", path, node.lineno,
+                    sf.scope_of(node), f"dynamic-stage:{leaf}",
+                    f"dynamic stage name passed to {leaf}() — stage "
+                    "labels are a bounded set; pass a literal from "
+                    "control/stages.py or allow(stage) with the "
+                    "bounding argument"))
+            elif lit not in stage_names:
+                findings.append(Finding(
+                    "stage_registry", path, node.lineno,
+                    sf.scope_of(node), f"unregistered-stage:{lit}",
+                    f"stage name `{lit}` not declared in "
+                    "gatekeeper_tpu/control/stages.py — register it "
+                    "(the README stage table renders from there)"))
+    return findings

@@ -1,0 +1,144 @@
+"""metrics_hygiene — family naming and bounded label values.
+
+* counters end in ``_total``; histograms end in ``_seconds`` (the
+  Prometheus/OpenMetrics conventions strict scrapers enforce). The
+  reference-parity legacy names the repo inherited are allowlisted
+  explicitly — the list may only shrink.
+* label values must never be interpolated strings (f-strings, ``%``,
+  ``+``, ``.format``): one interpolated kind/user/path value mints an
+  unbounded series set and the registry never forgets a label set.
+* ``reason``/``outcome``/``path``/``status`` labels fed from a
+  variable must show a bounded-set discipline in the enclosing
+  function: a membership test (or fold) against an ALL_CAPS constant,
+  the REASON_CODES pattern from ir/compile.py.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, dotted, str_const
+
+# reference metric-name parity (SURVEY.md §2.1) predates the _total
+# convention; these families are frozen — never add to this list
+LEGACY_COUNTERS = {
+    "request_count",
+    "mutation_request_count",
+    "mutator_ingestion_count",
+    "admission_batch_timeouts",
+}
+
+# histograms whose unit genuinely is not seconds
+NON_SECONDS_HISTOGRAMS = {
+    "gatekeeper_tpu_batch_fill_ratio",  # dimensionless fill fraction
+}
+
+_RECORDERS = {"counter_add": "counter", "observe": "histogram",
+              "observe_bucketed": "histogram", "gauge_set": "gauge"}
+
+_BOUNDED_LABELS = {"reason", "outcome", "path", "status"}
+
+
+def _interpolated(node: ast.AST) -> bool:
+    if isinstance(node, ast.JoinedStr):
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                  (ast.Add, ast.Mod)):
+        return (_interpolated(node.left) or _interpolated(node.right)
+                or str_const(node.left) is not None
+                or str_const(node.right) is not None)
+    if isinstance(node, ast.Call) and \
+            dotted(node.func).endswith(".format"):
+        return True
+    return False
+
+
+def _has_bound_discipline(fn_node: ast.AST, name: str) -> bool:
+    """True when the enclosing function tests/folds `name` against an
+    ALL_CAPS constant (`if reason not in REASON_CODES: ...`,
+    `REASONS.get(reason, ...)`), or reassigns it from a literal."""
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Compare) and \
+                isinstance(sub.left, ast.Name) and sub.left.id == name:
+            for op, comp in zip(sub.ops, sub.comparators):
+                if isinstance(op, (ast.In, ast.NotIn)):
+                    target = dotted(comp).split(".")[-1]
+                    if target and target.upper() == target:
+                        return True
+        if isinstance(sub, ast.Call):
+            d = dotted(sub.func)
+            base = d.rsplit(".", 1)[0] if "." in d else ""
+            if d.endswith(".get") and base.upper() == base and base:
+                for a in sub.args:
+                    if isinstance(a, ast.Name) and a.id == name:
+                        return True
+    return False
+
+
+def _enclosing_function(sf, node):
+    cur = sf.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = sf.parents.get(cur)
+    return None
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for path, sf in project.files.items():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = dotted(node.func).split(".")[-1]
+            kind = _RECORDERS.get(leaf)
+            if kind is None:
+                continue
+            name = str_const(node.args[0]) if node.args else None
+            scope = sf.scope_of(node)
+            if name is not None and not sf.allowed(node.lineno,
+                                                   "metrics_hygiene"):
+                if kind == "counter" and not name.endswith("_total") \
+                        and name not in LEGACY_COUNTERS:
+                    findings.append(Finding(
+                        "metrics_hygiene", path, node.lineno, scope,
+                        f"counter-name:{name}",
+                        f"counter `{name}` must end in _total "
+                        "(OpenMetrics strict scrapers reject bare "
+                        "counter families)"))
+                elif kind == "histogram" \
+                        and not name.endswith("_seconds") \
+                        and name not in NON_SECONDS_HISTOGRAMS:
+                    findings.append(Finding(
+                        "metrics_hygiene", path, node.lineno, scope,
+                        f"histogram-name:{name}",
+                        f"histogram `{name}` must end in _seconds "
+                        "(or be allowlisted with its real unit)"))
+            # label kwargs: interpolation + boundedness
+            for kw in node.keywords:
+                if kw.arg is None or kw.arg in ("help_", "value",
+                                                "buckets", "exemplar"):
+                    continue
+                if sf.allowed(node.lineno, "metrics_hygiene"):
+                    continue
+                if _interpolated(kw.value):
+                    findings.append(Finding(
+                        "metrics_hygiene", path, node.lineno, scope,
+                        f"interpolated-label:{kw.arg}",
+                        f"label `{kw.arg}` built from string "
+                        "interpolation — label values must come from "
+                        "bounded sets, never formatted input"))
+                elif kw.arg in _BOUNDED_LABELS and \
+                        isinstance(kw.value, ast.Name):
+                    fn = _enclosing_function(sf, node)
+                    if fn is not None and \
+                            not _has_bound_discipline(fn, kw.value.id):
+                        findings.append(Finding(
+                            "metrics_hygiene", path, node.lineno,
+                            scope, f"unbounded-label:{kw.arg}",
+                            f"label `{kw.arg}` fed from variable "
+                            f"`{kw.value.id}` with no membership "
+                            "test/fold against an ALL_CAPS bounded "
+                            "set in this function (REASON_CODES "
+                            "pattern)"))
+    return findings
