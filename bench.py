@@ -226,7 +226,8 @@ def main() -> int:
     import subprocess
 
     configs = {}
-    want_configs = ["1", "2", "3", "5", "6", "7", "9", "10", "11", "12"]
+    want_configs = ["1", "2", "3", "5", "6", "7", "9", "10", "11", "12",
+                    "13"]
     try:
         # FULL scale by default: BENCH_r0N.json must carry the
         # 10k-object and 50k-pod numbers, not reduced-scale stand-ins
@@ -310,6 +311,11 @@ def main() -> int:
         "audit_path": audit_path,
         "device_programs": driver.warm_status(),
         "n_devices": len(__import__("jax").devices()),
+        # execution platform: rounds measured on different JAX
+        # backends are not comparable — bench_trend restarts every
+        # gated series when this changes (host-class move, not a
+        # code regression)
+        "jax_backend": __import__("jax").default_backend(),
         "mutate_audit_s": round(mutate_audit_s, 3),
         # mutating-admission headline (config 7): one micro-batch's
         # batched mutate pass at the largest mutator-library size
@@ -340,6 +346,14 @@ def main() -> int:
             (configs.get("12") or {}).get(
                 "general_library_compiled_fraction"),
         "compile_widening_speedup": (configs.get("12") or {}).get("value"),
+        # sharded-inventory headline (config 13): one composed audit
+        # round over the process-sharded plane — objects/s at the best
+        # shard count and its full-round wall
+        "sharded_objects_per_sec": (configs.get("13") or {}).get("value"),
+        "sharded_sweep_wall_s":
+            (configs.get("13") or {}).get("sweep_wall_s"),
+        "sharded_best_shards": (configs.get("13") or {}).get(
+            "best_shards"),
         # multichip headline (config 10): default mesh-sharded audit at
         # 1M+ objects vs the forced single-device path
         "mesh_audit_s": (configs.get("10") or {}).get("value"),

@@ -39,6 +39,12 @@ One JSON line per config:
      vs the newly device-compiled path, plus the shipped general
      library's device coverage (general_library_compiled_fraction
      must read 1.0)
+  #13 sharded inventory plane at 10M * BENCH_SCALE objects
+     (BENCH_C13_OBJECTS overrides): the inventory consistent-hashed
+     across 1/2/4 audit shard processes — spawn + slice-sync wall,
+     the full composed-round wall (objects_per_sec headline), and the
+     steady incremental round under ~0.1% routed churn, vs the
+     unsharded single-client sweep
 
 All audits run steady-state through client.audit() (warm caches), same
 contract as bench.py. Run: python bench_configs.py [1 2 3 5 6 7 8 9]
@@ -2274,6 +2280,186 @@ def config12():
     }))
 
 
+# -------------------------------------------------------------- config 13
+
+
+def config13():
+    """Sharded inventory plane (the PR-16 tentpole): the audit
+    inventory consistent-hashed by (GVK, namespace) across N audit
+    shard PROCESSES, each sweeping only its slice, the leader
+    composing per-shard results into one audit round. At each shard
+    count over the SAME leader inventory it measures: the spawn +
+    slice-sync wall (what a respawned shard pays end to end), the
+    full-slice re-sweep wall right after a resync (the orphaned-
+    partition path — the `objects_per_sec` headline), and the steady
+    incremental round under ~0.1% routed churn (the recurring state).
+    Defaults to 10M * BENCH_SCALE objects (BENCH_C13_OBJECTS
+    overrides). On a small host the shard children time-share the
+    cores, so shards>1 validates the sharded path, not core scaling —
+    the record says which it was."""
+    import shutil
+    import tempfile
+
+    from gatekeeper_tpu import policies
+    from gatekeeper_tpu.control.audit import ShardedAuditPlane
+    from gatekeeper_tpu.control.backplane import AuditShardSupervisor
+    from gatekeeper_tpu.control.kube import FakeKube
+    from gatekeeper_tpu.parallel.workload import REQUIRED_LABELS_TEMPLATE
+
+    n = int(os.environ.get("BENCH_C13_OBJECTS",
+                           int(10_000_000 * SCALE)))
+    shard_counts = [int(s) for s in os.environ.get(
+        "BENCH_C13_SHARDS", "1 2 4").split()]
+    n_ns = max(16, min(8192, n // 100))
+    n_ing = max(4, n // 1000)
+    churn = max(1, min(1000, n // 1000))
+    cores = os.cpu_count() or 1
+
+    def pod(i, tag=None):
+        # ~0.1% violating tail keeps materialization off the critical
+        # path; churn tags mutate labels without changing verdicts
+        labels = {"team": "core"} if i % 1000 else {"app": "x"}
+        if tag:
+            labels["churn"] = tag
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"p-{i}",
+                             "namespace": f"ns{i % n_ns}",
+                             "labels": labels}}
+
+    drv, leader = new_client()
+    leader.add_template(REQUIRED_LABELS_TEMPLATE)
+    # the FIXED-kind join template: Ingresses broadcast their join
+    # columns to every shard, Pods stay owner-only (the broadcast
+    # pruning this plane exists for)
+    leader.add_template(policies.load("general/uniqueingresshost"))
+    leader.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "pods-need-team"},
+        "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Pod"]}]},
+                 "parameters": {"labels": [{"key": "team"}]}}})
+    leader.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sUniqueIngressHost",
+        "metadata": {"name": "unique-hosts"}, "spec": {}})
+
+    t0 = time.time()
+    for i in range(n_ns):
+        leader.add_data({"apiVersion": "v1", "kind": "Namespace",
+                         "metadata": {"name": f"ns{i}"}})
+    for i in range(n):
+        leader.add_data(pod(i))
+    for i in range(n_ing):
+        host = (f"dup{i % 8}.corp.example" if i % 100 == 1
+                else f"h{i}.corp.example")
+        leader.add_data({"apiVersion": "networking.k8s.io/v1",
+                         "kind": "Ingress",
+                         "metadata": {"name": f"ing-{i}",
+                                      "namespace": f"ns{i % n_ns}"},
+                         "spec": {"rules": [{"host": host}]}})
+    ingest_s = time.time() - t0
+    total = n + n_ns + n_ing
+
+    # unsharded reference on the leader itself (same client, same
+    # inventory): full re-evaluation wall, delta cache dropped so the
+    # steady-state shortcut can't answer from cache
+    leader.audit()  # warm-up (background XLA compile)
+    t0 = time.time()
+    while hasattr(drv, "warm_status") and \
+            drv.warm_status()["compiling"] and time.time() - t0 < 600:
+        time.sleep(0.2)
+    uns_s = float("inf")
+    for _ in range(2):
+        drop = getattr(drv, "_audit_results_cache", None)
+        if drop is not None:
+            drop.clear()
+        t0 = time.time()
+        uns_n = len(leader.audit().results())
+        uns_s = min(uns_s, time.time() - t0)
+
+    per_shards = []
+    tmp = tempfile.mkdtemp(prefix="gk-c13-")
+    try:
+        for shards in shard_counts:
+            sock = os.path.join(tmp, f"s{shards}.sock")
+            plane_box: list = []
+            sup = AuditShardSupervisor(
+                shards,
+                socket_for=lambda k, s=sock: f"{s}.{k}",
+                spawn_args=["--log-level", "WARNING"],
+                snapshot_provider=lambda k: plane_box[0].sync_snapshot(k))
+            plane = ShardedAuditPlane(FakeKube(), leader, sup, shards)
+            plane_box.append(plane)
+            row: dict = {"shards": shards}
+            try:
+                t0 = time.time()
+                sup.start()  # spawn children + bulk per-slice sync
+                row["spawn_sync_s"] = round(time.time() - t0, 3)
+                t0 = time.time()
+                res, _ = plane.sweep(None)  # slice encode + XLA warm
+                row["first_round_s"] = round(time.time() - t0, 3)
+                row["violations"] = len(res)
+                # orphaned-partition re-sweep: fresh slice sync (warm
+                # device programs), then one FULL composed round
+                for k in range(shards):
+                    sup._resync(k)
+                t0 = time.time()
+                res, stats = plane.sweep(None)
+                wall = time.time() - t0
+                row["full_sweep_wall_s"] = round(wall, 4)
+                row["objects_per_sec"] = round(total / max(wall, 1e-9))
+                row["shard_eval_max_s"] = stats.get("shard_eval_max_s")
+                # steady incremental round under routed churn: live
+                # deltas route owner-only over the backplane, shards
+                # re-evaluate dirty rows, the leader recomposes
+                plane.attach()
+                steady = float("inf")
+                for r in range(2):
+                    for j in range(churn):
+                        leader.add_data(pod((j * 997) % n, tag=f"r{r}"))
+                    t0 = time.time()
+                    res2, _ = plane.sweep(None)
+                    steady = min(steady, time.time() - t0)
+                row["steady_churn_sweep_s"] = round(steady, 4)
+                row["steady_violations"] = len(res2)
+            except Exception as e:
+                row["error"] = f"{type(e).__name__}: {e}"[:200]
+            finally:
+                sup.stop()
+                plane.stop()
+            per_shards.append(row)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    ok = [r for r in per_shards if "error" not in r]
+    best = max(ok, key=lambda r: r["objects_per_sec"]) if ok else None
+    out = {
+        "config": 13, "metric": "sharded_audit_objects_per_sec",
+        "value": best["objects_per_sec"] if best else None,
+        "unit": (f"objects/s (sharded inventory plane full composed "
+                 f"round, best of shards={shard_counts}; requiredlabels "
+                 f"+ uniqueingresshost x {total} objects across {n_ns} "
+                 "namespaces)"),
+        "objects": total, "host_cores": cores,
+        "leader_ingest_s": round(ingest_s, 2),
+        "unsharded_sweep_s": round(uns_s, 4),
+        "unsharded_violations": uns_n,
+        "per_shards": per_shards,
+    }
+    if best:
+        out["best_shards"] = best["shards"]
+        out["sweep_wall_s"] = best["full_sweep_wall_s"]
+        out["vs_unsharded"] = round(uns_s /
+                                    max(best["full_sweep_wall_s"], 1e-9),
+                                    2)
+        if cores < max(shard_counts):
+            out["note"] = (f"{cores} host core(s): shard children "
+                           "time-share the core, so shards>1 validates "
+                           "the sharded path, not core scaling")
+    print(json.dumps(out))
+
+
 def run(which: list[int]) -> int:
     """Run the named configs. A config-level exception no longer kills
     the remaining configs OR vanishes into the log: it prints an
@@ -2283,7 +2469,7 @@ def run(which: list[int]) -> int:
     nonzero at the end so a blocking CI step on one config fails."""
     table = {1: config1, 2: config2, 3: config3, 5: config5, 6: config6,
              7: config7, 8: config8, 9: config9, 10: config10,
-             11: config11, 12: config12}
+             11: config11, 12: config12, 13: config13}
     failed = 0
     for c in which:
         if c not in table:

@@ -132,6 +132,13 @@ class RegoDriver:
         # still count toward totals but skip message assembly — capped
         # constraints stop paying for messages that are never published
         self.audit_violations_cap: Optional[int] = None
+        # audit ownership predicate pred(gv, kind, namespace) -> bool,
+        # installed by the sharded audit plane (control/shardmap.py) so
+        # this driver flattens reviews only for its inventory slice.
+        # None = unsharded. Applies ONLY to review building — the
+        # inventory data tree stays whole so joins and interpreter
+        # data.inventory reads keep seeing broadcast objects.
+        self.audit_review_filter = None
 
     # ------------------------------------------------------------- modules
 
@@ -242,6 +249,18 @@ class RegoDriver:
         self._inv_tree_cache.clear()
         self._audit_frz = (None, {})
         self._frz_inv = (None, None)
+
+    def set_audit_review_filter(self, pred) -> None:
+        """Install (or clear, pred=None) the audit ownership predicate.
+        Tears down every derived inventory cache: the flattened review
+        list changes shape under a new filter, and any cache keyed off
+        it (signatures, encoded rows downstream) must rebuild from the
+        filtered view. Installed once at shard start, so the full
+        rebuild is a non-event."""
+        if pred is self.audit_review_filter:
+            return
+        self.audit_review_filter = pred
+        self.drop_inventory_caches()
 
     # spine depth below each scope node at which object leaves sit:
     # cluster/<gv>/<kind>/<name>, namespace/<ns>/<gv>/<kind>/<name> —
@@ -974,6 +993,7 @@ class RegoDriver:
         root = self._interp.get_data(("external", target))
         if root is UNDEF or not isinstance(root, dict):
             return reviews, keys
+        flt = self.audit_review_filter
         cluster = root.get("cluster")
         if isinstance(cluster, dict):
             for gv in sorted(cluster):
@@ -984,6 +1004,8 @@ class RegoDriver:
                 for kind in sorted(by_kind):
                     by_name = by_kind[kind]
                     if not isinstance(by_name, dict):
+                        continue
+                    if flt is not None and not flt(gv, kind, ""):
                         continue
                     for name in sorted(by_name):
                         reviews.append({
@@ -1007,6 +1029,8 @@ class RegoDriver:
                     for kind in sorted(by_kind):
                         by_name = by_kind[kind]
                         if not isinstance(by_name, dict):
+                            continue
+                        if flt is not None and not flt(gv, kind, ns):
                             continue
                         for name in sorted(by_name):
                             reviews.append({

@@ -24,15 +24,18 @@ written status is skipped entirely.
 
 from __future__ import annotations
 
+import heapq
+import json
 import threading
 import time
 from typing import Any, Callable, Optional
 
-from ..client import Client
+from ..client import Client, Result
 from ..utils import profiling
+from ..utils.values import thaw
 from . import metrics
 from . import trace as gtrace
-from .kube import GVK, KubeError, NotFound, WatchEvent
+from .kube import GVK, KubeError, NotFound, ScopedKube, WatchEvent
 from .logging import logger
 from .util import prune_stale_by_pod
 
@@ -103,9 +106,13 @@ class InventoryTracker:
     until the watch heals.
     """
 
-    def __init__(self, kube, opa: Client):
+    def __init__(self, kube, opa: Client, sink=None):
         self.kube = kube
         self.opa = opa
+        # where applied deltas land: the client itself by default; the
+        # sharded plane can substitute a routing sink (leader apply +
+        # owner-shard fan-out) without the tracker knowing about shards
+        self.sink = sink if sink is not None else opa
         self._lock = threading.Lock()
         self._dirty: dict[tuple, tuple] = {}   # key -> (etype, obj)
         # streaming audit: monotonic receipt time of the OLDEST pending
@@ -296,7 +303,7 @@ class InventoryTracker:
         if ns:
             stub["metadata"]["namespace"] = ns
         try:
-            self.opa.remove_data(stub)
+            self.sink.remove_data(stub)
         except Exception as e:
             # keep the key tracked and requeue the delete: forgetting it
             # here would orphan the object in the shared inventory with
@@ -500,7 +507,7 @@ class InventoryTracker:
             if self._state.get(key) == ver:
                 continue  # no-op event (or our own resync echo)
             try:
-                self.opa.add_data(obj)
+                self.sink.add_data(obj)
             except Exception as e:
                 # requeue so the NEXT sweep retries — dropping the
                 # drained entry would silently lose the delta until the
@@ -584,7 +591,7 @@ class InventoryTracker:
                         self._dirty_at.pop(k, None)
             for o in objs:
                 try:
-                    self.opa.add_data(o)
+                    self.sink.add_data(o)
                 except Exception:
                     # transient write failure for a live object must
                     # NOT turn into a deletion below: keep it tracked
@@ -633,11 +640,16 @@ class _KindStatusWriter:
     # drain would widen the event-drain race window)
     _UNRESOLVED = object()
 
-    def __init__(self, manager: "AuditManager", force: bool):
+    def __init__(self, manager: "AuditManager", force: bool, gen: int = 0):
         import queue
 
         self.manager = manager
         self.force = force
+        # this sweep's evaluation generation: every status write this
+        # writer issues is check-and-set against the manager's published
+        # generation, so a slow streamed write can never clobber the
+        # statuses of a NEWER flush/sweep that already published
+        self.gen = gen
         self.live_pods: Any = self._UNRESOLVED
         self.q: Any = queue.Queue()
         self.written = 0
@@ -685,11 +697,23 @@ class _KindStatusWriter:
                     for r in results:
                         handler.handle_violation(r, memo)
                 by_con = self.manager._group_by_constraint(results)
-                w, s, p = self.manager._write_kind_status(
-                    kind, by_con, force=self.force,
-                    live_pods=self.live_pods)
-                if w is None:
-                    continue  # list failed / breaker: post-sweep covers
+                with self.manager._status_lock:
+                    if self.manager._published_gen > self.gen:
+                        # a newer sweep/flush already published: its
+                        # evaluation drained the inventory AFTER ours,
+                        # so writing this kind now would roll statuses
+                        # backwards — skip the write (this sweep's
+                        # post-pass is gen-checked too, so the kind is
+                        # simply owned by the newer publish)
+                        continue
+                    w, s, p = self.manager._write_kind_status(
+                        kind, by_con, force=self.force,
+                        live_pods=self.live_pods)
+                    if w is None:
+                        # list failed / breaker: post-sweep covers
+                        continue
+                    self.manager._published_gen = max(
+                        self.manager._published_gen, self.gen)
                 self.written += w
                 self.skipped += s
                 self.pruned += p
@@ -736,9 +760,15 @@ class AuditManager:
                  stream_audit: bool = False,
                  stream_window_s: float = DEFAULT_STREAM_WINDOW_S,
                  stream_max_batch: int = DEFAULT_STREAM_MAX_BATCH,
-                 stream_status_writes: bool = True):
+                 stream_status_writes: bool = True,
+                 shard_plane: "Optional[ShardedAuditPlane]" = None):
         self.kube = kube
         self.opa = opa
+        # sharded inventory plane: when set, sweeps evaluate on the N
+        # audit shard processes (each owning a consistent-hash slice)
+        # and the leader composes the per-kind results; the local
+        # driver still serves admission/preview from the full inventory
+        self.shard_plane = shard_plane
         self.interval = interval
         self.limit = constraint_violations_limit
         self.audit_from_cache = audit_from_cache
@@ -772,8 +802,11 @@ class AuditManager:
         # (debounce window + max-batch) instead of waiting out the
         # interval; the interval sweep stays as the reconciliation
         # backstop. Requires incremental mode — the whole point is the
-        # persistent encoded inventory + results delta cache.
-        self.stream_audit = stream_audit and incremental
+        # persistent encoded inventory + results delta cache. Sharded
+        # sweeps keep the interval cadence (the shard round-trip IS the
+        # flush), so streaming is a leader-local-only mode.
+        self.stream_audit = stream_audit and incremental \
+            and shard_plane is None
         self.stream_window_s = max(0.0, stream_window_s)
         self.stream_max_batch = max(1, stream_max_batch)
         # streaming status publishing: interval sweeps write each
@@ -784,9 +817,24 @@ class AuditManager:
         self._stream_thread: Optional[threading.Thread] = None
         self._stream_cv = threading.Condition()
         self._stream_signal = False
-        # one sweep at a time: the stream flush and the interval
-        # backstop share the evaluation pipeline and the status writers
-        self._sweep_lock = threading.Lock()
+        # one EVALUATION at a time: the stream flush and the interval
+        # backstop share the delta pipeline. Status publishing happens
+        # OUTSIDE this lock (under _status_lock below) so a kube-write
+        # retry backoff can never sleep while the evaluation pipeline —
+        # and the follower drain, and streaming flushes — are blocked
+        # behind it. gklint promotes held-across-blocking findings on
+        # this allocation site from advisory to gating.
+        self._sweep_lock = threading.Lock()  # locktrace: gate
+        # one PUBLISH at a time, ordered by evaluation generation:
+        # _eval_gen is assigned under _sweep_lock (strictly increasing
+        # in evaluation order), _published_gen advances check-and-set
+        # under _status_lock — a publish whose generation is older than
+        # what's already published is skipped wholesale, so a slow
+        # in-flight write pass cannot clobber newer statuses. Bounded
+        # retry sleeps are acceptable under _status_lock (advisory).
+        self._status_lock = threading.Lock()
+        self._eval_gen = 0
+        self._published_gen = 0
         # rolling flush observability (bench + tests + /debug): counts
         # by outcome and the most recent detection-latency samples
         self.stream_stats = {"flushes": 0, "errors": 0, "skipped": 0,
@@ -831,6 +879,8 @@ class AuditManager:
             metrics.report_stream_pending(0)
         if self.tracker is not None:
             self.tracker.stop()
+        if self.shard_plane is not None:
+            self.shard_plane.stop()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -844,7 +894,17 @@ class AuditManager:
                 # map must not grow unboundedly while following, and a
                 # promoted survivor should sweep over a current
                 # inventory, not a stale one.
-                if self.incremental and self.tracker is not None \
+                if self.shard_plane is not None:
+                    # follower keeps the shard slices current too: a
+                    # promoted survivor's first sweep must find every
+                    # shard's encoded rows fresh, not an interval stale
+                    try:
+                        with self._sweep_lock:
+                            self.shard_plane.apply_pending()
+                    except Exception as e:
+                        log.error("follower shard-inventory sync failed",
+                                  details=str(e))
+                elif self.incremental and self.tracker is not None \
                         and not self.stream_audit:
                     # with streaming on, the stream loop owns follower
                     # drains (skipped flushes) — double-draining here
@@ -970,6 +1030,10 @@ class AuditManager:
             self.stream_stats["skipped"] += 1
             metrics.report_stream_flush("skipped")
             return
+        # EVALUATION under _sweep_lock only: the status publish below
+        # happens after the lock drops (under _status_lock), so the
+        # kube-retry backoff of a flaky status write can never sleep
+        # while the evaluation pipeline is blocked behind this flush
         with self._sweep_lock:
             if self._sweeps == 0:
                 # cold bootstrap pending: the first interval sweep's
@@ -980,6 +1044,8 @@ class AuditManager:
             event_ts = stats.pop("event_ts", None) or []
             if stats["dirty"] == 0 and not event_ts:
                 return  # pure no-op events (rv echoes)
+            self._eval_gen += 1
+            gen = self._eval_gen
             drv = getattr(self.opa, "driver", None)
             cap_armed = hasattr(drv, "audit_violations_cap")
             if cap_armed:
@@ -992,70 +1058,100 @@ class AuditManager:
                     finally:
                         if cap_armed:
                             drv.audit_violations_cap = None
-                by_constraint = self._group_by_constraint(results)
-                # delta against the last published fingerprints: only
-                # kinds whose violation sets moved get listed/compared
-                # this flush (unknown baseline = one full live pass)
-                cur_fp = {k: self._status_entries(v)
-                          for k, v in by_constraint.items()}
-                prev_fp = self._stream_fp
-                kinds = None
-                if prev_fp is not None:
-                    kinds = {key[0] for key in set(prev_fp) | set(cur_fp)
-                             if prev_fp.get(key) != cur_fp.get(key)}
-                with tr.span("status_writes"):
-                    if kinds is not None and not kinds:
-                        # nothing moved: the no-op verdict needs no
-                        # API traffic at all
-                        writes = {"status_writes": 0,
-                                  "status_skipped": len(cur_fp),
-                                  "status_deferred": False}
-                    else:
-                        writes = self._write_audit_results(
-                            by_constraint, kinds=kinds)
-                tr.set_status("stream")
-                tr.set_attr("dirty", stats["dirty"])
             except BaseException as e:
                 tr.set_status("error")
                 tr.set_attr("error", str(e))
-                raise
-            finally:
                 tr.finish()
-            self.stream_stats["flushes"] += 1
-            self.stream_stats["events"] += len(event_ts)
+                raise
+        superseded = False
+        try:
+            by_constraint = self._group_by_constraint(results)
+            cur_fp = {k: self._status_entries(v)
+                      for k, v in by_constraint.items()}
+            with self._status_lock:
+                if self._published_gen > gen:
+                    # a newer sweep already published: skipping this
+                    # flush wholesale is safe (its evaluation drained
+                    # the tracker after ours) — writing would clobber
+                    # the newer statuses with older ones
+                    superseded = True
+                    writes = {"status_writes": 0, "status_skipped": 0,
+                              "status_deferred": False,
+                              "status_superseded": True}
+                else:
+                    # delta against the last published fingerprints:
+                    # only kinds whose violation sets moved get
+                    # listed/compared this flush (unknown baseline =
+                    # one full live pass)
+                    prev_fp = self._stream_fp
+                    kinds = None
+                    if prev_fp is not None:
+                        kinds = {key[0]
+                                 for key in set(prev_fp) | set(cur_fp)
+                                 if prev_fp.get(key) != cur_fp.get(key)}
+                    with tr.span("status_writes"):
+                        if kinds is not None and not kinds:
+                            # nothing moved: the no-op verdict needs no
+                            # API traffic at all
+                            writes = {"status_writes": 0,
+                                      "status_skipped": len(cur_fp),
+                                      "status_deferred": False}
+                        else:
+                            writes = self._write_audit_results(
+                                by_constraint, kinds=kinds)
+                    if not writes.get("status_deferred"):
+                        self._stream_fp = cur_fp
+                        self._published_gen = max(self._published_gen,
+                                                  gen)
+            tr.set_status("stream")
+            tr.set_attr("dirty", stats["dirty"])
+        except BaseException as e:
+            tr.set_status("error")
+            tr.set_attr("error", str(e))
+            raise
+        finally:
+            tr.finish()
+        self.stream_stats["flushes"] += 1
+        self.stream_stats["events"] += len(event_ts)
+        if not superseded:
             self.last_results = results
-            metrics.report_audit_sweep("stream")
-            if writes.get("status_deferred"):
-                # breaker open: statuses did NOT publish — the flush is
-                # an error and these events record NO detection latency
-                # (a sub-second sample here would claim a detection that
-                # never reached status; the pending deltas re-issue on
-                # the first healthy sweep, counted as backstop drift).
-                # The fingerprint baseline does not advance either, so
-                # the next flush re-lists and re-issues these kinds.
-                self.stream_stats["errors"] += 1
-                metrics.report_stream_flush("error")
-                lat = []
-            else:
-                # the detection clock stops when the status writes that
-                # publish the verdicts have completed (or were
-                # confirmed no-ops — an unchanged violation set IS the
-                # verdict)
-                self._stream_fp = cur_fp
-                now = time.monotonic()
-                lat = [max(0.0, now - ts) for ts in event_ts]
-                for s in lat:
-                    metrics.report_violation_detection(s)
-                metrics.report_stream_flush("ok")
-            dt = time.monotonic() - t0
-            if lat:
-                log.info("stream flush",
-                         details={"dirty": stats["dirty"],
-                                  "events": len(lat),
-                                  "violations": len(results),
-                                  "detect_p_max_ms":
-                                      round(max(lat) * 1e3, 1),
-                                  "flush_s": round(dt, 4), **writes})
+        metrics.report_audit_sweep("stream")
+        if superseded:
+            # the overtaking publish covered these events' state; their
+            # detection latency is attributed there, not double-counted
+            self.stream_stats["skipped"] += 1
+            metrics.report_stream_flush("skipped")
+            lat = []
+        elif writes.get("status_deferred"):
+            # breaker open: statuses did NOT publish — the flush is
+            # an error and these events record NO detection latency
+            # (a sub-second sample here would claim a detection that
+            # never reached status; the pending deltas re-issue on
+            # the first healthy sweep, counted as backstop drift).
+            # The fingerprint baseline does not advance either, so
+            # the next flush re-lists and re-issues these kinds.
+            self.stream_stats["errors"] += 1
+            metrics.report_stream_flush("error")
+            lat = []
+        else:
+            # the detection clock stops when the status writes that
+            # publish the verdicts have completed (or were
+            # confirmed no-ops — an unchanged violation set IS the
+            # verdict)
+            now = time.monotonic()
+            lat = [max(0.0, now - ts) for ts in event_ts]
+            for s in lat:
+                metrics.report_violation_detection(s)
+            metrics.report_stream_flush("ok")
+        dt = time.monotonic() - t0
+        if lat:
+            log.info("stream flush",
+                     details={"dirty": stats["dirty"],
+                              "events": len(lat),
+                              "violations": len(results),
+                              "detect_p_max_ms":
+                                  round(max(lat) * 1e3, 1),
+                              "flush_s": round(dt, 4), **writes})
         cb = self.on_flush
         if cb is not None:
             try:
@@ -1072,6 +1168,14 @@ class AuditManager:
         list (uid, rv) re-validation plus whatever delta accumulated
         while down — instead of the forced from-scratch re-encode a
         cold boot pays."""
+        if self.shard_plane is not None:
+            n = self.shard_plane.restore_state(snap)
+            if n:
+                # sweep 0 forces a full re-encode (cold bootstrap); a
+                # restored plane starts at sweep 1 so the backstop
+                # cadence is kept but the boot sweep stays incremental
+                self._sweeps = 1
+            return n
         if not self.incremental:
             return 0
         self.tracker = InventoryTracker(self.kube, self.opa)
@@ -1086,11 +1190,15 @@ class AuditManager:
         """readyz gate: restored state must be re-validated against a
         live list before the pod reports Ready (trivially true when
         nothing was restored)."""
+        if self.shard_plane is not None:
+            return self.shard_plane.restore_ready()
         return self.tracker is None or self.tracker.validated.is_set()
 
     def snapshot_state(self) -> Optional[dict]:
         """Tracker section of the state snapshot; None before the first
         incremental sweep built a tracker."""
+        if self.shard_plane is not None:
+            return self.shard_plane.snapshot_state()
         if self.tracker is None:
             return None
         return self.tracker.snapshot()
@@ -1109,10 +1217,13 @@ class AuditManager:
         # delta_serve time into trace phases.
         tr = gtrace.TRACER.start(gtrace.AUDIT, force=True)
         try:
-            # serialized with the streaming flush: both drive the same
-            # delta pipeline and status writers
+            # evaluation is serialized with the streaming flush (both
+            # drive the same delta pipeline); publishing happens AFTER
+            # the lock drops, under _status_lock, so status-write retry
+            # backoff never sleeps while evaluation is blocked
             with self._sweep_lock:
-                return self._audit_once_traced(tr, t0)
+                pub = self._audit_once_traced(tr, t0)
+            return self._publish_sweep(tr, t0, pub)
         except BaseException as e:
             # a failing sweep must still land in the flight recorder —
             # the sweeps that error (API outage, eval blowup) are
@@ -1123,10 +1234,18 @@ class AuditManager:
         finally:
             tr.finish()
 
-    def _audit_once_traced(self, tr, t0: float) -> list:
+    def _audit_once_traced(self, tr, t0: float) -> dict:
+        """Evaluation half of one interval sweep, under _sweep_lock.
+        Returns the publish payload _publish_sweep consumes once the
+        lock has dropped."""
         timers = profiling.timers()
         phases0 = timers.snapshot()
         sweep_stats: dict = {}
+        # evaluation generation: assigned under _sweep_lock, strictly
+        # increasing in evaluation order — the publish step's clobber
+        # guard (and the streamed writer's) key off it
+        self._eval_gen += 1
+        gen = self._eval_gen
         # streaming status publishing: arm the driver's per-kind
         # completion hook so each kind's constraint statuses PATCH
         # while later kinds are still sweeping on the device. The
@@ -1135,18 +1254,21 @@ class AuditManager:
         # same counter).
         driver = getattr(self.opa, "driver", None)
         writer: Optional[_KindStatusWriter] = None
-        would_force = (not self.incremental or self._sweeps == 0
+        delta_mode = self.incremental or self.shard_plane is not None
+        would_force = (not delta_mode or self._sweeps == 0
                        or (self.full_resync_every > 0
                            and self._sweeps % self.full_resync_every
                            == 0))
         if (self.stream_status_writes
-                and (self.incremental or self.audit_from_cache)
-                and hasattr(driver, "on_kind_results")
+                and (delta_mode or self.audit_from_cache)
+                and (self.shard_plane is not None
+                     or hasattr(driver, "on_kind_results"))
                 and (self.leader_check is None or self.leader_check())
                 and not (self.write_breaker is not None
                          and self.write_breaker.is_open)):
-            writer = _KindStatusWriter(self, would_force)
-            driver.on_kind_results = writer.on_kind
+            writer = _KindStatusWriter(self, would_force, gen=gen)
+            if self.shard_plane is None:
+                driver.on_kind_results = writer.on_kind
         # per-constraint violations cap, armed for THIS sweep only:
         # direct client.audit() callers and previews that share the
         # driver stay uncapped (materialize counts every pair either
@@ -1156,21 +1278,24 @@ class AuditManager:
             driver.audit_violations_cap = self.limit
         t_ev0 = time.monotonic()
         try:
-            return self._audit_eval_and_publish(tr, t0, t_ev0, timers,
-                                                phases0, sweep_stats,
-                                                writer)
+            return self._audit_evaluate(tr, t_ev0, timers, phases0,
+                                        sweep_stats, writer, gen)
         finally:
             if cap_armed:
                 driver.audit_violations_cap = None
             if writer is not None:
-                driver.on_kind_results = None
+                if self.shard_plane is None:
+                    driver.on_kind_results = None
                 # error-path backstop: a raising evaluation must not
                 # leak the writer thread (finish is idempotent)
                 writer.finish()
 
-    def _audit_eval_and_publish(self, tr, t0, t_ev0, timers, phases0,
-                                sweep_stats, writer) -> list:
-        if self.incremental:
+    def _audit_evaluate(self, tr, t_ev0, timers, phases0,
+                        sweep_stats, writer, gen) -> dict:
+        if self.shard_plane is not None:
+            results, sweep_stats = self._audit_sharded(tr, writer)
+            ev_wall = sweep_stats.pop("_eval_wall_s", 0.0)
+        elif self.incremental:
             results, sweep_stats = self._audit_incremental(tr)
             ev_wall = sweep_stats.pop("_eval_wall_s", 0.0)
         elif self.audit_from_cache:
@@ -1212,13 +1337,33 @@ class AuditManager:
             tr.add_phase("evaluate", ev_wall)
         if stream_write_s > 0:
             tr.add_phase("status_write_stream", stream_write_s)
+        return {"gen": gen, "results": results,
+                "sweep_stats": sweep_stats, "writer": writer,
+                "streamed_kinds": streamed_kinds,
+                "stream_write_s": stream_write_s}
+
+    def _publish_sweep(self, tr, t0, pub) -> list:
+        """Publishing half of one interval sweep: constraint-status
+        writes under _status_lock, generation check-and-set so a stale
+        publish (a newer flush/sweep already wrote) is skipped wholesale
+        instead of rolling statuses backwards. Safe to skip entirely:
+        generation order implies inventory-recency order — the newer
+        evaluation drained the tracker AFTER this one, so its published
+        statuses already cover everything this one saw."""
+        gen = pub["gen"]
+        results = pub["results"]
+        sweep_stats = pub["sweep_stats"]
+        writer = pub["writer"]
+        streamed_kinds = pub["streamed_kinds"]
+        stream_write_s = pub["stream_write_s"]
         by_constraint = self._group_by_constraint(results)
-        # delta'd status writes are an INCREMENTAL-mode behavior: the
+        # delta'd status writes are a delta-pipeline behavior: the
         # discovery and from-cache modes keep upstream semantics (every
         # sweep rewrites every status, refreshing auditTimestamp). In
-        # incremental mode, full-resync sweeps force every write so the
-        # timestamp still refreshes every full_resync_every intervals
-        force_writes = (not self.incremental
+        # incremental/sharded mode, full-resync sweeps force every
+        # write so the timestamp still refreshes on that cadence
+        force_writes = (not (self.incremental
+                             or self.shard_plane is not None)
                         or sweep_stats.get("sweep") == "full_resync")
         # reuse the streamed writer's resolved live-pod set: the
         # post-sweep pass must not pay a second cluster-wide pod list
@@ -1227,10 +1372,29 @@ class AuditManager:
                 writer.live_pods is not _KindStatusWriter._UNRESOLVED:
             lp = writer.live_pods
         t_w0 = time.monotonic()
-        with tr.span("status_writes"):
-            writes = self._write_audit_results(
-                by_constraint, force=force_writes,
-                exclude_kinds=streamed_kinds or None, live_pods=lp)
+        superseded = False
+        with self._status_lock:
+            if self._published_gen > gen:
+                superseded = True
+                writes = {"status_writes": 0, "status_skipped": 0,
+                          "status_deferred": False,
+                          "status_superseded": True}
+            else:
+                with tr.span("status_writes"):
+                    writes = self._write_audit_results(
+                        by_constraint, force=force_writes,
+                        exclude_kinds=streamed_kinds or None,
+                        live_pods=lp)
+                if not writes.get("status_deferred"):
+                    self._published_gen = max(self._published_gen, gen)
+                # a full interval sweep (re)establishes the streaming
+                # delta baseline — unless the breaker deferred the
+                # writes, in which case what is published is unknown
+                if self.stream_audit:
+                    self._stream_fp = \
+                        None if writes.get("status_deferred") \
+                        else {k: self._status_entries(v)
+                              for k, v in by_constraint.items()}
         if writer is not None:
             writes["status_writes"] = (writes.get("status_writes", 0)
                                        + writer.written)
@@ -1243,13 +1407,6 @@ class AuditManager:
                 writes["status_streamed_kinds"] = len(streamed_kinds)
         sweep_stats["status_write_s"] = round(
             stream_write_s + (time.monotonic() - t_w0), 4)
-        # a full interval sweep (re)establishes the streaming delta
-        # baseline — unless the breaker deferred the writes, in which
-        # case what is published remains unknown
-        if self.stream_audit:
-            self._stream_fp = None if writes.get("status_deferred") \
-                else {k: self._status_entries(v)
-                      for k, v in by_constraint.items()}
         streaming = (self.stream_audit and self._stream_thread is not None
                      and sweep_stats.get("sweep") == "incremental")
         if streaming:
@@ -1281,8 +1438,11 @@ class AuditManager:
                 by_action.get(r.enforcement_action, 0) + 1
         for action, count in by_action.items():
             metrics.report_violations(action, count)
-        self.last_results = results
-        self.last_sweep_stats = sweep_stats
+        if not superseded:
+            # a superseded publish must not roll the observable sweep
+            # state back behind the newer publish that overtook it
+            self.last_results = results
+            self.last_sweep_stats = sweep_stats
         details = {"violations": len(results), "duration_s": round(dt, 3),
                    **sweep_stats, **writes}
         driver = getattr(self.opa, "driver", None)
@@ -1311,6 +1471,45 @@ class AuditManager:
         # finish() runs in audit_once's finally, error or not
         log.info("audit complete", details=details)
         return results
+
+    def _audit_sharded(self, tr, writer) -> tuple[list, dict]:
+        """Sharded sweep: drain every shard slice's tracker (deltas
+        route to the owning engine process, plus the join-broadcast
+        columns everywhere), dispatch one capped sweep per shard over
+        the backplane, and compose the per-kind results into ONE audit
+        round — bit-equal to the unsharded sweep (see
+        compose_shard_results). Kinds feed the streamed status writer
+        as they compose, so write I/O overlaps the remaining merge."""
+        plane = self.shard_plane
+        full = self._sweeps == 0 or (
+            self.full_resync_every > 0
+            and self._sweeps % self.full_resync_every == 0)
+        self._sweeps += 1
+        t0 = time.monotonic()
+        with tr.span("list_delta_apply"):
+            if full:
+                stats = plane.full_resync(_auditable_gvks(self.kube))
+                metrics.report_audit_sweep("full_resync")
+            else:
+                stats = plane.apply_pending()
+                metrics.report_audit_sweep("incremental")
+        sync_s = time.monotonic() - t0
+        t_ev0 = time.monotonic()
+        with tr.span("shard_sweeps"):
+            results, shard_stats = plane.sweep(
+                self.limit, writer=writer,
+                heartbeat=lambda: setattr(self, "heartbeat",
+                                          time.monotonic()))
+        ev_wall = time.monotonic() - t_ev0
+        metrics.report_audit_dirty(stats["dirty"], stats["total"], 0)
+        return results, {
+            "sweep": "full_resync" if full else "incremental",
+            "dirty": stats["dirty"], "inventory": stats["total"],
+            "sync_s": round(sync_s, 3), "shards": plane.shard_count,
+            **shard_stats,
+            "_eval_wall_s": ev_wall,
+            "_event_ts": stats.get("event_ts") or [],
+        }
 
     def _audit_incremental(self, tr=gtrace.NOOP) -> tuple[list, dict]:
         """Delta sweep: apply the tracker's pending adds/updates/deletes
@@ -1679,3 +1878,558 @@ class AuditManager:
         # client transients return immediately (the next sweep's delta
         # comparison re-issues the write); only Conflicts refresh-retry
         return guarded_status_update(self.kube, obj, refresh)
+
+
+# ------------------------------------------------------- sharded inventory
+
+def _review_sort_key(review: Optional[dict]) -> list:
+    """The driver's review ordering key (client/drivers.py builds
+    inventory reviews cluster-scoped first, then namespaced, each
+    sorted (ns, group/version, kind, name)) — recomputed from the
+    review dict so per-shard result runs carry a merge key that
+    interleaves bit-equal with the unsharded order."""
+    review = review or {}
+    rk = review.get("kind") or {}
+    group = rk.get("group") or ""
+    version = rk.get("version") or ""
+    gv = f"{group}/{version}" if group else version
+    ns = review.get("namespace")
+    if ns:
+        return [1, ns, gv, rk.get("kind") or "", review.get("name") or ""]
+    return [0, "", gv, rk.get("kind") or "", review.get("name") or ""]
+
+
+def _result_to_wire(r: Result) -> dict:
+    """JSON-able Result: the shard materialized messages and ran the
+    target's violation handler already, so `resource` travels populated
+    and the leader never re-derives anything."""
+    return {"msg": r.msg, "metadata": thaw(r.metadata) or {},
+            "constraint": thaw(r.constraint), "review": thaw(r.review),
+            "resource": thaw(r.resource),
+            "enforcement_action": r.enforcement_action}
+
+
+def _result_from_wire(d: dict) -> Result:
+    return Result(msg=d.get("msg") or "", metadata=d.get("metadata") or {},
+                  constraint=d.get("constraint"), review=d.get("review"),
+                  resource=d.get("resource"),
+                  enforcement_action=d.get("enforcement_action") or "deny")
+
+
+def compose_shard_results(per_shard: dict, writer=None,
+                          target: str = "admission.k8s.gatekeeper.sh"
+                          ) -> list:
+    """Merge per-shard sweep payloads into ONE ordered result list,
+    bit-equal to the unsharded sweep. Kinds iterate sorted (the
+    driver's template-kind-major order); within a kind each shard's
+    run is already review-major in review sort order (every audit path
+    — delta-serve, device consume, interpreter — emits row-major), so
+    a heap-merge on the review key interleaves them exactly. A review's
+    rows live on ONE shard (consistent hash of (GVK, namespace)), so
+    ties never span shards and the merge is a true interleave, never a
+    reorder. Composed kinds feed `writer.on_kind` as they finish so
+    streamed status writes overlap the remaining merge."""
+    kinds = sorted({k for p in per_shard.values()
+                    for k in (p.get("kinds") or {})})
+    out: list = []
+    for kind in kinds:
+        runs = [(p.get("kinds") or {}).get(kind) or []
+                for _, p in sorted(per_shard.items())]
+        merged = heapq.merge(*runs, key=lambda e: tuple(e[0]))
+        kr = [_result_from_wire(e[1]) for e in merged]
+        if writer is not None:
+            writer.on_kind(target, kind, kr)
+        out.extend(kr)
+    return out
+
+
+class AuditSliceServer:
+    """The shard-process end of the sharded audit plane: serves
+    /v1/auditslice on an audit engine's backplane socket. One request =
+    one capped sweep of THIS process's slice — the driver's review
+    filter (set_audit_shard) scopes candidates to owned objects while
+    broadcast copies stay visible to joins — returning per-kind result
+    runs keyed for the leader's bit-equal merge."""
+
+    def __init__(self, client, shard_id: int = 0, shard_count: int = 1,
+                 ready: Optional[Callable[[], bool]] = None):
+        self.client = client
+        self.shard_id = shard_id
+        self.shard_count = shard_count
+        # armed by the engine to the library sink's synced flag: a
+        # freshly respawned shard must answer 503 (leader retries after
+        # the supervisor's slice resync), never an empty-library sweep
+        # that would silently drop this partition's violations
+        self.ready = ready
+
+    def handle_http(self, body: bytes) -> tuple:
+        try:
+            req = json.loads(body or b"{}")
+        except ValueError:
+            return 400, b'{"error":"bad json"}'
+        if (req.get("op") or "sweep") != "sweep":
+            return 400, b'{"error":"unknown op"}'
+        if self.ready is not None and not self.ready():
+            return 503, b'{"error":"shard not synced"}'
+        cap = req.get("cap")
+        driver = getattr(self.client, "driver", None)
+        cap_armed = hasattr(driver, "audit_violations_cap")
+        if cap_armed:
+            driver.audit_violations_cap = cap
+        t0 = time.monotonic()
+        try:
+            results = self.client.audit().results()
+        finally:
+            if cap_armed:
+                driver.audit_violations_cap = None
+        eval_s = time.monotonic() - t0
+        kinds: dict = {}
+        for r in results:
+            kind = (r.constraint or {}).get("kind") or ""
+            kinds.setdefault(kind, []).append(
+                [_review_sort_key(r.review), _result_to_wire(r)])
+        n_reviews = 0
+        try:
+            n_reviews = len(driver._inventory_reviews(
+                "admission.k8s.gatekeeper.sh"))
+        except Exception:
+            pass
+        out = {"shard": self.shard_id, "kinds": kinds,
+               "stats": {"violations": len(results),
+                         "reviews": n_reviews,
+                         "eval_s": round(eval_s, 4)}}
+        return 200, json.dumps(out).encode("utf-8")
+
+
+class ShardedAuditPlane:
+    """Leader-side orchestration of the sharded audit inventory.
+
+    Consistent-hashes the auditable inventory by (GVK, namespace)
+    across N audit engine processes (an AuditShardSupervisor's
+    children). Each shard owns its slice end to end — the encoded
+    feature rows, delta cache and incremental-sweep state live in that
+    process, scoped by its driver's review filter — while the leader:
+
+      * runs one InventoryTracker per shard over a ScopedKube view, so
+        watches, resume RVs and the (uid, rv) state map persist per
+        slice (and snapshot/restore per slice);
+      * applies every delta to its OWN full-inventory client too
+        (admission and preview still serve the whole cluster) and,
+        riding the client's on_change notifications, routes the object
+        to its owner shard plus a column-PRUNED broadcast copy to every
+        other shard when the kind can be a join partner (the driver's
+        audit_broadcast_spec — the sik join-key columns);
+      * dispatches per-shard sweeps over the backplane and composes
+        the per-kind runs into one bit-equal audit round;
+      * rides shard death on the supervisor's respawn + per-shard sync
+        (the slice rebuilds from the leader's tree) and re-sweeps ONLY
+        the orphaned partition — the surviving shards' runs are
+        already in hand.
+    """
+
+    TARGET = "admission.k8s.gatekeeper.sh"
+
+    def __init__(self, kube, opa: Client, supervisor, shard_count: int,
+                 vnodes: int = 64, sweep_timeout_s: float = 600.0):
+        from .shardmap import ShardMap
+
+        self.kube = kube
+        self.opa = opa
+        self.supervisor = supervisor
+        self.shard_count = int(shard_count)
+        self.map = ShardMap(self.shard_count, vnodes=vnodes)
+        self.sweep_timeout_s = sweep_timeout_s
+        self._stop = threading.Event()
+        self._bcast: tuple = (None, None)  # (cache key, spec)
+        self.trackers = [
+            InventoryTracker(ScopedKube(kube, self._owns_pred(k)), opa)
+            for k in range(self.shard_count)]
+        metrics.report_audit_shard_map(self.map.version,
+                                       self.shard_count)
+
+    def _owns_pred(self, k: int) -> Callable:
+        return lambda gvk, ns, _k=k: self.map.owner(gvk, ns) == _k
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self.trackers:
+            t.stop()
+
+    # ------------------------------------------------------- replication
+
+    def attach(self) -> None:
+        """Chain onto the leader client's on_change feed: data deltas
+        route to their owner shard (+ the broadcast set), every other
+        library op replicates to ALL shards (each shard's client
+        evaluates the full template/constraint library over its
+        slice). Chained, not replaced — the admission-engine fan-out
+        installed before us keeps firing."""
+        prev = self.opa.on_change
+
+        def fan_out(op, obj, _prev=prev):
+            if _prev is not None:
+                _prev(op, obj)
+            self.on_library_change(op, obj)
+
+        self.opa.on_change = fan_out
+
+    def on_library_change(self, op: str, obj) -> None:
+        if self.supervisor is None:
+            return
+        if op == "add_data":
+            self.route_add(obj)
+        elif op == "remove_data":
+            self.route_remove(obj)
+        else:
+            # template/constraint/mutator ops invalidate the broadcast
+            # column spec (a new join template can widen it) and
+            # replicate everywhere
+            self._bcast = (None, None)
+            self.supervisor.replicate(op, obj)
+
+    def broadcast_spec(self) -> dict:
+        """Join-relevant column spec from the leader driver, cached
+        until a library (non-data) change invalidates it; the template-
+        kind set double-keys the cache against restores that bypass
+        on_change."""
+        driver = getattr(self.opa, "driver", None)
+        try:
+            key = tuple(sorted(self.opa.template_kinds()))
+        except Exception:
+            key = None
+        cached_key, spec = self._bcast
+        if spec is not None and cached_key == key:
+            return spec
+        if hasattr(driver, "audit_broadcast_spec"):
+            spec = driver.audit_broadcast_spec()
+        else:
+            # a driver that cannot prove column sets degrades to
+            # whole-inventory broadcast: sharding must never change a
+            # verdict
+            spec = {"full": True, "kinds": {}}
+        self._bcast = (key, spec)
+        return spec
+
+    _NO_BCAST = object()
+
+    def _bcast_cols(self, kind: str):
+        """Column subtrees a non-owner shard's copy of `kind` must
+        carry: None = whole object, _NO_BCAST = not a join partner
+        (owner-only), else a list of path tuples (kind-specific and
+        wildcard-join columns unioned)."""
+        spec = self.broadcast_spec()
+        if spec.get("full"):
+            return None
+        kinds = spec.get("kinds") or {}
+        entries = []
+        if kind in kinds:
+            entries.append(kinds[kind])
+        if "*" in kinds:
+            entries.append(kinds["*"])
+        if not entries:
+            return self._NO_BCAST
+        cols: list = []
+        for e in entries:
+            if e is None:
+                return None
+            for c in e:
+                if tuple(c) not in cols:
+                    cols.append(tuple(c))
+        return cols
+
+    @staticmethod
+    def _prune(obj: dict, cols: list) -> dict:
+        """Broadcast skeleton: identity + the join-key column subtrees.
+        Labels ride along (namespaceSelector / label joins read them);
+        resourceVersion keeps shard-side (uid, rv) no-op dedupe
+        working."""
+        meta = obj.get("metadata") or {}
+        out_meta = {k: v for k, v in
+                    (("name", meta.get("name")),
+                     ("namespace", meta.get("namespace")),
+                     ("uid", meta.get("uid")),
+                     ("resourceVersion", meta.get("resourceVersion")),
+                     ("labels", meta.get("labels")))
+                    if v is not None}
+        out = {"apiVersion": obj.get("apiVersion"),
+               "kind": obj.get("kind"), "metadata": out_meta}
+        for path in cols:
+            src: Any = obj
+            ok = True
+            for seg in path:
+                if isinstance(src, dict) and seg in src:
+                    src = src[seg]
+                else:
+                    ok = False
+                    break
+            if not ok:
+                continue
+            dst = out
+            for seg in path[:-1]:
+                nxt = dst.get(seg)
+                if not isinstance(nxt, dict):
+                    nxt = {}
+                    dst[seg] = nxt
+                dst = nxt
+            dst[path[-1]] = src
+        return out
+
+    def route_add(self, obj: dict) -> None:
+        from .kube import gvk_of
+
+        sup = self.supervisor
+        if sup is None:
+            return
+        gvk = gvk_of(obj)
+        owner = self.map.owner_of_obj(gvk, obj)
+        sup.send(owner, {"op": "add_data", "obj": obj})
+        cols = self._bcast_cols(obj.get("kind") or "")
+        if cols is self._NO_BCAST:
+            return
+        pruned = obj if cols is None else self._prune(obj, cols)
+        for k in range(self.shard_count):
+            if k != owner:
+                sup.send(k, {"op": "add_data", "obj": pruned})
+
+    def route_remove(self, obj: dict) -> None:
+        from .kube import gvk_of
+
+        sup = self.supervisor
+        if sup is None:
+            return
+        gvk = gvk_of(obj)
+        owner = self.map.owner_of_obj(gvk, obj)
+        sup.send(owner, {"op": "remove_data", "obj": obj})
+        if self._bcast_cols(obj.get("kind") or "") is self._NO_BCAST:
+            return
+        for k in range(self.shard_count):
+            if k != owner:
+                # removing a never-broadcast copy is a no-op shard-side
+                # (delete_data of a missing path returns False)
+                sup.send(k, {"op": "remove_data", "obj": obj})
+
+    # ------------------------------------------------------ sync snapshot
+
+    def sync_snapshot(self, shard: int) -> dict:
+        """The supervisor's per-shard resync payload: full library +
+        this shard's inventory slice REBUILT from the leader's tree
+        (owned objects whole, join partners pruned) — a respawned
+        shard heals without any cluster re-list; the tracker state
+        never left the leader."""
+        op = {"op": "sync", "library": self.opa.snapshot_library(),
+              "mutators": []}
+        driver = getattr(self.opa, "driver", None)
+        tree = driver.inventory_snapshot() \
+            if hasattr(driver, "inventory_snapshot") else None
+        op["data"] = self._prune_tree_for(shard, tree) if tree else None
+        return op
+
+    def _prune_tree_for(self, shard: int, tree: dict) -> dict:
+        from .kube import gvk_of
+
+        out: dict = {}
+        for target, scopes in tree.items():
+            if not isinstance(scopes, dict):
+                continue
+            t_out: dict = {}
+            for scope, sub in scopes.items():
+                if scope == "cluster":
+                    buckets = [("", sub)]
+                elif scope == "namespace" and isinstance(sub, dict):
+                    buckets = list(sub.items())
+                else:
+                    continue
+                for ns, by_gv in buckets:
+                    if not isinstance(by_gv, dict):
+                        continue
+                    for gv, by_kind in by_gv.items():
+                        if not isinstance(by_kind, dict):
+                            continue
+                        for kind, by_name in by_kind.items():
+                            if not isinstance(by_name, dict):
+                                continue
+                            group, _, version = gv.rpartition("/")
+                            gvk = (group, version, kind)
+                            owned = self.map.owner(gvk, ns) == shard
+                            cols = None if owned \
+                                else self._bcast_cols(kind)
+                            if cols is self._NO_BCAST:
+                                continue
+                            for name, o in by_name.items():
+                                if not isinstance(o, dict):
+                                    continue
+                                keep = o if (owned or cols is None) \
+                                    else self._prune(o, cols)
+                                if scope == "cluster":
+                                    dst = t_out.setdefault(
+                                        "cluster", {}).setdefault(
+                                        gv, {}).setdefault(kind, {})
+                                else:
+                                    dst = t_out.setdefault(
+                                        "namespace", {}).setdefault(
+                                        ns, {}).setdefault(
+                                        gv, {}).setdefault(kind, {})
+                                dst[name] = keep
+            out[target] = t_out
+        return out
+
+    # ----------------------------------------------------------- tracking
+
+    def apply_pending(self) -> dict:
+        agg = {"dirty": 0, "total": 0, "event_ts": []}
+        for k, t in enumerate(self.trackers):
+            st = t.apply_pending()
+            agg["dirty"] += st["dirty"]
+            agg["total"] += st["total"]
+            agg["event_ts"].extend(st.get("event_ts") or [])
+            metrics.report_audit_shard_ownership(k, st["total"])
+        return agg
+
+    def full_resync(self, gvks: list) -> dict:
+        driver = getattr(self.opa, "driver", None)
+        if hasattr(driver, "drop_inventory_caches"):
+            driver.drop_inventory_caches()
+        agg = {"dirty": 0, "total": 0, "event_ts": []}
+        for k, t in enumerate(self.trackers):
+            st = t.full_resync(gvks)
+            agg["dirty"] += st["dirty"]
+            agg["total"] += st["total"]
+            metrics.report_audit_shard_ownership(k, st["total"])
+        return agg
+
+    # -------------------------------------------------------- warm restart
+
+    def snapshot_state(self) -> dict:
+        return {"shard_count": self.shard_count,
+                "map_version": self.map.version,
+                "shards": [t.snapshot() for t in self.trackers]}
+
+    def restore_state(self, snap: Optional[dict]) -> int:
+        """Per-slice warm restore. A snapshot taken under a DIFFERENT
+        shard count is discarded (cold start): the hash ring moved, so
+        the saved slices no longer line up with the live predicates —
+        restoring watches against the wrong slice would silently leak
+        objects between shards."""
+        snap = snap or {}
+        shards = snap.get("shards")
+        if not shards or snap.get("shard_count") != self.shard_count \
+                or len(shards) != self.shard_count:
+            if shards:
+                log.info("audit shard snapshot discarded (shard count "
+                         "changed)",
+                         details={"snapshot": snap.get("shard_count"),
+                                  "configured": self.shard_count})
+            return 0
+        n = 0
+        for t, s in zip(self.trackers, shards):
+            n += t.restore(s)
+        return n
+
+    def restore_ready(self) -> bool:
+        return all(t.validated.is_set() for t in self.trackers)
+
+    # --------------------------------------------------------- rebalancing
+
+    def rebalance(self, shard_count: int) -> dict:
+        """Recompute the hash ring for a new shard count and report how
+        much of the tracked inventory moved (the consistent-hashing
+        contract: ~|new-old|/max(new,old), not ~all of it). The caller
+        owns restarting the supervisor with the matching process count;
+        trackers are rebuilt cold — their slices no longer match."""
+        keys = set()
+        for t in self.trackers:
+            with t._lock:
+                keys.update((k[0], k[1]) for k in t._state)
+        for t in self.trackers:
+            t.stop()
+        stats = self.map.rebalance(shard_count, sorted(keys))
+        self.shard_count = int(shard_count)
+        self.trackers = [
+            InventoryTracker(ScopedKube(self.kube, self._owns_pred(k)),
+                             self.opa)
+            for k in range(self.shard_count)]
+        metrics.report_audit_shard_map(self.map.version,
+                                       self.shard_count)
+        metrics.report_audit_shard_rebalanced(stats["moved"])
+        log.info("audit shard map rebalanced",
+                 details={"shards": self.shard_count, **stats})
+        return stats
+
+    # -------------------------------------------------------------- sweeps
+
+    def sweep(self, cap: Optional[int], writer=None,
+              heartbeat: Optional[Callable[[], None]] = None
+              ) -> tuple[list, dict]:
+        """One composed audit round: every shard sweeps its slice
+        concurrently; a shard that dies mid-sweep is retried alone
+        after the supervisor's respawn + slice resync (the surviving
+        shards' runs are kept). Returns (results, stats)."""
+        body = json.dumps({"op": "sweep", "cap": cap}).encode("utf-8")
+        per_shard: dict = {}
+        resweeps = [0] * self.shard_count
+        errors: list = []
+        lock = threading.Lock()
+
+        def one(k: int) -> None:
+            deadline = time.monotonic() + self.sweep_timeout_s
+            while True:
+                try:
+                    status, out = self.supervisor.sweep(
+                        k, body,
+                        timeout_s=max(1.0,
+                                      deadline - time.monotonic()))
+                    if status != 200:
+                        raise KubeError(
+                            f"shard {k} sweep HTTP {status}: "
+                            f"{out[:200]!r}")
+                    payload = json.loads(out.decode("utf-8"))
+                    with lock:
+                        per_shard[k] = payload
+                    if heartbeat is not None:
+                        heartbeat()
+                    return
+                except Exception as e:
+                    if self._stop.is_set() or \
+                            time.monotonic() >= deadline:
+                        with lock:
+                            errors.append((k, e))
+                        return
+                    # the supervisor's monitor respawns the child and
+                    # restores its slice from sync_snapshot; only THIS
+                    # partition re-sweeps
+                    resweeps[k] += 1
+                    metrics.report_audit_shard_resync(k)
+                    log.warning("audit shard sweep failed; waiting "
+                                "for respawn + slice resync",
+                                details={"shard": k, "error": str(e)})
+                    if heartbeat is not None:
+                        heartbeat()
+                    self._stop.wait(0.5)
+
+        threads = [threading.Thread(target=one, args=(k,),
+                                    name=f"audit-shard-sweep-{k}",
+                                    daemon=True)
+                   for k in range(self.shard_count)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        if errors:
+            k, e = errors[0]
+            raise KubeError(f"audit shard {k} sweep failed after "
+                            f"retries: {e}")
+        eval_max = 0.0
+        violations = 0
+        for k in sorted(per_shard):
+            st = (per_shard[k].get("stats") or {})
+            eval_s = float(st.get("eval_s") or 0.0)
+            eval_max = max(eval_max, eval_s)
+            violations += int(st.get("violations") or 0)
+            metrics.report_audit_shard_sweep(
+                k, eval_s, int(st.get("reviews") or 0))
+        results = compose_shard_results(per_shard, writer=writer,
+                                        target=self.TARGET)
+        stats = {"shard_eval_max_s": round(eval_max, 4)}
+        if any(resweeps):
+            stats["shard_resweeps"] = sum(resweeps)
+        return results, stats

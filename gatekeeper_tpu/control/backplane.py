@@ -245,7 +245,7 @@ class BackplaneEngine:
                  mutation=None, max_workers: int = 128,
                  default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S,
                  engine_id: str = "0", library_sink=None,
-                 stats_source=None, preview=None):
+                 stats_source=None, preview=None, auditor=None):
         self.socket_path = socket_path
         self.validation = validation
         self.ns_label = ns_label
@@ -256,6 +256,11 @@ class BackplaneEngine:
         # admission verdict is waiting for
         self.preview = preview
         self._preview_pool = None
+        # audit shard server (control.audit.AuditSliceServer): same
+        # isolation contract as preview — a slice sweep is a multi-
+        # second evaluation and rides its own single-thread executor
+        self.auditor = auditor
+        self._audit_pool = None
         self.default_timeout = default_timeout
         self.engine_id = str(engine_id)
         # L-frame handler (engine children): applies one replicated
@@ -295,6 +300,9 @@ class BackplaneEngine:
         if self.preview is not None:
             self._preview_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="preview-serve")
+        if self.auditor is not None:
+            self._audit_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="auditslice-serve")
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
         self._listener.listen(64)
@@ -386,6 +394,8 @@ class BackplaneEngine:
             self._pool.shutdown(wait=False, cancel_futures=True)
         if self._preview_pool is not None:
             self._preview_pool.shutdown(wait=False, cancel_futures=True)
+        if self._audit_pool is not None:
+            self._audit_pool.shutdown(wait=False, cancel_futures=True)
         with self._conns_lock:
             conns = list(self._conns.values())
             self._conns.clear()
@@ -485,10 +495,11 @@ class BackplaneEngine:
                             tr.add_span("ring_read", t_ring0,
                                         time.monotonic())
                         if review is not _BAD \
-                                and route_path(path) == "preview":
-                            # previews consume raw body bytes (the
-                            # client avoids the ring for them; this is
-                            # the defensive path)
+                                and route_path(path) in ("preview",
+                                                         "auditslice"):
+                            # previews/audit sweeps consume raw body
+                            # bytes (the client avoids the ring for
+                            # them; this is the defensive path)
                             body = jsonio.dumps_bytes(review)
                             review = _UNPARSED
                     else:
@@ -511,7 +522,8 @@ class BackplaneEngine:
                         log.error("backplane inline serve error",
                                   details=str(e))
                         inline = (500, b"")
-                    if inline[0] not in ("eval", "eval-preview"):
+                    if inline[0] not in ("eval", "eval-preview",
+                                         "eval-audit"):
                         # a failed/partial send desyncs the stream:
                         # close and let the frontend reconnect
                         t_send = time.monotonic()
@@ -524,11 +536,13 @@ class BackplaneEngine:
                         continue
                     with self._inflight_lock:
                         self._inflight += 1
-                    # preview sweeps ride their own single-thread
-                    # executor: admission verdicts never queue behind a
-                    # multi-second inventory evaluation
+                    # preview/audit sweeps ride their own single-thread
+                    # executors: admission verdicts never queue behind
+                    # a multi-second inventory evaluation
                     pool = (self._preview_pool
                             if inline[0] == "eval-preview"
+                            else self._audit_pool
+                            if inline[0] == "eval-audit"
                             else self._pool)
                     pool.submit(self._serve, conn, wlock, rid,
                                 timeout_s, deadline, path, body,
@@ -768,6 +782,9 @@ class BackplaneEngine:
         if route == "preview":
             return ("eval-preview", None) if self.preview is not None \
                 else (404, b"")
+        if route == "auditslice":
+            return ("eval-audit", None) if self.auditor is not None \
+                else (404, b"")
         return (404, b"")
 
     def _respond_frame(self, conn, wlock, rings, rid: int, status: int,
@@ -896,6 +913,8 @@ class BackplaneEngine:
         try:
             if route == "preview" and self.preview is not None:
                 return self.preview.handle_http(body)
+            if route == "auditslice" and self.auditor is not None:
+                return self.auditor.handle_http(body)
             if route == "admitlabel" and self.ns_label is not None:
                 out = self.ns_label.handle(review)
             elif route == "admit" and self.validation is not None:
@@ -1143,7 +1162,8 @@ class BackplaneClient:
         rings = self._rings
         roff = None
         if rings is not None and self._ring_ok.is_set() \
-                and not path.startswith("/v1/preview"):
+                and not path.startswith(("/v1/preview",
+                                         "/v1/auditslice")):
             t_w0 = time.monotonic()
             try:
                 roff = rings.req.append(body)
@@ -2061,10 +2081,13 @@ class EngineSupervisor:
             if now - last_poll >= self.POLL_INTERVAL_S:
                 last_poll = now
                 self.poll_stats()
-                from . import metrics
+                self._report_fleet()
 
-                metrics.report_admission_engines(
-                    1 + len(self.engine_ids), 1 + self.alive_count())
+    def _report_fleet(self) -> None:
+        from . import metrics
+
+        metrics.report_admission_engines(
+            1 + len(self.engine_ids), 1 + self.alive_count())
 
     def poll_stats(self) -> None:
         """Pull each engine's relayed metric totals and merge the
@@ -2127,6 +2150,99 @@ class EngineSupervisor:
         from . import metrics
         for k in self.engine_ids:
             metrics.zero_engine_gauges(str(k))
+
+
+class AuditShardSupervisor(EngineSupervisor):
+    """Spawns and supervises the N audit SHARD processes of the sharded
+    inventory plane (`--serve auditslice`): same process-lifecycle,
+    L-frame replication, and stats-merge machinery as the admission
+    engines, plus
+
+      * per-shard sync snapshots: the provider takes the shard id, so a
+        respawned shard is refilled with ITS inventory slice (+ the
+        join/namespace broadcast set), not the whole cluster;
+      * a resync GENERATION per shard: bumped on every successful full
+        sync, so the leader's sweep loop can tell "this shard was
+        reborn since I last talked to it" and re-dispatch only the
+        orphaned partition;
+      * `sweep()`: the Q-frame request that runs one slice sweep on a
+        shard's dedicated audit executor and returns its serialized
+        per-kind results.
+    """
+
+    def __init__(self, shard_count: int, socket_for, spawn_args=(),
+                 snapshot_provider=None, ready_timeout: float = 180.0):
+        super().__init__(range(shard_count), socket_for, spawn_args,
+                         snapshot_provider=None,
+                         ready_timeout=ready_timeout)
+        self.shard_count = int(shard_count)
+        self._shard_snapshot = snapshot_provider  # (k) -> sync op
+        self.generation: dict[int, int] = {k: 0 for k in self.engine_ids}
+
+    def _spawn(self, k: int) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "gatekeeper_tpu.control.engine",
+               "--socket", self.socket_for(k),
+               "--engine-id", f"audit{k}",
+               "--device", str(k),
+               "--serve", "auditslice",
+               "--audit-shard-id", str(k),
+               "--audit-shard-count",
+               str(self.shard_count)] + self.spawn_args
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+
+    def _resync(self, k: int) -> None:
+        provider = self._shard_snapshot
+        if provider is None:
+            self._dirty[k] = False
+            return
+        with self._lock:
+            self._dirty[k] = False
+            try:
+                op = provider(k)
+                op["op"] = "sync"
+                self._ctl[k].control(op, timeout=300.0)
+                self.generation[k] = self.generation.get(k, 0) + 1
+                log.info("audit shard resynced",
+                         details={"shard": k,
+                                  "generation": self.generation[k]})
+            except Exception as e:
+                self._dirty[k] = True
+                log.warning("audit shard resync failed; will retry",
+                            details={"shard": k, "error": str(e)})
+
+    def send(self, k: int, op: dict, timeout: float = 30.0) -> None:
+        """Targeted single-shard library/data op (an owned object's
+        add/remove goes ONLY to its owner; replicate() stays for
+        broadcast ops). Failures mark the shard dirty for a monitor
+        resync — same contract as replicate()."""
+        with self._lock:
+            ctl = self._ctl.get(k)
+            if ctl is None or self._dirty.get(k):
+                return
+            try:
+                ctl.control(op, timeout=timeout)
+            except BackplaneError as e:
+                self._dirty[k] = True
+                log.warning("audit shard op failed; shard marked for "
+                            "resync",
+                            details={"shard": k, "error": str(e)})
+
+    def _report_fleet(self) -> None:
+        from . import metrics
+
+        metrics.report_audit_shard_fleet(self.shard_count,
+                                         self.alive_count())
+
+    def sweep(self, k: int, body: bytes,
+              timeout_s: float = 600.0) -> tuple[int, bytes]:
+        """Run one slice sweep on shard k. Raises BackplaneError when
+        the shard is down/unreachable — the caller owns the respawn-
+        and-retry round trip (the orphaned-partition re-sweep)."""
+        ctl = self._ctl.get(k)
+        if ctl is None:
+            raise BackplaneError(f"audit shard {k} not connected")
+        return ctl.call("/v1/auditslice", body, timeout_s=timeout_s,
+                        deadline=time.monotonic() + timeout_s)
 
 
 # ------------------------------------------------------- frontend process

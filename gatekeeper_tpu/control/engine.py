@@ -151,7 +151,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "evaluation to; -1 = the platform default")
     p.add_argument("--serve", default="admit,admitlabel",
                    help="operations this engine evaluates "
-                        "(admit,admitlabel,mutate)")
+                        "(admit,admitlabel,mutate,auditslice)")
+    p.add_argument("--audit-shard-id", type=int, default=-1,
+                   help="this process's slice of the sharded audit "
+                        "plane (with --serve auditslice); -1 = unsharded")
+    p.add_argument("--audit-shard-count", type=int, default=1)
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--log-denies", action="store_true")
     p.add_argument("--fail-closed", action="store_true")
@@ -214,7 +218,24 @@ def main(argv=None) -> int:
             batch_max_wait=args.mutation_batch_max_wait,
             max_queue=args.admission_max_queue,
             default_timeout=args.admission_default_timeout)
+    auditor = None
+    if "auditslice" in serve:
+        from .audit import AuditSliceServer
+
+        # scope this driver's review building to its consistent-hash
+        # slice; the leader feeds it owned objects + the broadcast set
+        if args.audit_shard_id >= 0 and args.audit_shard_count > 1:
+            driver.set_audit_shard(args.audit_shard_id,
+                                   args.audit_shard_count)
+        auditor = AuditSliceServer(client,
+                                   shard_id=max(args.audit_shard_id, 0),
+                                   shard_count=args.audit_shard_count)
     sink = LibrarySink(client, mutation_system)
+    if auditor is not None:
+        # a respawned shard must 503 sweeps until its slice resync
+        # lands — an empty-library sweep would silently drop this
+        # partition's violations from the composed round
+        auditor.ready = lambda: sink.synced
     # saturation probes, same set the primary registers: this child has
     # no /metrics server, so the probes refresh on each M-frame stats
     # poll instead and the gauges relay to the primary (engine-labeled
@@ -245,7 +266,8 @@ def main(argv=None) -> int:
         default_timeout=args.admission_default_timeout,
         engine_id=args.engine_id,
         library_sink=sink,
-        stats_source=stats_source)
+        stats_source=stats_source,
+        auditor=auditor)
     # refuse admission until the supervisor's first full sync lands:
     # the frontends' router fails those requests over to synced engines
     engine.ready_check = lambda: sink.synced

@@ -314,6 +314,54 @@ class FakeKube:
             cb(event)
 
 
+class ScopedKube:
+    """Read view of a kube client restricted to ONE audit shard's slice.
+
+    An ownership predicate over (gvk, namespace) — the consistent-hash
+    partition key of the sharded audit plane — filters what `list`
+    returns and which watch events reach the subscriber, so the
+    InventoryTracker behind this wrapper maintains watches, resume RVs,
+    and a (uid, rv) state map for exactly its slice and nothing else.
+    Everything the predicate does not govern (discovery, gets, writes,
+    `watch_resume_synchronous`, breaker attributes) delegates untouched.
+
+    The resume-RV consequence of filtering: a tracker only advances its
+    per-GVK RV from events it was shown, so a resumed watch replays the
+    interleaved UNOWNED events again — each filtered out again here.
+    Correctness is unaffected; the replay cost is bounded by the
+    upstream client's own resume window.
+    """
+
+    def __init__(self, inner, owns: Callable[[GVK, str], bool]):
+        self.inner = inner
+        self.owns = owns
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _admit(self, gvk: GVK, obj: dict) -> bool:
+        ns = ((obj or {}).get("metadata") or {}).get("namespace") or ""
+        return self.owns(tuple(gvk), ns)
+
+    def list(self, gvk: GVK, namespace: Optional[str] = None) -> list[dict]:
+        return [o for o in self.inner.list(gvk, namespace)
+                if self._admit(gvk, o)]
+
+    def watch(self, gvk: GVK, callback: Callable[[WatchEvent], None],
+              send_initial: bool = True, resource_version: str = "",
+              on_gap: Optional[Callable[[], None]] = None
+              ) -> Callable[[], None]:
+        gvk = tuple(gvk)
+
+        def deliver(event: WatchEvent) -> None:
+            if self._admit(gvk, event.object):
+                callback(event)
+
+        return self.inner.watch(gvk, deliver, send_initial=send_initial,
+                                resource_version=resource_version,
+                                on_gap=on_gap)
+
+
 # --------------------------------------------------------------- REST client
 
 

@@ -105,6 +105,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "from scratch (self-healing backstop); 0 "
                         "disables the periodic re-encode (the first "
                         "sweep still encodes from scratch)")
+    p.add_argument("--audit-shards", type=int, default=1,
+                   help="partition the audit inventory across N audit "
+                        "engine processes by consistent hash of (GVK, "
+                        "namespace). Each shard owns its slice end to "
+                        "end — encoded feature rows, delta cache, "
+                        "incremental sweep state — in its own process "
+                        "pinned to its own device; the leader tracks "
+                        "per-slice watches, broadcasts join-relevant "
+                        "columns, and composes per-shard sweeps into "
+                        "one bit-equal audit round. 1 = unsharded")
     p.add_argument("--stream-audit", nargs="?", const=True,
                    default=False, type=_parse_bool,
                    help="with --audit-incremental: evaluate dirty rows "
@@ -499,7 +509,36 @@ class Runtime:
         if hasattr(driver, "on_quarantine"):
             driver.on_quarantine = self.manager.template_ctrl.note_quarantine
         self.audit = None
+        self.audit_shards = None   # sharded plane: shard-process supervisor
+        self._shard_plane = None
         if "audit" in operations:
+            shards = max(1, int(getattr(args, "audit_shards", 1) or 1))
+            if shards > 1:
+                # sharded inventory plane: N audit engine children, each
+                # owning a consistent-hash slice of the inventory; this
+                # process stays the leader (watches, routing, status
+                # writes, composition)
+                from .audit import ShardedAuditPlane
+                from .backplane import (
+                    AuditShardSupervisor,
+                    default_socket_path,
+                )
+
+                asock = (getattr(args, "backplane_socket", "")
+                         or default_socket_path()) + ".audit"
+                shard_spawn = ["--log-level",
+                               getattr(args, "log_level", "INFO")]
+                if getattr(args, "fault_injection", ""):
+                    shard_spawn += ["--fault-injection",
+                                    args.fault_injection]
+                self.audit_shards = AuditShardSupervisor(
+                    shards,
+                    socket_for=lambda k, s=asock: f"{s}.{k}",
+                    spawn_args=shard_spawn,
+                    snapshot_provider=self._audit_shard_snapshot)
+                self._shard_plane = ShardedAuditPlane(
+                    self.kube_gated, self.opa, self.audit_shards,
+                    shards)
             # the guarded client: status writes ride the shared breaker/
             # retry budget; reads and the tracker's watches pass through.
             # Under leader election only the lease holder sweeps.
@@ -517,7 +556,8 @@ class Runtime:
                 stream_audit=getattr(args, "stream_audit", False),
                 stream_window_s=getattr(args, "stream_window_ms",
                                         25.0) / 1000.0,
-                stream_max_batch=getattr(args, "stream_max_batch", 512))
+                stream_max_batch=getattr(args, "stream_max_batch", 512),
+                shard_plane=self._shard_plane)
         # what-if preview (POST /v1/preview + the dedicated
         # --preview-port listener): candidate templates/constraints
         # evaluated over this process's cached inventory, compiled
@@ -744,6 +784,12 @@ class Runtime:
             self.preview_server = WebhookServer(
                 None, None, port=preview_port,
                 preview=self.preview_engine)
+        if self._shard_plane is not None:
+            # AFTER the engines block above: attach() chains onto
+            # whatever on_change hook is installed (the admission-engine
+            # fan-out when --admission-engines > 1), so both planes see
+            # every library op
+            self._shard_plane.attach()
         self.upgrade = UpgradeManager(self.kube)
         self.metrics_server = None
         self.health = None
@@ -777,6 +823,18 @@ class Runtime:
             # (_deep_plain); marshal loads ~2x faster than pickle and
             # restore latency is the warm boot
             blob_codecs={"inventory": "marshal"})
+        if self._shard_plane is not None:
+            # observability section: which ring/fleet produced the
+            # tracker slices riding the inventory blob. restore_state
+            # discards slices saved under a different shard count, so
+            # operators can read WHY a warm boot went cold here.
+            plane, sup = self._shard_plane, self.audit_shards
+            self.snapshots.add_provider(
+                "audit_shards",
+                lambda: {"shard_count": plane.shard_count,
+                         "map_version": plane.map.version,
+                         "generations": {str(k): v for k, v
+                                         in sup.generation.items()}})
 
     def _snapshot_providers(self) -> tuple:
         driver = getattr(self.opa, "driver", None)
@@ -792,7 +850,8 @@ class Runtime:
 
         providers["library"] = library
         blobs = {}
-        if self.audit is not None and self.audit.incremental:
+        if self.audit is not None and (self.audit.incremental
+                                       or self._shard_plane is not None):
             # the inventory rides the BLOB (pickle) path: the frozen
             # in-memory tree round-trips without the O(cluster)
             # re-freeze a JSON restore would pay
@@ -834,7 +893,8 @@ class Runtime:
             log.info("library restored", details=out)
 
         restore_section(self.statestore, "library", apply_library)
-        if self.audit is not None and self.audit.incremental:
+        if self.audit is not None and (self.audit.incremental
+                                       or self._shard_plane is not None):
             def apply_inventory(snap):
                 n = 0
                 if hasattr(driver, "inventory_restore"):
@@ -875,6 +935,14 @@ class Runtime:
         if self.mutation_system is not None:
             snap["mutators"] = self.mutation_system.sources()
         return snap
+
+    def _audit_shard_snapshot(self, k: int) -> dict:
+        """The per-shard sync op the AuditShardSupervisor sends a fresh
+        (or respawned) shard child: full library + that shard's
+        inventory slice rebuilt from the leader's tree (owned objects
+        whole, join partners column-pruned). The slice heals without a
+        cluster re-list — tracker state never left the leader."""
+        return self._shard_plane.sync_snapshot(k)
 
     # ---------------------------------------------------- debug endpoints
 
@@ -1080,6 +1148,14 @@ class Runtime:
                     self.health.add_liveness(
                         "mutation-batcher",
                         self.mutation_handler.batcher.healthy)
+                if self.audit_shards is not None:
+                    # same contract as the admission-engine supervisor:
+                    # a dead shard mid-respawn is degraded-but-healing;
+                    # only a dead MONITOR (nothing left to respawn it)
+                    # pulls readiness
+                    self.health.add_readiness(
+                        "audit-shard-supervisor",
+                        self.audit_shards.monitoring)
                 if self.audit:
                     self.health.add_liveness("audit-loop",
                                              self.audit.healthy)
@@ -1113,6 +1189,11 @@ class Runtime:
             self.elector.start()
         self.upgrade.upgrade()
         self.manager.start()
+        if self.audit_shards is not None:
+            # shard children before the audit loop: the supervisor's
+            # first resync fills each slice, and the first sweep's
+            # dispatch retries through any shard still syncing
+            self.audit_shards.start()
         if self.audit:
             self.audit.start()
         if self.cert_rotator:
@@ -1195,6 +1276,10 @@ class Runtime:
             self.backplane.stop()
         if self.audit:
             self.audit.stop()
+        if self.audit_shards is not None:
+            # after the audit loop: no sweep can be dispatched into a
+            # stopping fleet
+            self.audit_shards.stop()
         if self.snapshots is not None:
             # SIGTERM drain snapshot: the replacement pod warm-boots
             # from state at most seconds old

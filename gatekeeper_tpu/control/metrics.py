@@ -1244,6 +1244,65 @@ def report_audit_shard(stage: str, shard: int, seconds: float) -> None:
                      stage=stage, shard=str(shard))
 
 
+def report_audit_shard_fleet(configured: int, alive: int) -> None:
+    """The sharded inventory plane's process census (AuditShard-
+    Supervisor): configured vs currently-alive shard children. A
+    sustained gap means a shard is crash-looping and its partition is
+    being re-swept by the leader every round."""
+    REGISTRY.gauge_set("gatekeeper_tpu_audit_shard_processes",
+                       "Configured audit shard processes",
+                       configured, state="configured")
+    REGISTRY.gauge_set("gatekeeper_tpu_audit_shard_processes",
+                       "Configured audit shard processes",
+                       alive, state="alive")
+
+
+def report_audit_shard_ownership(shard: int, objects: int) -> None:
+    """Objects currently owned by one audit shard's inventory slice.
+    Watch the SKEW, not the level: consistent hashing balances
+    (GVK, namespace) partitions, so one towering shard means one hot
+    namespace or a cluster-scoped kind pinning its whole population."""
+    REGISTRY.gauge_set("gatekeeper_tpu_audit_shard_owned_objects",
+                       "Inventory objects owned per audit shard",
+                       objects, shard=str(shard))
+
+
+def report_audit_shard_map(version: int, shards: int) -> None:
+    """The leader's current shard-map assignment epoch. Bumps on every
+    (re)build of the consistent-hash ring — a map that keeps bumping
+    is a plane that keeps resizing."""
+    REGISTRY.gauge_set("gatekeeper_tpu_audit_shard_map_version",
+                       "Audit shard map assignment epoch", version)
+    REGISTRY.gauge_set("gatekeeper_tpu_audit_shard_map_shards",
+                       "Audit shard count in the active map", shards)
+
+
+def report_audit_shard_rebalanced(moved: int) -> None:
+    REGISTRY.counter_add("gatekeeper_tpu_audit_shard_rebalanced_total",
+                         "Partitions moved between audit shards by "
+                         "shard-map rebalances", float(moved))
+
+
+def report_audit_shard_resync(shard: int) -> None:
+    REGISTRY.counter_add("gatekeeper_tpu_audit_shard_resyncs_total",
+                         "Full slice resyncs per audit shard (respawn "
+                         "heals and replication-failure repairs)",
+                         shard=str(shard))
+
+
+def report_audit_shard_sweep(shard: int, seconds: float,
+                             reviews: int) -> None:
+    """One shard PROCESS's slice sweep (distinct from the mesh slab
+    histogram above, which times device shards within one process)."""
+    REGISTRY.observe("gatekeeper_tpu_audit_shard_sweep_seconds",
+                     "Wall time of one audit shard process's slice "
+                     "sweep", seconds, buckets=STAGE_BUCKETS,
+                     shard=str(shard))
+    REGISTRY.gauge_set("gatekeeper_tpu_audit_shard_swept_reviews",
+                       "Reviews evaluated in the last slice sweep per "
+                       "audit shard", reviews, shard=str(shard))
+
+
 def report_trace(plane: str) -> None:
     REGISTRY.counter_add("gatekeeper_tpu_traces_total",
                          "Sampled traces completed per plane",

@@ -79,6 +79,9 @@ STAGES: dict[str, tuple[str, str]] = {
     "status_write_stream": (
         "audit", "streamed status-write wall attributed to the sweep "
         "that overlapped it"),
+    "shard_sweeps": (
+        "audit", "sharded plane: per-shard slice sweep dispatch + "
+        "composition into one audit round (leader side)"),
 }
 
 STAGE_NAMES = frozenset(STAGES)

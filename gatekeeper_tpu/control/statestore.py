@@ -308,6 +308,20 @@ class SnapshotManager:
         self._thread: Optional[threading.Thread] = None
         self.last_saved: Optional[float] = None
 
+    def add_provider(self, section: str, fn: Callable[[], Any],
+                     blob: bool = False,
+                     codec: Optional[str] = None) -> None:
+        """Register a section after construction (subsystems built
+        later than the manager — e.g. the sharded-audit plane — attach
+        their sections here instead of threading providers through
+        Runtime.__init__ ordering)."""
+        if blob:
+            self.blob_providers[section] = fn
+            if codec:
+                self.blob_codecs[section] = codec
+        else:
+            self.providers[section] = fn
+
     def start(self) -> None:
         if self.interval_s <= 0:
             return

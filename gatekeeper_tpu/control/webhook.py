@@ -94,6 +94,8 @@ def route_path(path: str) -> Optional[str]:
         return "mutate"
     if path.startswith("/v1/preview"):
         return "preview"
+    if path.startswith("/v1/auditslice"):
+        return "auditslice"
     return None
 
 

@@ -322,6 +322,9 @@ class TpuDriver(RegoDriver):
         self._join_progs: dict[str, Any] = {}
         self._join_compiled: dict[str, Any] = {}
         self._modules: dict[str, A.Module] = {}
+        # (shard_id, shard_count) when this driver sweeps one slice of
+        # a sharded audit plane (set_audit_shard); None = whole plane
+        self._audit_shard = None
         self._derived_cols: dict[str, list[int]] = {}  # kind -> global cols
         # generation counters for cache invalidation
         self._constraint_gen = 0
@@ -1010,6 +1013,252 @@ class TpuDriver(RegoDriver):
                 jc = None
         self._join_compiled[kind] = jc
         return jc
+
+    # ------------------------------------------------------ audit sharding
+
+    def set_audit_shard(self, shard_id: Optional[int],
+                        shard_count: int = 1, vnodes: int = 64) -> None:
+        """Scope this driver's audit review set to one consistent-hash
+        slice of the inventory (control/shardmap.py). shard_id=None or
+        shard_count<=1 clears the filter. The data TREE is not filtered
+        here — the sharded plane feeds each shard its owned objects
+        plus the join/namespace broadcast set, and review building is
+        what decides which objects this shard actually sweeps."""
+        if shard_id is None or shard_count <= 1:
+            self._audit_shard = None
+            self.set_audit_review_filter(None)
+            return
+        from ..control.shardmap import ShardMap
+
+        smap = ShardMap(shard_count, vnodes)
+        sid = int(shard_id)
+        self._audit_shard = (sid, int(shard_count))
+
+        def owns(gv: str, kind: str, namespace: str) -> bool:
+            group, _, version = gv.rpartition("/")
+            return smap.owner((group, version, kind), namespace) == sid
+
+        self.set_audit_review_filter(owns)
+
+    def audit_broadcast_spec(self) -> dict:
+        """What the leader must replicate to EVERY shard for non-owned
+        objects, derived from the loaded templates:
+
+          {"full": bool,             # give up: broadcast all, whole
+           "kinds": {kind: columns}} # kind "*" = any kind; columns:
+                                     # list of path tuples, or None =
+                                     # whole object
+
+        Join templates (ir/join.py) reach other objects only through
+        data.inventory generator bindings (`other := data.inventory.
+        namespace[ns][apiv][kind][name]`) and then read a handful of
+        columns off the bound object — directly (`other.spec.selector`)
+        or through helper functions (`selector_key(other)`). Tracing
+        those reads (including one level of helper-param dataflow)
+        yields exactly the columns a foreign shard's copy must carry —
+        the sik join-key inputs — so 10M-object broadcasts ship pruned
+        skeletons, not manifests. Anything the walk cannot prove
+        degrades conservatively (whole object, or full-inventory
+        broadcast for interpreted data-reading templates): sharding
+        must never change a verdict. Namespace objects are always
+        broadcast whole — namespaceSelector matching reads their
+        labels on every shard."""
+        from .join import _split_inv_ref as _join_split
+
+        spec: dict = {"full": False, "kinds": {"Namespace": None}}
+
+        def add_kind(kind: str, columns) -> None:
+            cur = spec["kinds"].get(kind)
+            if kind not in spec["kinds"]:
+                cur = []
+                spec["kinds"][kind] = cur
+            if columns is None or cur is None:
+                spec["kinds"][kind] = None
+                return
+            for c in columns:
+                if c not in cur:
+                    cur.append(c)
+
+        for prog in self._join_progs.values():
+            if prog is None:
+                continue
+            rules_by_name: dict[str, list] = {}
+            for r in prog.module.rules:
+                rules_by_name.setdefault(r.name, []).append(r)
+            memo: dict = {}
+
+            def var_columns(rule, vname: str, stack):
+                """Column paths `rule` reads off the object bound to
+                `vname`; None when the object escapes the analysis
+                (used bare, aliased, or fed to an unknown function)."""
+                cols: list = []
+                whole = [False]
+
+                def walk(t) -> None:
+                    if isinstance(t, A.Ref) and \
+                            isinstance(t.base, A.Var) and \
+                            t.base.name == vname:
+                        prefix = []
+                        for a in t.args:
+                            if isinstance(a, A.Scalar) and \
+                                    isinstance(a.value, str):
+                                prefix.append(a.value)
+                            else:
+                                break
+                        if prefix:
+                            cols.append(tuple(prefix))
+                        else:
+                            whole[0] = True
+                        for a in t.args:
+                            walk(a)
+                        return
+                    if isinstance(t, A.Call):
+                        for i, a in enumerate(t.args):
+                            if isinstance(a, A.Var) and a.name == vname:
+                                c = param_columns(t.fn, i, stack)
+                                if c is None:
+                                    whole[0] = True
+                                else:
+                                    cols.extend(c)
+                            else:
+                                walk(a)
+                        return
+                    if isinstance(t, A.Var):
+                        if t.name == vname:
+                            whole[0] = True
+                        return
+                    if isinstance(t, (list, tuple)):
+                        for x in t:
+                            walk(x)
+                    elif hasattr(t, "__dataclass_fields__"):
+                        for f in t.__dataclass_fields__:
+                            walk(getattr(t, f))
+
+                walk(rule.key)
+                walk(rule.value)
+                for lit in rule.body or ():
+                    e = lit.expr
+                    if isinstance(e, (A.Assign, A.Unify)):
+                        # skip the generator binding itself; flag any
+                        # OTHER alias of the object as an escape
+                        sides = (e.lhs, e.rhs)
+                        if any(isinstance(s, A.Var) and s.name == vname
+                               for s in sides):
+                            if any(_inv_gen_of(s) for s in sides):
+                                continue
+                            whole[0] = True
+                            continue
+                    walk(lit)
+                return None if whole[0] else cols
+
+            def param_columns(fn, idx: int, stack):
+                """Columns function `fn` reads off positional param
+                `idx`, across all its clauses; None = escapes."""
+                if len(fn) != 1 or fn[0] not in rules_by_name:
+                    return None
+                key = (fn[0], idx)
+                if key in memo:
+                    return memo[key]
+                if key in stack:
+                    return []  # recursive clause adds nothing new
+                cols: list = []
+                for r in rules_by_name[fn[0]]:
+                    if not r.args or idx >= len(r.args) or \
+                            not isinstance(r.args[idx], A.Var):
+                        memo[key] = None
+                        return None
+                    c = var_columns(r, r.args[idx].name, stack + (key,))
+                    if c is None:
+                        memo[key] = None
+                        return None
+                    cols.extend(c)
+                memo[key] = cols
+                return cols
+
+            def _inv_gen_of(t):
+                """(kind-or-*, ok) when t is an inventory object ref
+                addressing exactly one object; None otherwise."""
+                if not (isinstance(t, A.Ref) and isinstance(t.base, A.Var)
+                        and t.base.name == "data" and t.args
+                        and isinstance(t.args[0], A.Scalar)
+                        and t.args[0].value == "inventory"):
+                    return None
+                split = _join_split(t)
+                if split is None or split[1]:
+                    return ("*", False)  # odd shape: give up later
+                scope = t.args[1].value
+                kind_arg = t.args[4 if scope == "namespace" else 3]
+                if isinstance(kind_arg, A.Scalar) and \
+                        isinstance(kind_arg.value, str):
+                    return (kind_arg.value, True)
+                return ("*", True)
+
+            bound_refs: set = set()
+            for rule in prog.module.rules:
+                for lit in rule.body or ():
+                    e = lit.expr
+                    if not isinstance(e, (A.Assign, A.Unify)):
+                        continue
+                    for var_side, ref_side in ((e.lhs, e.rhs),
+                                               (e.rhs, e.lhs)):
+                        gen = _inv_gen_of(ref_side)
+                        if gen is None or not isinstance(var_side,
+                                                         A.Var):
+                            continue
+                        bound_refs.add(id(ref_side))
+                        kind, ok = gen
+                        if not ok:
+                            spec["full"] = True
+                            continue
+                        add_kind(kind, var_columns(rule, var_side.name,
+                                                   ()))
+            # any inventory ref NOT consumed as a generator binding
+            # (inline residual reads, negated absence checks, odd
+            # shapes) is handled from its own split — or gives up
+
+            def sweep(t) -> None:
+                if isinstance(t, A.Ref) and isinstance(t.base, A.Var) \
+                        and t.base.name == "data" and t.args \
+                        and isinstance(t.args[0], A.Scalar) \
+                        and t.args[0].value == "inventory":
+                    if id(t) not in bound_refs:
+                        split = _join_split(t)
+                        kind = None
+                        if split is not None:
+                            scope = t.args[1].value
+                            ka = t.args[4 if scope == "namespace"
+                                        else 3]
+                            kind = ka.value \
+                                if isinstance(ka, A.Scalar) and \
+                                isinstance(ka.value, str) else "*"
+                        if split is None:
+                            spec["full"] = True
+                        else:
+                            prefix = []
+                            for a in split[1]:
+                                if isinstance(a, A.Scalar) and \
+                                        isinstance(a.value, str):
+                                    prefix.append(a.value)
+                                else:
+                                    break
+                            add_kind(kind,
+                                     [tuple(prefix)] if prefix
+                                     else None)
+                if isinstance(t, (list, tuple)):
+                    for x in t:
+                        sweep(x)
+                elif hasattr(t, "__dataclass_fields__"):
+                    for f in t.__dataclass_fields__:
+                        sweep(getattr(t, f))
+
+            sweep(prog.module.rules)
+        # interpreted (non-join) templates that read `data` see the raw
+        # tree — a shard's partial tree would change their answers
+        for kind in self._modules:
+            if kind not in self._join_progs and \
+                    self._template_reads_data(kind):
+                spec["full"] = True
+        return spec
 
     # ---------------------------------------------------------------- data
 

@@ -69,6 +69,7 @@ def test_chart_values_reach_rendered_objects():
     vals = default_values()
     vals["replicas"] = 3
     vals["auditInterval"] = 123
+    vals["auditShards"] = 4
     vals["logLevel"] = "DEBUG"
     vals["image"]["release"] = "v9.9"
     vals["resources"]["limits"]["memory"] = "4Gi"
@@ -82,6 +83,7 @@ def test_chart_values_reach_rendered_objects():
     ac = audit["spec"]["template"]["spec"]["containers"][0]
     assert ac["image"] == "gatekeeper-tpu:v9.9"
     assert "--audit-interval=123" in ac["args"]
+    assert "--audit-shards=4" in ac["args"]
     assert "--log-level=DEBUG" in ac["args"]
     assert any("--constraint-violations-limit=20" == a for a in ac["args"])
     assert ac["resources"]["limits"]["memory"] == "4Gi"

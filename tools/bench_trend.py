@@ -61,6 +61,9 @@ _HIGHER_BETTER = {
     "grpc_stream_reviews_per_sec",
     "backplane_bulk_reviews_per_sec",
     "edge_vs_engine_ratio",
+    # sharded inventory plane (ISSUE 16): one composed audit round's
+    # throughput over the process-sharded plane
+    "sharded_audit_objects_per_sec", "sharded_objects_per_sec",
 }
 
 # measured but NOT gated by --check: cold-start and first-call numbers
@@ -95,7 +98,8 @@ _CONFIG_MIRRORS = {
     "violation_detection_ms", "detection_speedup_p99",
     "whatif_preview_s", "mesh_audit_s", "mesh_audit_vs_single_device",
     "compile_widening_speedup", "general_library_compiled_fraction",
-    "warm_first_audit_s",
+    "warm_first_audit_s", "sharded_objects_per_sec",
+    "sharded_sweep_wall_s",
 }
 
 def _ungated(name: str) -> bool:
@@ -110,7 +114,7 @@ _SKIP = {
     "violations_materialized", "baseline_evals_per_sec",
     "baseline_full_audit_s", "n_devices", "config", "violations",
     "host_cores", "workers", "device_compiled_kinds", "total_kinds",
-    "slo_met", "setup_s",
+    "slo_met", "setup_s", "best_shards", "sharded_best_shards",
 }
 
 
@@ -247,7 +251,11 @@ def load_rounds(paths: list[str]) -> list[dict]:
         metrics, errors, units = flatten_round(doc)
         rounds.append({"round": label, "path": path,
                        "metrics": metrics, "errors": errors,
-                       "units": units})
+                       "units": units,
+                       # execution platform (bench.py `jax_backend`):
+                       # part of the comparability key — None for
+                       # rounds that predate the field
+                       "platform": doc.get("jax_backend")})
     return rounds
 
 
@@ -261,11 +269,13 @@ def find_regressions(rounds: list[dict],
     `latest_only` gates only each metric's newest data point (the
     --check contract: history that already shipped can't fail CI
     forever); False flags every historical regression for the report."""
-    series: dict[str, list[tuple[int, float, Optional[str]]]] = {}
+    series: dict[str, list[tuple[int, float, Optional[str],
+                                 Optional[str]]]] = {}
     for i, rnd in enumerate(rounds):
         for name, v in rnd["metrics"].items():
             series.setdefault(name, []).append(
-                (i, v, (rnd.get("units") or {}).get(name)))
+                (i, v, (rnd.get("units") or {}).get(name),
+                 rnd.get("platform")))
     out = []
     for name, points in sorted(series.items()):
         d = direction(name)
@@ -287,12 +297,20 @@ def find_regressions(rounds: list[dict],
         else:
             checks = range(1, len(points))
         for j in checks:
-            i, v, unit = points[j]
+            i, v, unit, plat = points[j]
             # a round is only comparable against priors measured at
             # the SAME unit string — the bench encodes workload scale
             # and methodology there (r04 configs ran reduced scale,
-            # r05 full: not a regression, a series restart)
-            prior = [pv for _pi, pv, pu in points[:j] if pu == unit]
+            # r05 full: not a regression, a series restart) — AND on
+            # the same execution platform (`jax_backend`): r03/r04 ran
+            # on accelerator hosts, r06 on a 1-core CPU container;
+            # device-bound walls differ ~20x by host class alone.
+            # Rounds predating the field (platform None) only compare
+            # among themselves: comparability can't be assumed, and a
+            # host-class move must restart the baseline, not fail
+            # every future --check forever.
+            prior = [pv for _pi, pv, pu, pp in points[:j]
+                     if pu == unit and pp == plat]
             if not prior:
                 continue
             best = min(prior) if d == "lower" else max(prior)
@@ -328,11 +346,15 @@ def render_markdown(rounds: list[dict], regressions: list[dict],
     names = sorted({n for r in rounds for n in r["metrics"]},
                    key=lambda n: (direction(n) is None, n))
     lines = ["# Benchmark trend", ""]
-    lines.append(f"Rounds: {', '.join(r['round'] for r in rounds)}  ")
+    lines.append("Rounds: " + ", ".join(
+        r["round"] + (f" [{r['platform']}]" if r.get("platform")
+                      else "") for r in rounds) + "  ")
     lines.append(f"Regression threshold: >{threshold:.0%} vs the best "
                  "prior round (latest round gated; `↓` lower is "
                  "better, `↑` higher is better, unmarked metrics are "
-                 "informational).")
+                 "informational). Rounds compare only within the same "
+                 "`jax_backend` platform — a host-class change "
+                 "restarts every series baseline.")
     lines.append("")
     header = "| metric | " + " | ".join(r["round"] for r in rounds) + " |"
     lines.append(header)

@@ -23,6 +23,7 @@ import argparse
 import json
 import os
 import sys
+from typing import Optional
 
 from .core import Project, load_baseline, ratchet, run_checkers, \
     write_baseline
@@ -33,14 +34,56 @@ def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(here))
 
 
-def locktrace_gate(report_path: str) -> int:
+def _gated_lock_sites(root: str) -> set:
+    """Allocation sites whose held-across-blocking findings GATE.
+
+    A `# locktrace: gate` comment on a lock's construction line is the
+    code declaring "nothing blocking may ever run under me" (e.g. the
+    audit _sweep_lock, which every status-write path must exit before
+    any kube retry backoff can sleep). Returns {(relpath, lineno)}."""
+    sites = set()
+    pkg = os.path.join(root, "gatekeeper_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    for lineno, line in enumerate(f, 1):
+                        if "# locktrace: gate" in line:
+                            rel = os.path.relpath(path, root)
+                            sites.add((rel.replace(os.sep, "/"),
+                                       lineno))
+            except OSError:
+                continue
+    return sites
+
+
+def _site_gated(site: str, gated: set) -> bool:
+    """Locktrace sites are `<co_filename>:<lineno>` (absolute or
+    relative, depending on how the process was launched); match on
+    path SUFFIX + exact line."""
+    path, sep, lineno = site.rpartition(":")
+    if not sep or not lineno.isdigit():
+        return False
+    path = path.replace(os.sep, "/")
+    n = int(lineno)
+    return any(n == gl and (path == gp or path.endswith("/" + gp))
+               for gp, gl in gated)
+
+
+def locktrace_gate(report_path: str, root: Optional[str] = None) -> int:
     """Read a locktrace JSONL dump (one finding per line, possibly
-    appended by several processes) and fail on cycles/inversions."""
+    appended by several processes) and fail on cycles/inversions, plus
+    held-across-blocking events under locks marked `# locktrace: gate`
+    (every other held-across-blocking event stays advisory)."""
     if not os.path.exists(report_path):
         print(f"gklint: no locktrace dump at {report_path} "
               "(no traced process ran, or none found anything)")
         return 0
-    bad = advisory = 0
+    gated_sites = _gated_lock_sites(root or _repo_root())
+    bad = gated = advisory = 0
     with open(report_path, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
@@ -55,14 +98,31 @@ def locktrace_gate(report_path: str) -> int:
                 bad += 1
                 print(f"LOCKTRACE {kind}: {ent.get('detail')}")
             elif kind == "held_across_blocking":
-                # advisory: a bounded sleep under a lock is a smell,
-                # not a deadlock — report, never gate
-                advisory += 1
-                print(f"LOCKTRACE advisory held-across-blocking: "
-                      f"{ent.get('detail')}")
-    if bad:
-        print(f"gklint: {bad} locktrace cycle/inversion finding(s) — "
-              "potential deadlock under the chaos suite")
+                sites = ent.get("sites") or []
+                if isinstance(sites, str):
+                    sites = [sites]
+                if any(_site_gated(s, gated_sites) for s in sites):
+                    # the held lock's allocation is marked
+                    # `# locktrace: gate`: blocking under it is a
+                    # regression, not a smell
+                    gated += 1
+                    print(f"LOCKTRACE GATED held-across-blocking: "
+                          f"{ent.get('detail')}")
+                else:
+                    # advisory: a bounded sleep under an unmarked lock
+                    # is a smell, not a deadlock — report, never gate
+                    advisory += 1
+                    print(f"LOCKTRACE advisory held-across-blocking: "
+                          f"{ent.get('detail')}")
+    if bad or gated:
+        if bad:
+            print(f"gklint: {bad} locktrace cycle/inversion "
+                  "finding(s) — potential deadlock under the chaos "
+                  "suite")
+        if gated:
+            print(f"gklint: {gated} held-across-blocking finding(s) "
+                  "under gate-marked lock(s) — blocking calls "
+                  "regressed under a lock declared blocking-free")
         return 1
     print(f"gklint: locktrace clean ({advisory} advisory "
           "held-across-blocking event(s))")
@@ -83,7 +143,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.locktrace_report:
-        return locktrace_gate(args.locktrace_report)
+        return locktrace_gate(args.locktrace_report, root=args.root)
 
     if args.stages_md:
         import runpy
