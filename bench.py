@@ -227,7 +227,7 @@ def main() -> int:
 
     configs = {}
     want_configs = ["1", "2", "3", "5", "6", "7", "9", "10", "11", "12",
-                    "13"]
+                    "13", "14"]
     try:
         # FULL scale by default: BENCH_r0N.json must carry the
         # 10k-object and 50k-pod numbers, not reduced-scale stand-ins

@@ -45,6 +45,12 @@ One JSON line per config:
      the full composed-round wall (objects_per_sec headline), and the
      steady incremental round under ~0.1% routed churn, vs the
      unsharded single-client sweep
+  #14 adaptive serving controller: an edge-bound closed loop from cold
+     mis-tuned defaults (max_wait 50ms) with the controller armed must
+     converge to within ~10% of the config-5 hand-tuned optimum's rps
+     with the actuation flip count gated, survive a mid-burst engine
+     kill with zero unanswered admissions, and restore the baseline
+     knobs bit-exactly on the kill switch
 
 All audits run steady-state through client.audit() (warm caches), same
 contract as bench.py. Run: python bench_configs.py [1 2 3 5 6 7 8 9]
@@ -2460,6 +2466,236 @@ def config13():
     print(json.dumps(out))
 
 
+# -------------------------------------------------------------- config 14
+
+
+def config14():
+    """Adaptive serving controller (the PR-18 tentpole), three legs on
+    the in-process closed-loop harness:
+
+    Leg A — reference: the config-5 hand-tuned optimum (max_wait=3ms,
+    max_batch=256) under an 8-thread closed loop -> `ref_rps`. Low
+    concurrency puts the plane in the edge-bound trickle regime PR
+    14's scrape showed for the real deployment — the regime the
+    controller's max_wait rule exists for (at 64 threads the plane is
+    flusher-bound and batch amortization, not the wait window, sets
+    the throughput).
+
+    Leg B — convergence: the SAME loop against deliberately mis-tuned
+    cold defaults (max_wait=50ms, max_batch=1024 — every batch seals
+    on the wait window at ~1% fill, so the wait is pure added latency)
+    measured first WITHOUT the controller (`cold_rps`, the gap the
+    loop must close), then with an armed AdaptiveController ticked on
+    a fixed cadence until `max_wait` lands at its floor. The steady
+    window after convergence must reach within ~10% of `ref_rps`
+    (`adaptive_converged_frac`, the headline — gate >= 0.9) with zero
+    sustained oscillation (actuation-direction flip count gated <= 2)
+    and the degradation ladder never leaving rung 0. The kill switch
+    (`disarm(restore=True)`) must then restore every knob to the cold
+    baseline bit-exactly.
+
+    Leg C — chaos: the test_resilience engine-kill storm with the
+    controller ARMED on the serving batcher: a 60-caller admission
+    burst over FrontendServer -> BackplaneClient -> BackplaneEngine,
+    the engine aborted (the in-process kill -9 analog) mid-burst with
+    the `backplane.engine` fault point held down — zero unanswered
+    admissions, every caller gets an AdmissionReview per the fail-open
+    stance, and the armed controller disarms clean afterwards."""
+    import http.client
+    import threading
+
+    from gatekeeper_tpu.control.adaptive import AdaptiveController
+    from gatekeeper_tpu.control.backplane import (
+        BackplaneClient,
+        BackplaneEngine,
+        FrontendServer,
+        default_socket_path,
+    )
+    from gatekeeper_tpu.control.webhook import (
+        MicroBatcher,
+        ValidationHandler,
+    )
+    from gatekeeper_tpu.utils.faults import FAULTS
+
+    _, client = _general_library_client()
+    reviews = _mixed_reviews(max(64, int(256 * SCALE)), seed=14)
+    n_threads = 8
+    window_s = max(1.0, 2.5 * min(SCALE, 1.0))
+    tick_s = 0.2
+
+    def closed_loop(batcher, stop_evt, counts):
+        def worker(k):
+            j = 0
+            while not stop_evt.is_set():
+                batcher.submit(reviews[(k * 131 + j) % len(reviews)])
+                j += 1
+                counts[k] += 1  # per-thread slot: no lock on the hot path
+        ths = [threading.Thread(target=worker, args=(k,), daemon=True)
+               for k in range(n_threads)]
+        for t in ths:
+            t.start()
+        return ths
+
+    def measure_window(counts, duration):
+        before = sum(counts)
+        t0 = time.time()
+        time.sleep(duration)
+        return (sum(counts) - before) / (time.time() - t0)
+
+    # --- leg A: hand-tuned reference (config-5 closed-loop optimum)
+    batcher_a = MicroBatcher(client, max_wait=0.003, max_batch=256)
+    batcher_a.submit(reviews[0])  # warm the flusher + XLA programs
+    stop_a = threading.Event()
+    counts_a = [0] * n_threads
+    ths = closed_loop(batcher_a, stop_a, counts_a)
+    time.sleep(0.3)  # let the loop fill before the timed window
+    ref_rps = measure_window(counts_a, window_s)
+    stop_a.set()
+    for t in ths:
+        t.join(10)
+    batcher_a.stop()
+
+    # --- leg B: cold mis-tuned defaults, then the armed controller
+    cold = {"max_wait": 0.05, "max_batch": 1024, "max_queue": 0}
+    batcher_b = MicroBatcher(client, **cold)
+    ctrl = AdaptiveController(batcher=batcher_b, interval=999.0,
+                              cooldown_s=0.1, hysteresis_s=1.0,
+                              relax_after_s=1e9, min_seals=2)
+    stop_b = threading.Event()
+    counts_b = [0] * n_threads
+    ths = closed_loop(batcher_b, stop_b, counts_b)
+    time.sleep(0.3)
+    cold_rps = measure_window(counts_b, window_s)
+    ctrl.arm()  # interval=999: the tick thread parks; ticks are manual
+    ctrl._sample(time.monotonic())  # prime counter deltas: leg A's
+    # seal/shed series live in the same process registry — the first
+    # sample must not read their lifetime totals as one tick's delta
+    ticks = 0
+    wait_floor = ctrl.knobs["batch_max_wait"].lo
+    while batcher_b.max_wait > 1.5 * wait_floor and ticks < 60:
+        time.sleep(tick_s)
+        ctrl.tick()
+        ticks += 1
+    converged_rps = measure_window(counts_b, window_s)
+    stop_b.set()
+    for t in ths:
+        t.join(10)
+    conv_frac = converged_rps / max(ref_rps, 1e-9)
+    flips = ctrl.flip_count()
+    rung_after = ctrl.ladder.rung
+    converged_wait = batcher_b.max_wait
+    trail = ctrl.actuations()[-12:]  # already wire-shape dicts
+    ctrl.disarm(restore=True)  # the kill switch: bit-exact restore
+    restore_exact = (batcher_b.max_wait == cold["max_wait"]
+                     and batcher_b.max_batch == cold["max_batch"]
+                     and batcher_b.max_queue == cold["max_queue"])
+    batcher_b.stop()
+    assert conv_frac >= 0.9, \
+        f"controller converged to {conv_frac:.2f}x of the hand-tuned " \
+        f"reference (gate: within ~10%)"
+    assert flips <= 2, f"actuation oscillation: {flips} direction flips"
+    assert restore_exact, "kill switch did not restore the baseline " \
+        f"bit-exactly: {batcher_b.knob_values()} != {cold}"
+
+    # --- leg C: mid-burst engine kill with the controller armed
+    def slow_eval(batch):
+        time.sleep(0.05)  # keep a healthy backlog in flight at the kill
+        return client.driver.review_batch(TARGET, batch)
+
+    batcher_c = MicroBatcher(client, max_wait=0.002, max_batch=8,
+                             evaluate=slow_eval)
+    ctrl_c = AdaptiveController(batcher=batcher_c, interval=0.1,
+                                cooldown_s=0.1, hysteresis_s=1.0)
+    validation = ValidationHandler(client, kube=None, batcher=batcher_c,
+                                   decision_cache_size=0,
+                                   ladder=ctrl_c.ladder)
+    sock = default_socket_path() + ".bench14"
+    engine = BackplaneEngine(sock, validation=validation)
+    engine.start()
+    bc = BackplaneClient(sock, worker_id="bench14")
+    frontend = FrontendServer(bc, port=0, addr="127.0.0.1")
+    frontend.start()
+    ctrl_c.arm()  # the real tick thread rides the kill
+    n = 60
+    answered: dict = {}
+    errors: list = []
+    lock = threading.Lock()
+
+    def fire(i):
+        obj = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": f"b14-{i}", "namespace": "bench"}}
+        payload = {"apiVersion": "admission.k8s.io/v1",
+                   "kind": "AdmissionReview",
+                   "request": {"uid": f"b14-{i}", "operation": "CREATE",
+                               "kind": {"group": "", "version": "v1",
+                                        "kind": "Pod"},
+                               "name": f"b14-{i}", "namespace": "bench",
+                               "userInfo": {"username": "bench"},
+                               "object": obj, "timeoutSeconds": 3}}
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1",
+                                              frontend.port, timeout=15)
+            conn.request("POST", "/v1/admit?timeout=3s",
+                         json.dumps(payload),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            conn.close()
+            with lock:
+                answered[i] = (resp.status, body["response"])
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(n)]
+    try:
+        for t in threads:
+            t.start()
+        # let part of the burst land real verdicts, then kill the
+        # engine under the rest; the fault point keeps the reconnect
+        # path down for the stragglers
+        deadline = time.time() + 10
+        while len(answered) < n // 6 and time.time() < deadline:
+            time.sleep(0.01)
+        FAULTS.inject("backplane.engine", mode="error")
+        engine.abort()
+        for t in threads:
+            t.join(20)
+    finally:
+        frontend.stop(drain_timeout=2.0)
+        ctrl_c.disarm(restore=True)
+        batcher_c.stop()
+        FAULTS.reset()
+    stance = sum(1 for _, resp in answered.values()
+                 if (resp.get("status") or {}).get("code") in (503, 504))
+    assert not errors, errors[:3]
+    assert len(answered) == n, \
+        f"unanswered admissions after engine kill: {n - len(answered)}"
+
+    print(json.dumps({
+        "config": 14, "metric": "adaptive_converged_frac",
+        "value": round(conv_frac, 3),
+        "unit": ("x of the hand-tuned config-5 knobs' rps on the same "
+                 "edge-bound closed loop, reached from cold defaults "
+                 "(max_wait 50ms) by the armed controller; gates: "
+                 ">= 0.9, flip count <= 2, zero unanswered admissions "
+                 "through a mid-burst engine kill, kill-switch restore "
+                 "bit-exact"),
+        "ref_rps": round(ref_rps),
+        "cold_rps": round(cold_rps),
+        "converged_rps": round(converged_rps),
+        "ticks_to_converge": ticks,
+        "converged_max_wait_ms": round(converged_wait * 1000, 3),
+        "flip_count": flips,
+        "rung_after": rung_after,
+        "kill_switch_restore_exact": restore_exact,
+        "actuations": trail,
+        "chaos": {"callers": n, "answered": len(answered),
+                  "stance_answers": stance, "errors": len(errors)},
+    }))
+
+
 def run(which: list[int]) -> int:
     """Run the named configs. A config-level exception no longer kills
     the remaining configs OR vanishes into the log: it prints an
@@ -2469,7 +2705,7 @@ def run(which: list[int]) -> int:
     nonzero at the end so a blocking CI step on one config fails."""
     table = {1: config1, 2: config2, 3: config3, 5: config5, 6: config6,
              7: config7, 8: config8, 9: config9, 10: config10,
-             11: config11, 12: config12, 13: config13}
+             11: config11, 12: config12, 13: config13, 14: config14}
     failed = 0
     for c in which:
         if c not in table:

@@ -623,6 +623,46 @@ class InventoryTracker:
             cancel()
 
 
+class _PublishGate:
+    """Mutual exclusion for status PUBLISH passes without a Lock held
+    across kube-write retry backoffs.
+
+    The three publish sites (streamed per-kind writes, flush writes,
+    the post-sweep pass) must serialize against each other — the
+    generation check-and-set they perform is only atomic under mutual
+    exclusion — but each pass spends most of its time in
+    `_write_kind_status`, whose kube PATCHes retry with backoff sleeps.
+    PR 15's lockset tracer flagged exactly that: a `threading.Lock`
+    held across `retry_call`'s `time.sleep`. Holding a *Lock object*
+    there is a smell (an interrupt/timeout path blocking on the lock
+    stalls behind another pass's network backoff with no way to see
+    why), so the exclusion is a token instead: `__enter__` waits for
+    the busy flag under an internal lock that is only ever held for
+    the flag hand-off itself, then RELEASES it before the publish body
+    runs. Same semantics at every `with` site, but no lock is held
+    while a write sleeps — which is why the internal lock can be
+    promoted to a gating locktrace site."""
+
+    def __init__(self) -> None:
+        # held only for busy-flag hand-offs — never across a write or
+        # a sleep; gklint gates any held-across-blocking event on it
+        self._lock = threading.Lock()  # locktrace: gate
+        self._cv = threading.Condition(self._lock)
+        self._busy = False
+
+    def __enter__(self) -> "_PublishGate":
+        with self._cv:
+            while self._busy:
+                self._cv.wait()
+            self._busy = True
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        with self._cv:
+            self._busy = False
+            self._cv.notify()
+
+
 class _KindStatusWriter:
     """Streaming constraint-status publisher for one interval sweep.
 
@@ -830,9 +870,11 @@ class AuditManager:
         # in evaluation order), _published_gen advances check-and-set
         # under _status_lock — a publish whose generation is older than
         # what's already published is skipped wholesale, so a slow
-        # in-flight write pass cannot clobber newer statuses. Bounded
-        # retry sleeps are acceptable under _status_lock (advisory).
-        self._status_lock = threading.Lock()
+        # in-flight write pass cannot clobber newer statuses. The gate
+        # is a token, not a Lock: kube-write retry backoffs sleep with
+        # NO lock held (see _PublishGate), closing PR 15's locktrace
+        # advisory on this site.
+        self._status_lock = _PublishGate()
         self._eval_gen = 0
         self._published_gen = 0
         # rolling flush observability (bench + tests + /debug): counts

@@ -969,7 +969,12 @@ class BackplaneClient:
         self.connect_timeout = connect_timeout
         self._sock: Optional[socket.socket] = None
         self._wlock = threading.Lock()
-        self._conn_lock = threading.Lock()
+        # reentrant: _ensure_connected calls _drop() from inside its
+        # own critical section when the engine dies between connect()
+        # and the hello send (the chaos suite's SIGKILL window) — a
+        # plain Lock self-deadlocks there, wedging every HTTP thread
+        # of the frontend behind a lock nobody will ever release
+        self._conn_lock = threading.RLock()
         self._pending: dict[int, _Waiter] = {}
         self._pending_lock = threading.Lock()
         self._next_id = 0
@@ -1915,6 +1920,17 @@ class EngineSupervisor:
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+        # fan-out actuation (adaptive controller): how many children
+        # should be RUNNING. Children beyond the prefix are "parked" —
+        # terminated and not respawned until the count rises again.
+        # The configured engine_ids list stays the hard ceiling.
+        self._desired_children = len(self.engine_ids)
+        # serving-knob replication: the latest set_knobs() payload and
+        # a generation counter; the monitor loop pushes it to every
+        # synced child and re-pushes after each respawn/resync
+        self._knobs: Optional[dict] = None
+        self._knobs_gen = 0
+        self._knobs_pushed: dict[int, int] = {}
 
     # spawn / readiness ----------------------------------------------
 
@@ -2017,17 +2033,94 @@ class EngineSupervisor:
                                 "marked for resync",
                                 details={"engine": k, "error": str(e)})
 
+    # fan-out / knob actuation ---------------------------------------
+
+    def scale_to(self, total: int) -> int:
+        """Desired TOTAL engine count, primary included (the adaptive
+        controller's fan-out actuator). Clamped to [1, configured].
+        NON-BLOCKING: this only records the target — the monitor loop
+        parks (terminates, stops respawning) children beyond it and
+        unparks (respawns + resyncs) them when it rises. Returns the
+        clamped total."""
+        want = min(1 + len(self.engine_ids), max(1, int(total)))
+        self._desired_children = want - 1
+        return want
+
+    def active_total(self) -> int:
+        """Desired total engine count (primary + unparked children)."""
+        return 1 + self._desired_children
+
+    def _active_ids(self) -> set:
+        return set(self.engine_ids[: self._desired_children])
+
+    def set_knobs(self, knobs: dict) -> None:
+        """Queue a serving-knob update (MicroBatcher max_wait /
+        max_batch / max_queue share) for every engine child.
+        NON-BLOCKING: the monitor loop pushes the newest payload over
+        each child's control stream, and re-pushes after any respawn,
+        so a healed engine never serves with stale knobs."""
+        with self._lock:
+            self._knobs = dict(knobs)
+            self._knobs_gen += 1
+
+    def _push_knobs(self) -> None:
+        """Send the newest knob payload to synced children that have
+        not acknowledged this generation. A send failure just leaves
+        the child un-acked for the next pass — knob pushes are
+        idempotent, unlike library ops, so no dirty/resync machinery."""
+        with self._lock:
+            knobs, gen = self._knobs, self._knobs_gen
+        if knobs is None:
+            return
+        for k in self.engine_ids:
+            if self._knobs_pushed.get(k) == gen:
+                continue
+            ctl = self._ctl.get(k)
+            if ctl is None or self._dirty.get(k):
+                continue
+            try:
+                ctl.control({"op": "knobs", "obj": knobs})
+                self._knobs_pushed[k] = gen
+            except BackplaneError as e:
+                log.warning("knob replication failed; will retry",
+                            details={"engine": k, "error": str(e)})
+
     # monitor / stats ------------------------------------------------
 
     def _monitor_loop(self) -> None:
         last_poll = 0.0
         while not self._stopping.wait(0.5):
+            active = self._active_ids()
+            # park pass: children beyond the desired fan-out stop
+            # (graceful terminate -> batcher drain) and stay down; the
+            # frontends' router fails their sockets over to survivors
+            for k in self.engine_ids:
+                if k in active:
+                    continue
+                proc = self._procs.get(k)
+                if proc is None or proc.poll() is not None:
+                    continue
+                log.info("admission engine parked (scale-down)",
+                         details={"engine": k})
+                old = self._ctl.pop(k, None)
+                if old is not None:
+                    old.close()
+                self._prev_stats.pop(k, None)
+                self._knobs_pushed.pop(k, None)
+                from . import metrics as _metrics
+                _metrics.zero_engine_gauges(str(k))
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
             # two-pass respawn: spawn EVERY dead engine first, then
             # await readiness — concurrently-dead engines initialize
             # in parallel instead of head-of-line blocking on one
             # child's (potentially slow) JAX/device init
             spawned: list = []
             for k in self.engine_ids:
+                if k not in active:
+                    continue  # parked: dead on purpose, no respawn
                 proc = self._procs.get(k)
                 if proc is not None and proc.poll() is not None \
                         and not self._stopping.is_set():
@@ -2038,6 +2131,10 @@ class EngineSupervisor:
                     if old is not None:
                         old.close()
                     self._prev_stats.pop(k, None)
+                    # the replacement process boots with configured
+                    # defaults: forget any knob ack so the newest
+                    # payload re-pushes after its resync
+                    self._knobs_pushed.pop(k, None)
                     # the dead child's relayed engine-labeled gauges
                     # must not export its last depth/duty while it is
                     # down (respawn's first poll would eventually
@@ -2077,6 +2174,7 @@ class EngineSupervisor:
             for k in self.engine_ids:
                 if self._dirty.get(k) and k in self._ctl:
                     self._resync(k)
+            self._push_knobs()
             now = time.monotonic()
             if now - last_poll >= self.POLL_INTERVAL_S:
                 last_poll = now
@@ -2086,8 +2184,12 @@ class EngineSupervisor:
     def _report_fleet(self) -> None:
         from . import metrics
 
+        # "configured" follows the DESIRED fan-out, not the ceiling:
+        # a deliberately parked engine must read as converged
+        # (desired == alive), while a dead unparked one reads as a
+        # deficit the monitor is healing
         metrics.report_admission_engines(
-            1 + len(self.engine_ids), 1 + self.alive_count())
+            self.active_total(), 1 + self.alive_count())
 
     def poll_stats(self) -> None:
         """Pull each engine's relayed metric totals and merge the
@@ -2117,7 +2219,9 @@ class EngineSupervisor:
         return bool(t and t.is_alive()) and not self._stopping.is_set()
 
     def alive(self) -> bool:
-        return self.alive_count() == len(self.engine_ids)
+        # measured against the DESIRED fan-out: parked children are
+        # down on purpose and must not read as a fleet deficit
+        return self.alive_count() == self._desired_children
 
     def kill_engine(self, k: int) -> None:
         """Chaos hook: SIGKILL one engine child (the monitor respawns

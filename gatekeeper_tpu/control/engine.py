@@ -66,6 +66,10 @@ class LibrarySink:
         # Q frames NOT_READY until then, so a respawned engine never
         # issues verdicts from its empty pre-sync library
         self.synced = False
+        # serving-knob receiver (adaptive controller fan-out): main()
+        # binds this to the batcher's set_knobs so the primary's
+        # actuations keep every engine's batch economics coherent
+        self.on_knobs = None
 
     def __call__(self, op: dict) -> None:
         kind = op.get("op")
@@ -73,6 +77,9 @@ class LibrarySink:
         client = self.client
         if kind == "sync":
             self._sync(op)
+        elif kind == "knobs":
+            if self.on_knobs is not None:
+                self.on_knobs(obj or {})
         elif kind == "add_template":
             client.add_template(obj)
         elif kind == "remove_template":
@@ -231,6 +238,13 @@ def main(argv=None) -> int:
                                    shard_id=max(args.audit_shard_id, 0),
                                    shard_count=args.audit_shard_count)
     sink = LibrarySink(client, mutation_system)
+    if validation is not None:
+        # replicated serving-knob ops land on this engine's batcher
+        # (unknown keys dropped: a version-skewed primary must not
+        # TypeError the control stream)
+        sink.on_knobs = lambda kn: validation.batcher.set_knobs(
+            **{key: v for key, v in (kn or {}).items()
+               if key in ("max_wait", "max_batch", "max_queue")})
     if auditor is not None:
         # a respawned shard must 503 sweeps until its slice resync
         # lands — an empty-library sweep would silently drop this

@@ -357,6 +357,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "spans the longest burn window at this "
                         "cadence); <= 0 disables the SLO engine, "
                         "burn-rate gauges, and /debug/slo")
+    p.add_argument("--adaptive-control", nargs="?", const=True,
+                   default=False, type=_parse_bool,
+                   help="arm the closed-loop serving controller "
+                        "(control/adaptive.py): samples burn rates, "
+                        "seal mix/fill, queue depth, duty cycle and "
+                        "actuates batch max_wait/max_batch, shed "
+                        "depth, engine fan-out, and AOT pre-warm "
+                        "inside declared bounds with hysteresis + "
+                        "per-knob cooldowns, plus the degradation "
+                        "ladder (normal -> tighten_shed -> cache_only "
+                        "-> fail_stance). false (the default) is the "
+                        "kill switch: knobs hold the configured "
+                        "baselines bit-exactly")
+    p.add_argument("--adaptive-interval", type=float, default=1.0,
+                   help="seconds between adaptive-controller ticks")
+    p.add_argument("--adaptive-hysteresis", type=float, default=10.0,
+                   help="minimum seconds before a knob may reverse "
+                        "direction (the anti-oscillation window); "
+                        "same-direction steps wait out the per-knob "
+                        "cooldown instead")
     p.add_argument("--debug-endpoints", nargs="?", const=True,
                    default=True, type=_parse_bool,
                    help="serve /debug/traces (flight-recorder dump), "
@@ -790,6 +810,28 @@ class Runtime:
             # fan-out when --admission-engines > 1), so both planes see
             # every library op
             self._shard_plane.attach()
+        # closed-loop adaptive serving controller (--adaptive-control):
+        # samples the SLO/saturation signals and steers the declared
+        # knobs; the flag defaulting OFF is the kill switch — disarm
+        # restores every captured baseline bit-exactly. Built AFTER the
+        # engines block so baselines capture the divided queue share.
+        self.adaptive = None
+        if getattr(args, "adaptive_control", False) \
+                and self.validation_handler is not None:
+            from .adaptive import AdaptiveController
+            self.adaptive = AdaptiveController(
+                batcher=self.validation_handler.batcher,
+                engines=self.engines,
+                slo=self.slo,
+                generation=lambda: self.opa.generation,
+                prewarm=self._adaptive_prewarm,
+                interval=getattr(args, "adaptive_interval", 1.0),
+                hysteresis_s=getattr(args, "adaptive_hysteresis",
+                                     10.0),
+                on_actuate=self._on_adaptive_actuation)
+            # the ladder gates the admission pipeline: rung >= 2
+            # serves cache hits only, rung >= 3 answers per stance
+            self.validation_handler.ladder = self.adaptive.ladder
         self.upgrade = UpgradeManager(self.kube)
         self.metrics_server = None
         self.health = None
@@ -959,7 +1001,34 @@ class Runtime:
                               else {"disabled": True,
                                     "hint": "--slo-sample-interval > 0 "
                                             "enables the SLO engine"}),
+            "adaptive": lambda q: (self.adaptive.status(q)
+                                   if self.adaptive is not None
+                                   else {"disabled": True,
+                                         "hint": "--adaptive-control "
+                                                 "arms the controller"}),
         }
+
+    def _on_adaptive_actuation(self, act) -> None:
+        """Controller actuation hook: batcher-knob movements replicate
+        to the engine children so the fleet's batch economics stay
+        coherent (set_knobs only records the payload — the supervisor's
+        monitor loop does the socket work, keeping the control loop
+        no-block)."""
+        if self.engines is None or self.validation_handler is None:
+            return
+        if act.knob in ("batch_max_wait", "batch_max_batch",
+                        "shed_depth"):
+            self.engines.set_knobs(
+                self.validation_handler.batcher.knob_values())
+
+    def _adaptive_prewarm(self) -> int:
+        """Churn-triggered off-path AOT pre-warm over every known
+        template kind (runs on the controller's one-shot thread, never
+        on the control loop)."""
+        driver = getattr(self.opa, "driver", None)
+        if not hasattr(driver, "prewarm_templates"):
+            return 0
+        return driver.prewarm_templates(self.opa.template_kinds())
 
     def _debug_templates(self, query: str) -> dict:
         driver = getattr(self.opa, "driver", None)
@@ -1148,6 +1217,13 @@ class Runtime:
                     self.health.add_liveness(
                         "mutation-batcher",
                         self.mutation_handler.batcher.healthy)
+                if self.adaptive is not None:
+                    # a dead armed control loop means knobs freeze at
+                    # whatever the last tick left them — not baselines,
+                    # not steered; restart the pod (disarm-on-shutdown
+                    # restores the baselines first)
+                    self.health.add_liveness("adaptive-controller",
+                                             self.adaptive.healthy)
                 if self.audit_shards is not None:
                     # same contract as the admission-engine supervisor:
                     # a dead shard mid-respawn is degraded-but-healing;
@@ -1226,6 +1302,9 @@ class Runtime:
             self.snapshots.start()
         if self.slo is not None:
             self.slo.start()
+        if self.adaptive is not None:
+            # AFTER slo.start(): the first tick reads a seeded export
+            self.adaptive.arm()
         self._ready = True
         # long-lived-server GC tuning: everything built so far (engine,
         # policy caches, codegen closures) is effectively permanent;
@@ -1239,6 +1318,11 @@ class Runtime:
 
     def stop(self) -> None:
         self._ready = False
+        if self.adaptive is not None:
+            # FIRST: no actuation may race the teardown below, and the
+            # baseline restore leaves the knobs as configured for any
+            # still-serving embedder/test plane
+            self.adaptive.disarm()
         if self.slo is not None:
             self.slo.stop()
         for probe in ("admission-queue", "mutation-queue",

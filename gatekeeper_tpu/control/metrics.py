@@ -696,6 +696,11 @@ def report_admission_shed(n: int = 1) -> None:
 # statically by gklint's metrics_hygiene checker.
 DECISION_CACHE_OUTCOMES = ("hit", "miss", "bypass")
 RING_PATHS = ("ring", "inline")
+ADAPTIVE_KNOBS = ("batch_max_wait", "batch_max_batch", "shed_depth",
+                  "engine_fanout", "prewarm")
+ADAPTIVE_DIRECTIONS = ("up", "down", "restore")
+DEGRADATION_RUNGS = ("normal", "tighten_shed", "cache_only",
+                     "fail_stance")
 KUBE_WRITE_OUTCOMES = ("ok", "retried_ok", "failed", "breaker_open",
                        "budget_exhausted", "not_leader")
 INGESTION_STATUSES = ("ok", "error", "active")
@@ -710,6 +715,49 @@ PREVIEW_OUTCOMES = ("ok", "error", "invalid")
 SNAPSHOT_OUTCOMES = ("ok", "error", "missing", "fallback")
 
 LABEL_FOLD = "other"
+
+
+def report_adaptive_actuation(knob: str, direction: str,
+                              n: int = 1) -> None:
+    """One adaptive-controller knob movement (or a kill-switch
+    baseline restore): which declared knob moved and which way. The
+    aggregate oscillation read — sustained up/down alternation on one
+    knob is the flip-flop the bench gate forbids."""
+    if knob not in ADAPTIVE_KNOBS:
+        knob = LABEL_FOLD
+    if direction not in ADAPTIVE_DIRECTIONS:
+        direction = LABEL_FOLD
+    REGISTRY.counter_add("gatekeeper_tpu_adaptive_actuations_total",
+                         "Adaptive-controller actuations by knob and "
+                         "direction", n, knob=knob, direction=direction)
+
+
+def report_adaptive_knob(knob: str, value: float) -> None:
+    """Current value of one adaptive-controller knob (set on every
+    actuation and at arm/restore): the convergence read — CI and the
+    bench compare this against the hand-tuned optimum."""
+    if knob not in ADAPTIVE_KNOBS:
+        knob = LABEL_FOLD
+    REGISTRY.gauge_set("gatekeeper_tpu_adaptive_knob_value",
+                       "Current value of each adaptive-controller "
+                       "knob", float(value), knob=knob)
+
+
+def report_degradation_rung(rung: int) -> None:
+    """Current degradation-ladder rung, twice over: a plain gauge
+    (0=normal .. 3=fail_stance, the alerting read) and a per-rung
+    transition counter (how often the plane ENTERED each rung)."""
+    idx = min(len(DEGRADATION_RUNGS) - 1, max(0, int(rung)))
+    name = DEGRADATION_RUNGS[idx]
+    if name not in DEGRADATION_RUNGS:
+        name = LABEL_FOLD  # unreachable; keeps the fold discipline
+    REGISTRY.gauge_set("gatekeeper_tpu_degradation_rung",
+                       "Current degradation-ladder rung (0=normal, "
+                       "1=tighten_shed, 2=cache_only, 3=fail_stance)",
+                       idx)
+    REGISTRY.counter_add("gatekeeper_tpu_degradation_transitions_total",
+                         "Degradation-ladder entries by rung",
+                         rung=name)
 
 
 def report_decision_cache(outcome: str, n: int = 1) -> None:

@@ -81,6 +81,7 @@ class CircuitBreaker:
         self._fails = 0
         self._opened_at = 0.0
         self._probe_inflight = False
+        self._probe_claimed_at = 0.0
         metrics.report_breaker(name, self.CLOSED)
 
     # ------------------------------------------------------------- state
@@ -96,10 +97,25 @@ class CircuitBreaker:
         return self.state == self.OPEN
 
     def _tick(self) -> None:
-        """open -> half-open once the reset timeout elapsed (lock held)."""
+        """open -> half-open once the reset timeout elapsed (lock held).
+
+        Also expires a stale half-open probe LEASE: allow() hands out
+        one probe slot, and the claimant is obligated to resolve it —
+        but a claimant that dies without a verdict (its thread torn
+        down mid-write, a BaseException skipping the caller's failure
+        handling) would otherwise wedge the breaker in half-open with
+        the slot held forever, refusing every write while the server
+        may be perfectly healthy. A claim older than reset_timeout is
+        treated as abandoned and the slot re-opens."""
+        now = time.monotonic()
         if self._state == self.OPEN and \
-                time.monotonic() - self._opened_at >= self.reset_timeout:
+                now - self._opened_at >= self.reset_timeout:
             self._transition(self.HALF_OPEN)
+            self._probe_inflight = False
+        elif self._state == self.HALF_OPEN and self._probe_inflight and \
+                now - self._probe_claimed_at >= self.reset_timeout:
+            log.info("circuit breaker %s: half-open probe lease expired; "
+                     "releasing the slot" % self.name)
             self._probe_inflight = False
 
     def _transition(self, state: str) -> None:
@@ -122,6 +138,7 @@ class CircuitBreaker:
                 return True
             if self._state == self.HALF_OPEN and not self._probe_inflight:
                 self._probe_inflight = True
+                self._probe_claimed_at = time.monotonic()
                 return True
             return False
 
@@ -145,6 +162,17 @@ class CircuitBreaker:
                     self._fails >= self.failure_threshold:
                 self._opened_at = time.monotonic()
                 self._transition(self.OPEN)
+
+    def abandon(self) -> None:
+        """Release a claimed probe slot with NO health verdict.
+
+        For callers cancelled before their write resolved
+        (KeyboardInterrupt, SystemExit, executor teardown): the aborted
+        attempt says nothing about the server, so the state machine and
+        failure count stay untouched — half-open simply waits for the
+        next real probe instead of wedging on the leaked slot."""
+        with self._lock:
+            self._probe_inflight = False
 
 
 class RetryBudget:
@@ -234,6 +262,14 @@ def retry_call(fn: Callable, breaker: Optional[CircuitBreaker] = None,
             if breaker is not None:
                 breaker.record_failure()
             metrics.report_kube_write("failed")
+            raise
+        except BaseException:
+            # cancellation (KeyboardInterrupt, SystemExit, interpreter
+            # teardown) skips `except Exception` — it is not a health
+            # verdict either way, so release the probe slot with no
+            # state transition instead of leaking it
+            if breaker is not None:
+                breaker.abandon()
             raise
         if breaker is not None:
             breaker.record_success()

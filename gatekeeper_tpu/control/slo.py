@@ -199,6 +199,7 @@ class SloEngine:
         keep = int(max(self.windows.values())
                    / self.sample_interval_s) + 8
         self._samples: deque = deque(maxlen=keep)
+        self._last_rates: dict = {}  # latest export(), for latest()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -273,10 +274,19 @@ class SloEngine:
 
     # ----------------------------------------------------------- exports
 
+    def latest(self) -> dict:
+        """The most recent export()'s burn rates, without recomputing:
+        the adaptive controller's per-tick signal read. Shape matches
+        burn_rates(); {} before the first export. The reference is
+        swapped atomically and never mutated after publication, so
+        readers need no lock."""
+        return self._last_rates
+
     def export(self, now: Optional[float] = None) -> dict:
         """Refresh the burn-rate gauges from the ring; returns what it
         exported (the /debug/slo payload core)."""
         rates = self.burn_rates(now)
+        self._last_rates = rates
         for obj in self.objectives:
             self.registry.gauge_set(
                 "gatekeeper_tpu_slo_target",

@@ -219,6 +219,32 @@ class MicroBatcher:
         # up in _sealed instead
         self._pending = 0
 
+    def set_knobs(self, max_wait: Optional[float] = None,
+                  max_batch: Optional[int] = None,
+                  max_queue: Optional[int] = None) -> dict:
+        """Thread-safe live retuning (the adaptive controller's
+        actuation surface, also replicated to engine children as a
+        `knobs` control op). Values are sanity-clamped here — the
+        controller's declared per-knob bounds are tighter, this floor
+        only guards a garbage replication frame. Takes effect at the
+        next collection window: the collector re-reads max_wait /
+        max_batch at each window start, and the shed bound is
+        consulted per enqueue. Returns the resulting values."""
+        with self._cv:
+            if max_wait is not None:
+                self.max_wait = max(0.0, float(max_wait))
+            if max_batch is not None:
+                self.max_batch = max(1, int(max_batch))
+            if max_queue is not None:
+                self.max_queue = max(0, int(max_queue))
+            self._cv.notify()
+        return self.knob_values()
+
+    def knob_values(self) -> dict:
+        """The live knob set, in the `knobs` control-op wire shape."""
+        return {"max_wait": self.max_wait, "max_batch": self.max_batch,
+                "max_queue": self.max_queue}
+
     def submit_many(self, reviews: list, timeout: float = 60.0,
                     deadline: Optional[float] = None) -> list:
         """Bulk enqueue (streaming ingest): every review joins the
@@ -672,7 +698,8 @@ class ValidationHandler:
                  traces_provider=None,
                  fail_closed: bool = False,
                  default_timeout: float = DEFAULT_WEBHOOK_TIMEOUT_S,
-                 decision_cache_size: int = 4096):
+                 decision_cache_size: int = 4096,
+                 ladder=None):
         self.opa = opa
         self.kube = kube
         self.batcher = batcher or MicroBatcher(opa)
@@ -683,6 +710,12 @@ class ValidationHandler:
         self.default_timeout = default_timeout
         self.cache = (DecisionCache(decision_cache_size)
                       if decision_cache_size > 0 else None)
+        # degradation ladder (control/adaptive.py DegradationLadder,
+        # duck-typed: anything with an int `.rung`). Rung >= 2 serves
+        # cache hits + short-circuits only (misses shed per the
+        # failure stance); rung >= 3 answers every non-exempt request
+        # per the stance immediately. None = never degraded.
+        self.ladder = ladder
 
     def handle(self, admission_review: dict,
                deadline: Optional[float] = None,
@@ -846,6 +879,17 @@ class ValidationHandler:
         if username == SERVICE_ACCOUNT:
             pre.response = {"allowed": True}
             return pre
+        rung = self.ladder.rung if self.ladder is not None else 0
+        if rung >= 3:
+            # fail-stance rung: the plane is past the point where
+            # evaluating (or even consulting the cache) helps —
+            # answer per the configured failure stance immediately.
+            # Raising (not returning a response) routes through
+            # _failure, so status=shed accounting and the stance
+            # mapping stay on the one shared path.
+            raise AdmissionShed(
+                "degraded (fail_stance): admission answered per "
+                "failure stance without evaluation")
         kind = request.get("kind") or {}
         group = kind.get("group") or ""
         if group in (TEMPLATE_GROUP, CONSTRAINT_GROUP):
@@ -898,13 +942,28 @@ class ValidationHandler:
                 # shallow copy: the caller patches uid into the response
                 pre.response = dict(cached)
                 return pre
+            if rung >= 2:
+                # cache-only rung: hits (above) still serve at full
+                # speed; a miss would need evaluation the degraded
+                # plane is protecting — shed it, on the fast path too
+                # (a shed needs no blocking work)
+                raise AdmissionShed(
+                    "degraded (cache_only): decision-cache miss shed "
+                    "without evaluation")
             if fast:
                 raise NeedsEvaluation()  # miss reported by the re-issue
             metrics.report_decision_cache("miss")
         elif self.cache is not None:
+            if rung >= 2:
+                raise AdmissionShed(
+                    "degraded (cache_only): uncacheable request shed "
+                    "without evaluation")
             if fast:
                 raise NeedsEvaluation()
             metrics.report_decision_cache("bypass")
+        if rung >= 2:
+            raise AdmissionShed(
+                "degraded (cache_only): evaluation path disabled")
         if fast:
             raise NeedsEvaluation()  # cache disabled: evaluation ahead
         return pre

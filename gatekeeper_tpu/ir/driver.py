@@ -644,6 +644,20 @@ class TpuDriver(RegoDriver):
         threading.Thread(target=run, daemon=True,
                          name=f"aot-prewarm-{kind}").start()
 
+    def prewarm_templates(self, kinds) -> int:
+        """Re-run the ingest-time off-path AOT preload for the given
+        template kinds (the adaptive controller's churn-triggered
+        actuation: after a burst of library ops settles, every known
+        kind's stored executables deserialize in the background so the
+        first post-churn evaluation dispatches warm). Best-effort and
+        cheap to repeat — kinds already warm re-adopt idempotently.
+        Returns how many kinds were enqueued."""
+        n = 0
+        for kind in kinds:
+            self._enqueue_prewarm(kind)
+            n += 1
+        return n
+
     def _mark_stored_sigs_warm(self, fingerprint: str,
                                loaded: dict) -> None:
         """Adopt the store's remembered sweep signatures as warm. Mesh

@@ -165,3 +165,33 @@ def test_chart_ring_and_ingest_values_reach_webhook_deployment():
     assert all(p.get("name") != "grpc-ingest" for p in wc["ports"])
     # rings stay on independently of the ingest endpoint
     assert "--admission-shm-ring-mb=16" in wc["args"]
+
+
+def test_chart_adaptive_control_values_reach_webhook_deployment():
+    # default ships the kill switch: knobs hold their baselines
+    docs = [d for d in yaml.safe_load_all(render(default_values()))
+            if d is not None]
+    wc = {d["metadata"]["name"]: d for d in docs
+          if d["kind"] == "Deployment"}["gatekeeper-controller-manager"][
+        "spec"]["template"]["spec"]["containers"][0]
+    assert "--adaptive-control=False" in wc["args"]
+    assert "--adaptive-interval=1" in wc["args"]
+    assert "--adaptive-hysteresis=10" in wc["args"]
+    # arming the controller is a values flip, not a template edit
+    vals = default_values()
+    vals["adaptive"]["enabled"] = True
+    vals["adaptive"]["intervalSeconds"] = 2
+    vals["adaptive"]["hysteresisSeconds"] = 30
+    docs = [d for d in yaml.safe_load_all(render(vals)) if d is not None]
+    wc = {d["metadata"]["name"]: d for d in docs
+          if d["kind"] == "Deployment"}["gatekeeper-controller-manager"][
+        "spec"]["template"]["spec"]["containers"][0]
+    assert "--adaptive-control=True" in wc["args"]
+    assert "--adaptive-interval=2" in wc["args"]
+    assert "--adaptive-hysteresis=30" in wc["args"]
+    # the audit pod runs no admission batcher: the controller flag
+    # stays off its container (it would only watch)
+    ac = {d["metadata"]["name"]: d for d in docs
+          if d["kind"] == "Deployment"}["gatekeeper-audit"][
+        "spec"]["template"]["spec"]["containers"][0]
+    assert not any(a.startswith("--adaptive") for a in ac["args"])

@@ -48,6 +48,11 @@ class FastHTTPServer:
 def run_saturation_probes():
     pass
 """,
+    "gatekeeper_tpu/control/adaptive.py": """\
+class AdaptiveController:
+    def _loop(self):
+        pass
+""",
 }
 
 

@@ -211,6 +211,125 @@ def test_retry_call_releases_probe_slot_on_unexpected_error():
     assert br.state == CircuitBreaker.CLOSED
 
 
+def test_breaker_half_open_probe_lease_expires():
+    """A claimed probe slot whose claimant never resolves it (thread
+    torn down mid-write) must not wedge the breaker in half-open
+    forever: the lease expires after reset_timeout and the slot
+    re-opens for the next caller."""
+    br = CircuitBreaker("g6", failure_threshold=1, reset_timeout=0.1)
+    br.record_failure()  # open
+    time.sleep(0.15)     # half-open
+    assert br.allow() is True    # claim the probe slot... and vanish
+    assert br.allow() is False   # slot held: second caller refused
+    time.sleep(0.15)             # lease expires
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.allow() is True    # slot reclaimed by a live caller
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_abandon_releases_probe_without_verdict():
+    """abandon() hands the probe slot back with NO state transition:
+    a cancelled probe says nothing about the server, so half-open
+    stays half-open (not re-opened as a failure would, not closed as
+    a success would)."""
+    br = CircuitBreaker("g7", failure_threshold=1, reset_timeout=0.05)
+    br.record_failure()
+    time.sleep(0.1)
+    assert br.allow() is True
+    br.abandon()
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.allow() is True    # immediately available again
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN  # real verdicts still count
+
+
+def test_retry_call_baseexception_abandons_probe():
+    """KeyboardInterrupt/SystemExit skip `except Exception` — the
+    probe slot must still be released, and since cancellation is not
+    a health verdict the breaker must NOT transition."""
+    from gatekeeper_tpu.control.resilience import retry_call
+
+    br = CircuitBreaker("g8", failure_threshold=1, reset_timeout=0.05)
+    br.record_failure()
+    time.sleep(0.1)
+    assert br.state == CircuitBreaker.HALF_OPEN
+
+    def cancelled():
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        retry_call(cancelled, breaker=br)
+    assert br.state == CircuitBreaker.HALF_OPEN  # no verdict recorded
+    assert retry_call(lambda: "ok", breaker=br) == "ok"  # slot free
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_publish_gate_excludes_without_holding_lock():
+    """audit's _PublishGate: same mutual exclusion as the Lock it
+    replaced, but the internal lock is NOT held while the guarded body
+    runs — so a kube-write backoff sleeping inside the gate holds no
+    lock (the PR 15 locktrace advisory this closes)."""
+    from gatekeeper_tpu.control.audit import _PublishGate
+
+    gate = _PublishGate()
+    order: list = []
+    entered = threading.Event()
+    release = threading.Event()
+
+    def first():
+        with gate:
+            # the token is held, the internal lock is not: a backoff
+            # sleep here runs lock-free
+            assert gate._lock.locked() is False
+            order.append("first-in")
+            entered.set()
+            release.wait(5)
+            order.append("first-out")
+
+    def second():
+        entered.wait(5)
+        with gate:
+            order.append("second-in")
+
+    t1 = threading.Thread(target=first)
+    t2 = threading.Thread(target=second)
+    t1.start()
+    t2.start()
+    time.sleep(0.1)
+    assert order == ["first-in"]  # second excluded while first holds
+    release.set()
+    t1.join(5)
+    t2.join(5)
+    assert order == ["first-in", "first-out", "second-in"]
+
+
+def test_submit_many_sheds_each_item_exactly_once():
+    """Bulk enqueue against a full queue: every refused item counts
+    once on the shed counter, admitted items never count, and the
+    draining-batcher path (AdmissionShed without capacity pressure)
+    counts nothing."""
+    def evaluate(reviews):
+        return [[] for _ in reviews]
+
+    b = MicroBatcher(None, max_wait=0.001, max_batch=8,
+                     evaluate=evaluate, max_queue=2)
+    try:
+        # one lock pass admits items 0-1 and sheds 2-4: the flusher
+        # cannot drain capacity mid-enqueue, so the split is exact
+        outs = b.submit_many([{"i": i} for i in range(5)], timeout=5.0)
+        assert b.shed == 3               # items 2..4, exactly once each
+        assert [isinstance(o, AdmissionShed) for o in outs] == \
+            [False, False, True, True, True]
+        assert outs[0] == [] and outs[1] == []
+        b.stop()
+        outs = b.submit_many([{"i": 9}], timeout=1.0)
+        assert isinstance(outs[0], AdmissionShed)
+        assert b.shed == 3               # shutdown refusals don't count
+    finally:
+        b.stop()
+
+
 def test_batch_seals_for_tightest_member_deadline():
     """A request with a deadline tighter than the collection window
     must not wait out the full window."""

@@ -429,6 +429,53 @@ def test_backplane_engine_fault_point():
         engine.stop(drain_timeout=1.0)
 
 
+def test_backplane_client_hello_failure_does_not_deadlock(monkeypatch,
+                                                          tmp_path):
+    """An engine that dies between connect() and the hello send (the
+    chaos suite's SIGKILL window) sends _ensure_connected into _drop()
+    from inside its own _conn_lock critical section. With a
+    non-reentrant lock that self-deadlocks — and every HTTP thread of
+    the frontend then wedges behind the lock, hanging callers into
+    their client-side timeouts instead of stance answers."""
+    import socket as sk
+
+    from gatekeeper_tpu.control import backplane as bp
+
+    path = str(tmp_path / "hello.sock")
+    srv = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(2)
+    try:
+        cl = bp.BackplaneClient(path, worker_id="t")
+        monkeypatch.setattr(
+            bp, "_send_frame",
+            lambda *a, **k: (_ for _ in ()).throw(
+                OSError("peer died before hello")))
+        res: list = []
+
+        def attempt():
+            try:
+                cl._ensure_connected()
+                res.append("connected")
+            except bp.BackplaneError:
+                res.append("error")
+
+        t1 = threading.Thread(target=attempt, daemon=True)
+        t1.start()
+        t1.join(5)
+        assert res == ["error"], \
+            "hello-failure path hung instead of raising"
+        # the lock must be free again: a retry takes the same path
+        t2 = threading.Thread(target=attempt, daemon=True)
+        t2.start()
+        t2.join(5)
+        assert res == ["error", "error"], \
+            "connection lock was left held after the hello failure"
+        cl.close()
+    finally:
+        srv.close()
+
+
 def test_frontend_forward_stats_reach_engine_metrics():
     from gatekeeper_tpu.control import metrics as gm
 

@@ -64,6 +64,9 @@ _HIGHER_BETTER = {
     # sharded inventory plane (ISSUE 16): one composed audit round's
     # throughput over the process-sharded plane
     "sharded_audit_objects_per_sec", "sharded_objects_per_sec",
+    # adaptive controller (ISSUE 18): converged fraction of the
+    # hand-tuned reference throughput, gated >= 0.9 in-bench too
+    "adaptive_converged_frac",
 }
 
 # measured but NOT gated by --check: cold-start and first-call numbers

@@ -38,6 +38,9 @@ ENTRY_POINTS = [
     ("gatekeeper_tpu/control/metrics.py::run_saturation_probes",
      {"lock"},
      "/metrics scrape-time saturation probes"),
+    ("gatekeeper_tpu/control/adaptive.py::AdaptiveController._loop",
+     {"lock", "wait"},
+     "adaptive controller tick loop"),
 ]
 
 REGISTER_PROBE = "register_saturation_probe"

@@ -36,7 +36,8 @@ NON_SECONDS_HISTOGRAMS = {
 _RECORDERS = {"counter_add": "counter", "observe": "histogram",
               "observe_bucketed": "histogram", "gauge_set": "gauge"}
 
-_BOUNDED_LABELS = {"reason", "outcome", "path", "status"}
+_BOUNDED_LABELS = {"reason", "outcome", "path", "status",
+                   "knob", "direction", "rung"}
 
 
 def _interpolated(node: ast.AST) -> bool:
