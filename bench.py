@@ -227,7 +227,7 @@ def main() -> int:
 
     configs = {}
     want_configs = ["1", "2", "3", "5", "6", "7", "9", "10", "11", "12",
-                    "13", "14"]
+                    "13", "14", "15"]
     try:
         # FULL scale by default: BENCH_r0N.json must carry the
         # 10k-object and 50k-pod numbers, not reduced-scale stand-ins
@@ -354,6 +354,13 @@ def main() -> int:
             (configs.get("13") or {}).get("sweep_wall_s"),
         "sharded_best_shards": (configs.get("13") or {}).get(
             "best_shards"),
+        # chaos headline (config 15): worst harness-measured MTTR over
+        # the six-fault matrix, and the crash-consistency verifier's
+        # violation count (the config asserts it 0; the copy makes a
+        # nonzero impossible to miss in the round record)
+        "chaos_mttr_p99_s": (configs.get("15") or {}).get("value"),
+        "chaos_invariant_violations":
+            (configs.get("15") or {}).get("chaos_invariant_violations"),
         # multichip headline (config 10): default mesh-sharded audit at
         # 1M+ objects vs the forced single-device path
         "mesh_audit_s": (configs.get("10") or {}).get("value"),

@@ -2696,6 +2696,315 @@ def config14():
     }))
 
 
+def config15():
+    """Chaos MTTR matrix (the PR-19 tentpole): six fault scenarios,
+    each injected twice against a live plane, with recovery measured
+    HARNESS-side as t_healthy - t_inject (the supervisor's own
+    fault_recovery histogram measures detection->resync; this number
+    adds detection latency, which is the part an operator feels):
+
+      engine-kill     SIGKILL the child admission engine; healthy =
+                      replacement spawned + resynced
+      engine-pause    SIGSTOP it (gray failure: alive to waitpid,
+                      wedged to callers) — detection must come from
+                      the heartbeat deadline, nobody sends SIGCONT
+      frontend-kill   SIGKILL one pre-forked frontend slot; healthy =
+                      full worker fan-out serving again
+      shard-kill      SIGKILL an audit shard child; healthy = slice
+                      rebuilt AND the next composed round bit-equal
+                      to a clean single-process oracle
+      leader-kill     expire the incumbent's lease (the crashed-
+                      leader analog); healthy = a candidate holds
+                      the lease again
+      apiserver-flap  a burst of 5xx on kube writes; healthy = the
+                      next status write round-trips
+
+    An admission trickle rides every serve-plane scenario and the
+    crash-consistency verifier (gatekeeper_tpu.control.chaos) checks
+    the side effects: zero unanswered admissions, audit bit-equality,
+    no leaked children/fds//dev/shm segments, no stale gauge series.
+
+    Headlines: `chaos_mttr_p99_s` (max MTTR across the matrix — p99
+    over this sample count IS the max; lower-better, gated by
+    bench_trend via the c15 series) and `chaos_invariant_violations`
+    (asserted == 0 in-bench, so a violation fails the config rather
+    than shipping as a number).
+
+    Engine/shard children run on JAX_PLATFORMS=cpu: MTTR measures the
+    supervisory plane (detect/kill/respawn/resync), not eval speed,
+    and child processes must not fight the parent for an accelerator.
+    """
+    prev_platform = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        _config15_body()
+    finally:
+        if prev_platform is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev_platform
+
+
+def _config15_body():
+    import tempfile
+    import threading
+
+    import tools.chaos_verify as cv
+    from gatekeeper_tpu.control.chaos import (
+        CheckResult,
+        LeakBaseline,
+        PlaneHandles,
+        Verifier,
+    )
+    from gatekeeper_tpu.control.main import Runtime, build_parser
+    from gatekeeper_tpu.utils.faults import FAULTS
+
+    REPEATS = 2
+    verifier = Verifier()
+    matrix: dict = {}
+    probe_seq = [0]
+    answered: dict = {}
+    errors: list = []
+    lock = threading.Lock()
+
+    def sample(name, fn):
+        samples = matrix.setdefault(name, {"samples_s": []})["samples_s"]
+        for _ in range(REPEATS):
+            samples.append(round(fn(), 3))
+            time.sleep(0.3)  # settle between repeats
+        matrix[name]["p99_s"] = max(samples)
+
+    def wait_until(pred, timeout=45.0, tag=""):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"no recovery within {timeout}s: {tag}")
+
+    # ---- serve plane: engine-kill / engine-pause / frontend-kill ----
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--disable-cert-rotation", "--health-addr", ":0",
+        "--operation", "webhook", "--admission-workers", "2",
+        "--admission-engines", "2"])
+    rt = Runtime(args)
+    rt.args.metrics_backend = "none"
+    baseline = LeakBaseline(PlaneHandles(kube=rt.kube)).capture()
+    rt.start()
+    rt.frontends.heartbeat_deadline_s = 3.0
+    rt.engines.heartbeat_deadline_s = 2.0
+    try:
+        wait_until(lambda: rt.backplane.connected >= 2, 30,
+                   "frontends never connected")
+        baseline.plane.frontends = rt.frontends
+        baseline.plane.engines = rt.engines
+        baseline.track_children()
+
+        def trickle(n=12):
+            """An admission load thread riding one outage window."""
+            base = probe_seq[0]
+            probe_seq[0] += n
+            ids = [f"m15-{base + i}" for i in range(n)]
+            t = threading.Thread(
+                target=cv._load_worker,
+                args=(rt.frontends.port, ids, answered, errors, lock),
+                daemon=True)
+            t.start()
+            return t
+
+        def engines_converged():
+            return (rt.engines.alive_count()
+                    == len(rt.engines.engine_ids)
+                    and not any(rt.engines._dirty.values()))
+
+        def engine_fault(pause):
+            victim = rt.engines._procs[rt.engines.engine_ids[0]]
+            load = trickle()
+            t0 = time.monotonic()
+            k = rt.engines.engine_ids[0]
+            (rt.engines.pause_engine if pause
+             else rt.engines.kill_engine)(k)
+            wait_until(lambda: rt.engines._procs.get(k) is not victim
+                       and engines_converged(),
+                       tag="engine pause" if pause else "engine kill")
+            mttr = time.monotonic() - t0
+            load.join(60)
+            return mttr
+
+        def frontend_kill():
+            slot = 0
+            victim_pid = rt.frontends.child_pids()[slot]
+            load = trickle()
+            t0 = time.monotonic()
+            rt.frontends.kill_child(slot)
+            wait_until(lambda: rt.frontends.child_pids().get(slot)
+                       not in (None, victim_pid)
+                       and rt.frontends.alive()
+                       and rt.backplane.connected >= 2,
+                       tag="frontend kill")
+            mttr = time.monotonic() - t0
+            load.join(60)
+            return mttr
+
+        sample("engine-kill", lambda: engine_fault(pause=False))
+        sample("engine-pause", lambda: engine_fault(pause=True))
+        sample("frontend-kill", frontend_kill)
+
+        baseline.track_children()
+        verifier.check_admissions(probe_seq[0], answered, errors,
+                                  fail_closed=bool(args.fail_closed))
+    finally:
+        rt.stop()
+    verifier.check_leaks(baseline)
+
+    # ---- audit plane: shard-kill --------------------------------------
+    from gatekeeper_tpu.client import Backend
+    from gatekeeper_tpu.control.audit import AuditManager, ShardedAuditPlane
+    from gatekeeper_tpu.control.backplane import AuditShardSupervisor
+    from gatekeeper_tpu.ir import TpuDriver
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    objs = cv._cluster_objects()
+    okube = cv._cluster_kube(objs)
+    oracle_client = Backend(TpuDriver()).new_client([K8sValidationTarget()])
+    cv._library(oracle_client)
+    oracle_results = [cv._result_key(r) for r in AuditManager(
+        okube, oracle_client, interval=3600,
+        incremental=True).audit_once()]
+
+    kube = cv._cluster_kube(objs)
+    leader = Backend(TpuDriver()).new_client([K8sValidationTarget()])
+    sock = os.path.join(tempfile.mkdtemp(prefix="bench15-"), "audit.sock")
+    plane_box: list = []
+    sup = AuditShardSupervisor(
+        2, socket_for=lambda k: f"{sock}.{k}",
+        spawn_args=["--log-level", "WARNING"],
+        snapshot_provider=lambda k: plane_box[0].sync_snapshot(k),
+        heartbeat_deadline_s=2.0)
+    splane = ShardedAuditPlane(kube, leader, sup, 2)
+    plane_box.append(splane)
+    splane.attach()
+    cv._library(leader)
+    mgr = AuditManager(kube, leader, interval=3600, shard_plane=splane)
+    sup.start()
+    try:
+        round0 = [cv._result_key(r) for r in mgr.audit_once()]
+        pre = CheckResult("bench15_audit_clean")
+        if round0 != oracle_results:
+            pre.violations.append(
+                "pre-chaos sharded round differs from oracle")
+        verifier.results.append(pre)
+
+        def shard_kill():
+            victim = sup._procs[1]
+            t0 = time.monotonic()
+            sup.kill_engine(1)
+            wait_until(lambda: sup._procs.get(1) is not victim
+                       and sup.alive_count() == 2
+                       and not any(sup._dirty.values()),
+                       tag="shard kill")
+            mttr = time.monotonic() - t0
+            verifier.check_audit_bitequal(
+                [cv._result_key(r) for r in mgr.audit_once()],
+                oracle_results)
+            return mttr
+
+        sample("shard-kill", shard_kill)
+    finally:
+        sup.stop()
+        splane.stop()
+
+    # ---- control plane: leader-kill / apiserver-flap ------------------
+    from gatekeeper_tpu.control.kube import FakeKube, LEASE_GVK, LeaseElector
+
+    lkube = FakeKube()
+    lkube.register_kind(LEASE_GVK)
+    electors = [LeaseElector(lkube, identity=i, lease_duration=0.6,
+                             namespace="gk") for i in ("pod-a", "pod-b")]
+    for e in electors:
+        e.start()
+    try:
+        wait_until(lambda: any(e.is_leader for e in electors), 15,
+                   "no initial leader")
+
+        def leader_kill():
+            incumbent = next(e for e in electors if e.is_leader)
+            t0 = time.monotonic()
+            FAULTS.inject("kube.lease", mode="expire", count=1,
+                          match={"identity": incumbent.identity})
+            wait_until(lambda: not incumbent.is_leader, 15,
+                       "incumbent never deposed")
+            wait_until(lambda: any(e.is_leader for e in electors), 15,
+                       "no successor elected")
+            return time.monotonic() - t0
+
+        sample("leader-kill", leader_kill)
+    finally:
+        for e in electors:
+            e.stop()
+        FAULTS.reset()
+
+    from gatekeeper_tpu.control.resilience import GuardedKube
+
+    fkube = FakeKube()
+    fkube.register_kind(("constraints.gatekeeper.sh", "v1beta1",
+                         "K8sRequiredLabels"))
+    fkube.apply({"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                 "kind": "K8sRequiredLabels",
+                 "metadata": {"name": "flap-target", "uid": "c-flap"},
+                 "spec": {}})
+    # the kube.write point lives in GuardedKube's mutating verbs — the
+    # flap is felt (and retried through) exactly where production
+    # status writes go
+    gkube = GuardedKube(fkube)
+
+    def apiserver_flap():
+        gvk = ("constraints.gatekeeper.sh", "v1beta1",
+               "K8sRequiredLabels")
+        t0 = time.monotonic()
+        FAULTS.inject("kube.write", mode="error", param="503", count=5)
+        while True:
+            try:
+                obj = fkube.get(gvk, "flap-target")
+                obj["status"] = {"probedAt": len(matrix)}
+                gkube.update(obj, subresource="status")
+                return time.monotonic() - t0
+            except Exception:
+                if time.monotonic() - t0 > 15:
+                    raise
+                time.sleep(0.02)
+
+    try:
+        sample("apiserver-flap", apiserver_flap)
+    finally:
+        FAULTS.reset()
+
+    verifier.check_stale_gauges()
+    violations = verifier.violation_count()
+    mttr_p99 = max(v["p99_s"] for v in matrix.values())
+    report = verifier.report()
+
+    print(json.dumps({
+        "config": 15, "metric": "chaos_mttr_p99_s",
+        "value": round(mttr_p99, 3),
+        "unit": ("s, worst harness-measured MTTR (t_healthy - "
+                 "t_inject, incl. detection) across engine-kill/"
+                 "engine-pause/frontend-kill/shard-kill/leader-kill/"
+                 "apiserver-flap x2 repeats; heartbeat deadlines "
+                 "2-3s; cpu children; gated alongside "
+                 "chaos_invariant_violations == 0"),
+        "chaos_invariant_violations": violations,
+        "matrix": matrix,
+        "checks": [{"name": c["name"], "violations": c["violations"]}
+                   for c in report["checks"]],
+        "probes": {"submitted": probe_seq[0], "answered": len(answered),
+                   "errors": len(errors)},
+    }), flush=True)
+    assert violations == 0, \
+        f"crash-consistency violations under the MTTR matrix: {report}"
+
+
 def run(which: list[int]) -> int:
     """Run the named configs. A config-level exception no longer kills
     the remaining configs OR vanishes into the log: it prints an
@@ -2705,7 +3014,8 @@ def run(which: list[int]) -> int:
     nonzero at the end so a blocking CI step on one config fails."""
     table = {1: config1, 2: config2, 3: config3, 5: config5, 6: config6,
              7: config7, 8: config8, 9: config9, 10: config10,
-             11: config11, 12: config12, 13: config13, 14: config14}
+             11: config11, 12: config12, 13: config13, 14: config14,
+             15: config15}
     failed = 0
     for c in which:
         if c not in table:

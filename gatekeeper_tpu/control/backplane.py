@@ -115,6 +115,7 @@ from typing import Callable, Optional
 
 from ..utils import faults
 from . import jsonio
+from . import liveness
 from . import shm
 from . import trace as gtrace
 from .logging import logger
@@ -165,6 +166,28 @@ STATUS_NOT_READY = 599
 # peer must unblock senders so frontends can answer per the failure
 # stance instead of hanging HTTP threads past their deadlines
 IO_TIMEOUT_S = 2.0
+# frame hygiene: upper bound on any length prefix accepted at parse
+# time. A desynced/corrupted u32 (mid-stream reset, flipped bit) would
+# otherwise commit the reader to recv'ing gigabytes of garbage and then
+# smear every subsequent parse; an oversized header is treated as a
+# torn stream — clean connection close, the client re-handshakes. Must
+# comfortably exceed the largest legal frame (bulk B frames carry whole
+# inventory slices; admission bodies are ~MBs at worst).
+MAX_FRAME_LEN = 256 * 1024 * 1024
+
+
+class FrameDesyncError(ConnectionError):
+    """A length prefix failed the hygiene bound — the stream is torn
+    (desynced or corrupted) and the only safe recovery is to drop the
+    connection and re-handshake."""
+
+
+def _check_frame_len(length: int) -> int:
+    if length > MAX_FRAME_LEN:
+        raise FrameDesyncError(
+            f"backplane frame length {length} exceeds bound "
+            f"{MAX_FRAME_LEN}; closing desynced connection")
+    return length
 
 
 class BackplaneError(Exception):
@@ -214,6 +237,10 @@ def _send_frame(sock: socket.socket, lock: threading.Lock,
     back to flattening just the unsent remainder."""
     plen = sum(len(p) for p in parts)
     header = struct.pack("!I", plen)
+    wire_fault = faults.consume("backplane.wire")
+    if wire_fault is not None:
+        _fault_frame(sock, lock, header, parts, wire_fault)
+        return
     bufs = (header, *parts)
     if len(bufs) > 1000:
         # sendmsg is capped at IOV_MAX (1024) iovecs — a bulk B frame
@@ -231,6 +258,51 @@ def _send_frame(sock: socket.socket, lock: threading.Lock,
         if sent < 4 + plen:
             rest = b"".join(bufs)
             sock.sendall(memoryview(rest)[sent:])
+
+
+def _fault_frame(sock: socket.socket, lock: threading.Lock,
+                 header: bytes, parts: tuple, fault: tuple) -> None:
+    """Act out an armed backplane.wire fault on this frame.
+
+    reset    -> close the socket without sending a byte and raise as if
+                the kernel reset the connection mid-frame
+    truncate -> write the header + a partial payload, then close: the
+                peer's length-prefixed read blocks on bytes that never
+                come until its ConnectionError on the close
+    slow     -> drip the frame out in small chunks with delays (frame
+                eventually completes; exercises IO_TIMEOUT_S retries)
+    """
+    mode, param = fault
+    frame = header + b"".join(parts)
+    with lock:
+        if mode == "reset":
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+            raise ConnectionResetError(
+                "injected backplane.wire reset mid-frame")
+        if mode == "truncate":
+            cut = max(4, len(frame) // 2)
+            try:
+                sock.sendall(frame[:cut])
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+            raise ConnectionResetError(
+                "injected backplane.wire truncated frame")
+        # slow drip: param carries the per-chunk delay in seconds
+        try:
+            delay = float(param) if param else 0.05
+        except ValueError:
+            delay = 0.05
+        chunk = 64
+        for off in range(0, len(frame), chunk):
+            sock.sendall(frame[off:off + chunk])
+            # gklint: allow(block-zone) reason=the slow-drip fault EXISTS to stall this send; only reachable with backplane.wire armed by a chaos run
+            time.sleep(delay)
 
 
 # ----------------------------------------------------------------- engine
@@ -433,7 +505,7 @@ class BackplaneEngine:
         try:
             while not self._stop.is_set():
                 (length,) = struct.unpack("!I", _recv_exact(conn, 4))
-                payload = _recv_exact(conn, length)
+                payload = _recv_exact(conn, _check_frame_len(length))
                 kind = payload[:1]
                 if kind == b"Q":
                     rid, timeout_s = _Q_HEADER.unpack_from(payload, 1)
@@ -627,6 +699,9 @@ class BackplaneEngine:
                         log.error("stats poll failed", details=str(e))
                         _send_frame(conn, wlock, b"R",
                                     _R_HEADER.pack(rid, 500), b"")
+        except FrameDesyncError as e:
+            log.error("backplane frame desync; dropping connection",
+                      details=str(e))
         except (ConnectionError, OSError):
             pass
         finally:
@@ -1039,13 +1114,21 @@ class BackplaneClient:
 
     def _drop(self, sock: socket.socket) -> None:
         with self._conn_lock:
-            if self._sock is sock:
+            current = self._sock is sock
+            if current:
                 self._sock = None
-        self._ring_ok.clear()
         try:
             sock.close()
         except OSError:
             pass
+        if not current:
+            # stale drop: the old reader thread unwinding AFTER the
+            # sender already dropped (or replaced) this connection.
+            # Its waiters were failed by the first drop — touching
+            # the pending table again would kill requests riding the
+            # replacement connection.
+            return
+        self._ring_ok.clear()
         # every in-flight request on the dead connection fails NOW —
         # the frontends answer per the failure stance instead of
         # letting HTTP callers hang into their own timeouts
@@ -1064,7 +1147,7 @@ class BackplaneClient:
         try:
             while True:
                 (length,) = struct.unpack("!I", _recv_exact(sock, 4))
-                payload = _recv_exact(sock, length)
+                payload = _recv_exact(sock, _check_frame_len(length))
                 kind = payload[:1]
                 if kind == b"R":
                     rid, status = _R_HEADER.unpack_from(payload, 1)
@@ -1097,6 +1180,9 @@ class BackplaneClient:
                     ack = jsonio.loads(payload[1:]) or {}
                     if ack.get("rings") and self._rings is not None:
                         self._ring_ok.set()
+        except FrameDesyncError as e:
+            log.error("backplane frame desync; dropping connection",
+                      details=str(e))
         except (ConnectionError, OSError, struct.error):
             pass
         finally:
@@ -1750,6 +1836,20 @@ class FrontendSupervisor:
         self._procs: list[Optional[subprocess.Popen]] = [None] * n
         self._stopping = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+        # gray-failure liveness: frontends print an HB line on stdout
+        # every second (frontend_main); the per-slot reader thread
+        # stamps arrival times here and the monitor loop declares a
+        # child WEDGED — alive but silent past the deadline — and
+        # SIGKILLs it onto the ordinary respawn path. Death-only
+        # detection (waitpid) misses a SIGSTOP'd/hung frontend, which
+        # holds its SO_REUSEPORT share and blackholes its connections.
+        self.heartbeat_deadline_s = 10.0
+        self._hb: dict[int, float] = {}
+        # crash-loop rate limiting + MTTR accounting
+        self._backoff = liveness.Backoff("frontend")
+        self._spawned_at: dict[int, float] = {}
+        self._respawn_at: dict[int, float] = {}
+        self._detected: dict[int, tuple] = {}  # k -> (t_detect, fault)
 
     def _ring_prefix(self, k: int) -> str:
         # deterministic per worker SLOT (not per child pid): the
@@ -1827,39 +1927,123 @@ class FrontendSupervisor:
         if not line or "READY" not in (line[0] or ""):
             raise RuntimeError(
                 f"admission frontend {k} failed to start")
-        # drain any later stdout so the pipe can never fill and block
-        threading.Thread(target=lambda: proc.stdout.read(),
+        # reader thread for the child's remaining stdout: keeps the
+        # pipe from ever filling (the old full-read drain's job) AND
+        # stamps every line — the 1/s HB lines above all — as this
+        # slot's liveness heartbeat
+        self._hb[k] = time.monotonic()
+        self._spawned_at[k] = time.monotonic()
+        threading.Thread(target=self._pump_heartbeats, args=(k, proc),
                          daemon=True).start()
+
+    def _pump_heartbeats(self, k: int, proc: subprocess.Popen) -> None:
+        try:
+            for _ in proc.stdout:
+                self._hb[k] = time.monotonic()
+        except (OSError, ValueError):
+            pass  # child died / pipe closed: poll() takes it from here
 
     def _monitor_loop(self) -> None:
         while not self._stopping.wait(0.5):
+            now = time.monotonic()
             for k, proc in enumerate(self._procs):
-                if proc is not None and proc.poll() is not None \
-                        and not self._stopping.is_set():
+                if proc is None or self._stopping.is_set():
+                    continue
+                dead = proc.poll() is not None
+                if not dead and k not in self._detected \
+                        and now - self._hb.get(k, now) \
+                        > self.heartbeat_deadline_s:
+                    # gray failure: the process is alive but has not
+                    # written a heartbeat past the deadline (SIGSTOP,
+                    # hung accept loop). SIGKILL it; the respawn path
+                    # below heals it like any crash.
+                    log.warning(
+                        "admission frontend wedged (no heartbeat); "
+                        "killing",
+                        details={"worker": k,
+                                 "hb_age_s":
+                                     round(now - self._hb[k], 2)})
+                    self._detected[k] = (now, "wedge")
+                    try:
+                        proc.kill()
+                        proc.wait(5.0)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+                    dead = proc.poll() is not None
+                if not dead:
+                    if self._backoff.pending(k) \
+                            and now - self._spawned_at.get(k, now) \
+                            >= self._backoff.healthy_after:
+                        self._backoff.note_healthy(k)
+                    continue
+                if k not in self._detected:
                     log.warning("admission frontend died; respawning",
                                 details={"worker": k,
                                          "rc": proc.returncode})
-                    p = None
-                    try:
-                        p = self._spawn(k)
-                        self._await_ready(
-                            k, p, time.monotonic() + self.ready_timeout)
-                        self._procs[k] = p
-                    except Exception as e:
-                        log.error("frontend respawn failed",
-                                  details={"worker": k, "error": str(e)})
-                        # never leak a half-started child: it may hold
-                        # the SO_REUSEPORT bind and receive live
-                        # connections while untracked
-                        if p is not None:
-                            try:
-                                p.kill()
-                            except OSError:
-                                pass
+                    self._detected[k] = (now, "death")
+                    uptime = now - self._spawned_at.get(k, now)
+                    delay = self._backoff.delay_for(k, uptime)
+                    self._respawn_at[k] = now + delay
+                if now < self._respawn_at.get(k, now):
+                    continue  # holding the crash-loop backoff delay
+                p = None
+                try:
+                    p = self._spawn(k)
+                    self._await_ready(
+                        k, p, time.monotonic() + self.ready_timeout)
+                    self._procs[k] = p
+                    self._backoff.respawned(k)
+                    self._respawn_at.pop(k, None)
+                    t0, fault = self._detected.pop(k, (now, "death"))
+                    from . import metrics as _metrics
+                    _metrics.report_fault_recovery(
+                        "frontend", fault, time.monotonic() - t0)
+                except Exception as e:
+                    log.error("frontend respawn failed",
+                              details={"worker": k, "error": str(e)})
+                    # never leak a half-started child: it may hold
+                    # the SO_REUSEPORT bind and receive live
+                    # connections while untracked
+                    if p is not None:
+                        try:
+                            p.kill()
+                        except OSError:
+                            pass
+                    # the failed attempt counts as another fast death
+                    # for the backoff ladder
+                    self._respawn_at[k] = time.monotonic() + \
+                        self._backoff.delay_for(k, 0.0)
 
     def alive(self) -> bool:
         return all(p is not None and p.poll() is None
                    for p in self._procs)
+
+    # chaos hooks ----------------------------------------------------
+
+    def child_pids(self) -> dict[int, int]:
+        """Live child pids by worker slot (the chaos verifier's
+        process-leak baseline)."""
+        return {k: p.pid for k, p in enumerate(self._procs)
+                if p is not None and p.poll() is None}
+
+    def kill_child(self, k: int) -> None:
+        """Chaos hook: SIGKILL one frontend (the monitor respawns it;
+        the kernel re-balances its SO_REUSEPORT share meanwhile)."""
+        proc = self._procs[k] if 0 <= k < len(self._procs) else None
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    def pause_child(self, k: int) -> None:
+        """Chaos hook: SIGSTOP one frontend — alive to waitpid, silent
+        on the wire. Only the heartbeat deadline can catch this."""
+        proc = self._procs[k] if 0 <= k < len(self._procs) else None
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGSTOP)
+
+    def resume_child(self, k: int) -> None:
+        proc = self._procs[k] if 0 <= k < len(self._procs) else None
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGCONT)
 
     def stop(self, timeout: float = 15.0) -> None:
         """SIGTERM every frontend (each drains its in-flight HTTP
@@ -1869,6 +2053,10 @@ class FrontendSupervisor:
         for proc in self._procs:
             if proc is not None and proc.poll() is None:
                 try:
+                    # a SIGSTOP'd child (chaos pause) cannot handle
+                    # SIGTERM while stopped: resume it first so it can
+                    # drain; SIGCONT on a running child is a no-op
+                    os.kill(proc.pid, signal.SIGCONT)
                     proc.terminate()
                 except OSError:
                     pass
@@ -1880,9 +2068,14 @@ class FrontendSupervisor:
                 proc.wait(max(0.1, end - time.monotonic()))
             except subprocess.TimeoutExpired:
                 proc.kill()
+                try:  # reap: an unwaited kill leaves a zombie that
+                    proc.wait(5.0)  # still answers os.kill(pid, 0)
+                except subprocess.TimeoutExpired:
+                    pass
         if self._holder is not None:
             self._holder.close()
             self._holder = None
+        self._backoff.close()
         # a gracefully-exited frontend unlinked its own rings; sweep
         # anyway so a kill -9'd child cannot leak /dev/shm segments
         for k in range(self.n):
@@ -1904,9 +2097,14 @@ class EngineSupervisor:
     the primary's /metrics."""
 
     POLL_INTERVAL_S = 2.0
+    # labels for the recovery histogram / backoff gauges; the audit
+    # subclass overrides both
+    RECOVERY_COMPONENT = "engine"
+    SUPERVISOR_LABEL = "engine"
 
     def __init__(self, engine_ids, socket_for, spawn_args=(),
-                 snapshot_provider=None, ready_timeout: float = 180.0):
+                 snapshot_provider=None, ready_timeout: float = 180.0,
+                 heartbeat_deadline_s: float = 10.0):
         self.engine_ids = list(engine_ids)
         self.socket_for = socket_for          # engine id -> socket path
         self.spawn_args = list(spawn_args)    # passthrough CLI flags
@@ -1920,6 +2118,25 @@ class EngineSupervisor:
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+        # gray-failure liveness: the M-frame stats poll doubles as the
+        # heartbeat — a child that is alive to waitpid but has not
+        # ANSWERED a poll (or resync) within this deadline is wedged
+        # (SIGSTOP, spinning, hung device) and gets SIGKILLed onto the
+        # ordinary respawn+resync path. Must comfortably exceed
+        # POLL_INTERVAL_S plus the poll timeout.
+        self.heartbeat_deadline_s = heartbeat_deadline_s
+        self._last_ok: dict[int, float] = {}
+        # stamped before each poll; a child is only wedged if a poll
+        # was ATTEMPTED after its last answer — polls are serialized,
+        # so one wedged sibling stalling its 5 s poll timeout must not
+        # age a healthy (simply not-yet-re-polled) child past the
+        # deadline and get it falsely killed
+        self._last_attempt: dict[int, float] = {}
+        # crash-loop rate limiting + MTTR accounting
+        self._backoff = liveness.Backoff(self.SUPERVISOR_LABEL)
+        self._spawned_at: dict[int, float] = {}
+        self._respawn_at: dict[int, float] = {}
+        self._detected: dict[int, tuple] = {}  # k -> (t_detect, fault)
         # fan-out actuation (adaptive controller): how many children
         # should be RUNNING. Children beyond the prefix are "parked" —
         # terminated and not respawned until the count rises again.
@@ -1933,6 +2150,12 @@ class EngineSupervisor:
         self._knobs_pushed: dict[int, int] = {}
 
     # spawn / readiness ----------------------------------------------
+
+    def engine_label(self, k: int) -> str:
+        """The `engine=` label this child relays its stats under; gauge
+        zeroing on park/death/stop must target the SAME string or a
+        dead child's duty/depth series outlives it."""
+        return str(k)
 
     def _spawn(self, k: int) -> subprocess.Popen:
         cmd = [sys.executable, "-m", "gatekeeper_tpu.control.engine",
@@ -1953,6 +2176,10 @@ class EngineSupervisor:
         t.join(max(0.1, deadline - time.monotonic()))
         if not line or "READY" not in (line[0] or ""):
             raise RuntimeError(f"admission engine {k} failed to start")
+        # liveness stamps: the child just proved it can talk; the
+        # heartbeat deadline measures from here until its first
+        # answered poll
+        self._spawned_at[k] = self._last_ok[k] = time.monotonic()
         threading.Thread(target=lambda: proc.stdout.read(),
                          daemon=True).start()
 
@@ -2007,6 +2234,7 @@ class EngineSupervisor:
                 op = provider()
                 op["op"] = "sync"
                 self._ctl[k].control(op, timeout=120.0)
+                self._last_ok[k] = time.monotonic()
                 log.info("engine resynced", details={"engine": k})
             except Exception as e:
                 self._dirty[k] = True
@@ -2091,6 +2319,40 @@ class EngineSupervisor:
         last_poll = 0.0
         while not self._stopping.wait(0.5):
             active = self._active_ids()
+            now = time.monotonic()
+            # gray-failure pass: a child that is ALIVE to waitpid but
+            # has not answered a stats poll or resync within the
+            # heartbeat deadline is WEDGED (SIGSTOP'd, spinning, hung
+            # on its device) — death-only detection would leave it
+            # holding its socket while frontends pile failovers onto
+            # survivors. SIGKILL it; the respawn pass below heals it
+            # like any crash.
+            for k in self.engine_ids:
+                if k not in active or k in self._detected:
+                    continue
+                proc = self._procs.get(k)
+                if proc is None or proc.poll() is not None:
+                    continue
+                last_ok = self._last_ok.get(k, now)
+                age = now - last_ok
+                if age > self.heartbeat_deadline_s \
+                        and self._last_attempt.get(k, 0.0) > last_ok:
+                    log.warning(
+                        "engine child wedged (no poll answer); "
+                        "killing",
+                        details={"engine": k,
+                                 "supervisor": self.SUPERVISOR_LABEL,
+                                 "poll_age_s": round(age, 2)})
+                    self._detected[k] = (now, "wedge")
+                    try:
+                        proc.kill()
+                        proc.wait(5.0)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+                elif self._backoff.pending(k) \
+                        and now - self._spawned_at.get(k, now) \
+                        >= self._backoff.healthy_after:
+                    self._backoff.note_healthy(k)
             # park pass: children beyond the desired fan-out stop
             # (graceful terminate -> batcher drain) and stay down; the
             # frontends' router fails their sockets over to survivors
@@ -2107,8 +2369,12 @@ class EngineSupervisor:
                     old.close()
                 self._prev_stats.pop(k, None)
                 self._knobs_pushed.pop(k, None)
+                # a park mid-recovery cancels the recovery: down on
+                # purpose now, not a fault being healed
+                self._detected.pop(k, None)
+                self._respawn_at.pop(k, None)
                 from . import metrics as _metrics
-                _metrics.zero_engine_gauges(str(k))
+                _metrics.zero_engine_gauges(self.engine_label(k))
                 try:
                     proc.terminate()
                 except OSError:
@@ -2122,32 +2388,46 @@ class EngineSupervisor:
                 if k not in active:
                     continue  # parked: dead on purpose, no respawn
                 proc = self._procs.get(k)
-                if proc is not None and proc.poll() is not None \
-                        and not self._stopping.is_set():
+                if proc is None or proc.poll() is None \
+                        or self._stopping.is_set():
+                    continue
+                now = time.monotonic()
+                if k not in self._detected:
                     log.warning("admission engine died; respawning",
                                 details={"engine": k,
-                                         "rc": proc.returncode})
-                    old = self._ctl.pop(k, None)
-                    if old is not None:
-                        old.close()
-                    self._prev_stats.pop(k, None)
-                    # the replacement process boots with configured
-                    # defaults: forget any knob ack so the newest
-                    # payload re-pushes after its resync
-                    self._knobs_pushed.pop(k, None)
-                    # the dead child's relayed engine-labeled gauges
-                    # must not export its last depth/duty while it is
-                    # down (respawn's first poll would eventually
-                    # overwrite them — or never, if respawn keeps
-                    # failing)
-                    from . import metrics as _metrics
-                    _metrics.zero_engine_gauges(str(k))
-                    try:
-                        spawned.append((k, self._spawn(k)))
-                    except Exception as e:
-                        log.error("engine respawn failed",
-                                  details={"engine": k,
-                                           "error": str(e)})
+                                         "rc": proc.returncode,
+                                         "supervisor":
+                                             self.SUPERVISOR_LABEL})
+                    self._detected[k] = (now, "death")
+                if k not in self._respawn_at:
+                    uptime = now - self._spawned_at.get(k, now)
+                    self._respawn_at[k] = \
+                        now + self._backoff.delay_for(k, uptime)
+                if now < self._respawn_at[k]:
+                    continue  # holding the crash-loop backoff delay
+                old = self._ctl.pop(k, None)
+                if old is not None:
+                    old.close()
+                self._prev_stats.pop(k, None)
+                # the replacement process boots with configured
+                # defaults: forget any knob ack so the newest
+                # payload re-pushes after its resync
+                self._knobs_pushed.pop(k, None)
+                # the dead child's relayed engine-labeled gauges
+                # must not export its last depth/duty while it is
+                # down (respawn's first poll would eventually
+                # overwrite them — or never, if respawn keeps
+                # failing)
+                from . import metrics as _metrics
+                _metrics.zero_engine_gauges(self.engine_label(k))
+                try:
+                    spawned.append((k, self._spawn(k)))
+                except Exception as e:
+                    log.error("engine respawn failed",
+                              details={"engine": k,
+                                       "error": str(e)})
+                    self._respawn_at[k] = time.monotonic() + \
+                        self._backoff.delay_for(k, 0.0)
             for k, p in spawned:
                 try:
                     self._await_ready(
@@ -2157,6 +2437,8 @@ class EngineSupervisor:
                         self.socket_for(k), worker_id=f"ctl-{k}",
                         connect_timeout=5.0)
                     self._dirty[k] = True
+                    self._respawn_at.pop(k, None)
+                    self._backoff.respawned(k)
                     # sync NOW, not next pass: the engine refuses
                     # admission (NOT_READY) until this lands, so the
                     # shorter the window the less failover traffic
@@ -2171,9 +2453,26 @@ class EngineSupervisor:
                         p.kill()
                     except OSError:
                         pass
+                    self._respawn_at[k] = time.monotonic() + \
+                        self._backoff.delay_for(k, 0.0)
             for k in self.engine_ids:
                 if self._dirty.get(k) and k in self._ctl:
                     self._resync(k)
+            # recovery accounting: a detected-failed child counts as
+            # recovered once its replacement is alive AND resynced —
+            # the wall clock from detection to here is the MTTR the
+            # fault_recovery histogram exports
+            for k in list(self._detected):
+                proc = self._procs.get(k)
+                if proc is None or proc.poll() is not None \
+                        or self._dirty.get(k) or k not in self._ctl:
+                    continue
+                t0, fault = self._detected.pop(k)
+                self._respawn_at.pop(k, None)
+                from . import metrics as _metrics
+                _metrics.report_fault_recovery(
+                    self.RECOVERY_COMPONENT, fault,
+                    time.monotonic() - t0)
             self._push_knobs()
             now = time.monotonic()
             if now - last_poll >= self.POLL_INTERVAL_S:
@@ -2200,10 +2499,19 @@ class EngineSupervisor:
             ctl = self._ctl.get(k)
             if ctl is None:
                 continue
+            self._last_attempt[k] = time.monotonic()
             try:
-                cur = ctl.poll_stats(timeout=5.0)
+                # the poll timeout bounds wedge-detection latency (a
+                # SIGSTOP'd child is only detectable once its poll
+                # EXPIRES), so scale it with the heartbeat deadline
+                # instead of always waiting the full production 5 s
+                cur = ctl.poll_stats(timeout=min(
+                    5.0, max(1.0, self.heartbeat_deadline_s)))
             except BackplaneError:
                 continue  # dead/respawning engine: next pass
+            # an answered poll IS the heartbeat: only a child whose
+            # read loop is actually scheduling can produce one
+            self._last_ok[k] = time.monotonic()
             metrics.merge_engine_stats(cur, self._prev_stats.get(k))
             self._prev_stats[k] = cur
 
@@ -2230,6 +2538,25 @@ class EngineSupervisor:
         if proc is not None and proc.poll() is None:
             proc.kill()
 
+    def pause_engine(self, k: int) -> None:
+        """Chaos hook: SIGSTOP one engine child — alive to waitpid,
+        silent on the wire. Only the poll-age heartbeat deadline can
+        catch this (the gray-failure case)."""
+        proc = self._procs.get(k)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGSTOP)
+
+    def resume_engine(self, k: int) -> None:
+        proc = self._procs.get(k)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGCONT)
+
+    def child_pids(self) -> dict[int, int]:
+        """Live child pids by engine id (the chaos verifier's
+        process-leak baseline)."""
+        return {k: p.pid for k, p in self._procs.items()
+                if p is not None and p.poll() is None}
+
     def stop(self, timeout: float = 15.0) -> None:
         self._stopping.set()
         for ctl in self._ctl.values():
@@ -2238,6 +2565,9 @@ class EngineSupervisor:
         for proc in self._procs.values():
             if proc is not None and proc.poll() is None:
                 try:
+                    # resume a SIGSTOP'd child first — see
+                    # FrontendSupervisor.stop
+                    os.kill(proc.pid, signal.SIGCONT)
                     proc.terminate()
                 except OSError:
                     pass
@@ -2249,11 +2579,16 @@ class EngineSupervisor:
                 proc.wait(max(0.1, end - time.monotonic()))
             except subprocess.TimeoutExpired:
                 proc.kill()
+                try:
+                    proc.wait(5.0)
+                except subprocess.TimeoutExpired:
+                    pass
         # stopped children's relayed engine-labeled gauges must not
         # outlive them on the primary's exposition
         from . import metrics
         for k in self.engine_ids:
-            metrics.zero_engine_gauges(str(k))
+            metrics.zero_engine_gauges(self.engine_label(k))
+        self._backoff.close()
 
 
 class AuditShardSupervisor(EngineSupervisor):
@@ -2272,16 +2607,31 @@ class AuditShardSupervisor(EngineSupervisor):
       * `sweep()`: the Q-frame request that runs one slice sweep on a
         shard's dedicated audit executor and returns its serialized
         per-kind results.
+
+    Liveness rides the inherited M-frame poll-age heartbeat: slice
+    sweeps run on the child's dedicated audit executor, so its read
+    loop keeps answering polls through a multi-second sweep — only a
+    genuinely wedged (SIGSTOP'd/hung) shard goes silent, gets killed,
+    and is healed by respawn+resync; the leader's sweep retry then
+    re-dispatches just the orphaned partition.
     """
 
+    RECOVERY_COMPONENT = "audit_shard"
+    SUPERVISOR_LABEL = "audit"
+
     def __init__(self, shard_count: int, socket_for, spawn_args=(),
-                 snapshot_provider=None, ready_timeout: float = 180.0):
+                 snapshot_provider=None, ready_timeout: float = 180.0,
+                 heartbeat_deadline_s: float = 10.0):
         super().__init__(range(shard_count), socket_for, spawn_args,
                          snapshot_provider=None,
-                         ready_timeout=ready_timeout)
+                         ready_timeout=ready_timeout,
+                         heartbeat_deadline_s=heartbeat_deadline_s)
         self.shard_count = int(shard_count)
         self._shard_snapshot = snapshot_provider  # (k) -> sync op
         self.generation: dict[int, int] = {k: 0 for k in self.engine_ids}
+
+    def engine_label(self, k: int) -> str:
+        return f"audit{k}"  # matches --engine-id in _spawn
 
     def _spawn(self, k: int) -> subprocess.Popen:
         cmd = [sys.executable, "-m", "gatekeeper_tpu.control.engine",
@@ -2305,6 +2655,7 @@ class AuditShardSupervisor(EngineSupervisor):
                 op = provider(k)
                 op["op"] = "sync"
                 self._ctl[k].control(op, timeout=300.0)
+                self._last_ok[k] = time.monotonic()
                 self.generation[k] = self.generation.get(k, 0) + 1
                 log.info("audit shard resynced",
                          details={"shard": k,
@@ -2433,6 +2784,20 @@ def frontend_main(argv=None) -> int:
     except BackplaneError:
         pass  # engine not up yet; the first forward retries
     print(f"READY {server.port}", flush=True)
+
+    def heartbeat():
+        # 1/s liveness heartbeat on the supervisor pipe: the parent's
+        # reader stamps each line, so a SIGSTOP'd/wedged frontend goes
+        # silent and trips the heartbeat deadline. A closed pipe
+        # (supervisor gone) ends the loop instead of crashing serving.
+        while not stop.wait(1.0):
+            try:
+                print("HB", flush=True)
+            except (OSError, ValueError):
+                return
+
+    threading.Thread(target=heartbeat, name="frontend-heartbeat",
+                     daemon=True).start()
     stop.wait()
     server.stop()
     return 0

@@ -217,6 +217,25 @@ class FakeKube:
         self._notify(tuple(gvk), WatchEvent("DELETED", copy.deepcopy(obj)))
 
     def list(self, gvk: GVK, namespace: Optional[str] = None) -> list[dict]:
+        # apiserver-flap chaos: kube.list simulates the control plane's
+        # read path degrading — 410 storms (compaction raced the list),
+        # 429 rate limiting, 5xx blips, or a stalled response (sleep).
+        # Armed with a rate (kube.list:error:429@0.5) it flaps rather
+        # than hard-fails, which is the gray shape real apiservers show.
+        from ..utils import faults
+        flt = faults.consume("kube.list", gvk=tuple(gvk))
+        if flt is not None:
+            mode, param = flt
+            if mode == "sleep":
+                time.sleep(float(param) if param else 1.0)
+            else:
+                try:
+                    code = int(param) if param else 503
+                except ValueError:
+                    code = 503
+                raise KubeError(
+                    f"injected apiserver fault on list ({code})",
+                    code=code)
         with self._lock:
             self._record(("list", tuple(gvk), namespace))
             out = []

@@ -20,6 +20,7 @@ import time
 from ..client import Backend
 from ..ir import TpuDriver
 from ..target import K8sValidationTarget
+from . import chaos as chaos_debug
 from . import health
 from . import logging as glog
 from . import metrics
@@ -1006,6 +1007,11 @@ class Runtime:
                                    else {"disabled": True,
                                          "hint": "--adaptive-control "
                                                  "arms the controller"}),
+            # the chaos ledger: active/last schedule + what fired, plus
+            # the fault injector's armed/fired snapshots (answers even
+            # with no orchestrator — a GATEKEEPER_TPU_FAULTS game day
+            # still shows its armed points here)
+            "chaos": chaos_debug.debug_snapshot,
         }
 
     def _on_adaptive_actuation(self, act) -> None:

@@ -796,6 +796,80 @@ def report_admission_engines(configured: int, alive: int) -> None:
                        alive, state="alive")
 
 
+# chaos & recovery: the orchestrator's recovery clock and the
+# supervisors' respawn-storm rate limiting, exported as data so MTTR
+# is a dashboard read, not a log grep. Bounded label sets with the
+# fold discipline like every other enumerated label.
+RECOVERY_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0, 15.0, 30.0,
+                    60.0, 120.0)
+CHAOS_COMPONENTS = ("frontend", "engine", "audit_shard", "leader",
+                    "apiserver", "backplane", "state")
+CHAOS_FAULT_KINDS = ("kill", "pause", "death", "wedge", "flap", "wire",
+                     "corrupt", "disk")
+SUPERVISOR_KINDS = ("frontend", "engine", "audit")
+
+
+def report_fault_recovery(component: str, fault: str,
+                          seconds: float) -> None:
+    """One completed recovery: the wall clock from fault detection (a
+    child seen dead/wedged, a flap's first error) to the plane healthy
+    again (respawned AND resynced / breaker closed). The MTTR series
+    bench config 15 distills into its headline matrix."""
+    if component not in CHAOS_COMPONENTS:
+        component = LABEL_FOLD
+    if fault not in CHAOS_FAULT_KINDS:
+        fault = LABEL_FOLD
+    REGISTRY.observe("gatekeeper_tpu_fault_recovery_seconds",
+                     "Fault-to-recovered wall clock by component and "
+                     "fault kind", seconds, buckets=RECOVERY_BUCKETS,
+                     component=component, fault=fault)
+
+
+def report_respawn_backoff(supervisor: str, seconds: float) -> None:
+    """Current respawn-backoff delay one supervisor is holding (0 when
+    its children are healthy): a sustained non-zero value is a child
+    stuck in a crash loop, rate-limited instead of hot-looping."""
+    if supervisor not in SUPERVISOR_KINDS:
+        supervisor = LABEL_FOLD
+    REGISTRY.gauge_set("gatekeeper_tpu_respawn_backoff_seconds",
+                       "Current jittered-exponential respawn delay per "
+                       "supervisor (0 = healthy)", seconds,
+                       supervisor=supervisor)
+
+
+def report_crashloop_breaker(supervisor: str, tripped: bool) -> None:
+    """Crash-loop breaker state per supervisor: 1 after a child has
+    died CRASHLOOP_TRIP consecutive times faster than the healthy
+    threshold (respawns continue at the capped delay); back to 0 once
+    a child survives past it."""
+    if supervisor not in SUPERVISOR_KINDS:
+        supervisor = LABEL_FOLD
+    REGISTRY.gauge_set("gatekeeper_tpu_crashloop_breaker",
+                       "1 while a supervisor's child is in a detected "
+                       "crash loop (respawn delay capped)",
+                       1.0 if tripped else 0.0, supervisor=supervisor)
+
+
+def zero_supervisor_gauges(supervisor: str) -> None:
+    """Teardown zeroing for the supervisor-labeled chaos gauges (the
+    PR 13 stale-export discipline): a stopped supervisor must not
+    export its last backoff/breaker state forever."""
+    report_respawn_backoff(supervisor, 0.0)
+    report_crashloop_breaker(supervisor, False)
+
+
+def gauge_series(name: str) -> dict[tuple, float]:
+    """Label-values-tuple -> current value for one gauge family (empty
+    when the family never registered). The chaos verifier's stale-gauge
+    invariant reads the gklint lifecycle families through this after
+    teardown: every series must be zero."""
+    m = REGISTRY._metrics.get(name)
+    if m is None:
+        return {}
+    with m.lock:
+        return dict(m.values)
+
+
 # counters/histograms an engine child relays to the primary over the
 # backplane M frame (all monotonic — the delta merge assumes it), so
 # shed accounting, decision counts, cache outcomes, and per-engine

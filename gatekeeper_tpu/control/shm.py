@@ -52,6 +52,7 @@ failing that frontend's in-flight requests exactly as before.
 
 from __future__ import annotations
 
+import os
 import struct
 import threading
 from collections import deque
@@ -362,3 +363,47 @@ def sweep_stale(prefix: str) -> None:
     shutdown path: a SIGKILLed frontend leaves its segments behind)."""
     for suffix in ("-q", "-r"):
         unlink(prefix + suffix)
+
+
+# ------------------------------------------------------------ chaos hooks
+
+
+_SHM_DIR = "/dev/shm"
+
+
+def list_segments(prefix: str = "") -> list[str]:
+    """Names of live /dev/shm segments starting with `prefix` (the
+    chaos verifier's leak check: after a schedule + teardown, no
+    gk-bp-* segment may remain). Empty where /dev/shm is absent."""
+    try:
+        names = os.listdir(_SHM_DIR)
+    except OSError:
+        return []
+    return sorted(n for n in names if n.startswith(prefix))
+
+
+def corrupt_segment(name: str, offset: int = 0,
+                    pattern: bytes = b"\xde\xad\xbe\xef") -> bool:
+    """Chaos action: stamp `pattern` into a live segment at `offset`
+    without the owner's locks — a torn/corrupted record the reader
+    must survive (parse failure -> 400 / inline retry, never a smeared
+    verdict). Returns False when the segment does not exist."""
+    if _shm is None:
+        return False
+    try:
+        seg = _shm.SharedMemory(name=name)
+    except (OSError, ValueError):
+        return False
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:
+        pass
+    try:
+        end = min(len(seg.buf), offset + len(pattern))
+        if end > offset:
+            seg.buf[offset:end] = pattern[: end - offset]
+        return True
+    finally:
+        _close_quiet(seg)

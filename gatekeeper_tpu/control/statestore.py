@@ -111,6 +111,14 @@ class StateStore:
     # ----------------------------------------------------------------- save
 
     def _write_atomic(self, path: str, data: bytes) -> None:
+        # disk-pressure chaos: state.disk simulates the volume itself
+        # failing under us — ENOSPC (full) or EIO (device error) — as
+        # the kernel would raise it, so every save path exercises its
+        # previous-snapshot-kept contract against real errno shapes
+        flt = faults.consume("state.disk", path=path)
+        if flt is not None:
+            errno_ = 5 if (flt[1] or "enospc") == "eio" else 28
+            raise OSError(errno_, os.strerror(errno_), path + ".tmp")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)

@@ -42,6 +42,17 @@ Injection points in the tree (grep for faults.fire / faults.consume):
                    engine is unreachable and the frontend answers per
                    the fail-open/closed stance; sleep -> a slow
                    backplane)
+    backplane.wire control/backplane.py    _send_frame — the wire
+                   itself (modes: reset -> the socket closes mid-frame;
+                   truncate -> a partial frame is written then the
+                   socket closes; slow -> the frame drips out in small
+                   chunks with delays, holding the peer's read loop)
+    state.disk     control/statestore.py   _write_atomic (modes:
+                   enospc/eio -> the write raises OSError as if the
+                   state dir ran out of space / the device errored)
+    kube.list      control/kube.py         FakeKube.list — apiserver
+                   flap (error param carries the HTTP code: 410 forces
+                   relist storms, 429 rate-limit storms)
 """
 
 from __future__ import annotations
@@ -151,6 +162,27 @@ class FaultInjector:
     def armed(self) -> list[str]:
         with self._lock:
             return sorted(self._specs)
+
+    def armed_snapshot(self) -> dict[str, dict]:
+        """Full armed-state snapshot for /debug/chaos: point -> the
+        spec's observable fields (mode, param, rate, remaining count).
+        An aborted schedule reports which faults were still pending."""
+        with self._lock:
+            return {
+                point: {
+                    "mode": spec.mode,
+                    "param": spec.param,
+                    "rate": spec.rate,
+                    "count": spec.count,
+                }
+                for point, spec in sorted(self._specs.items())
+            }
+
+    def fired_snapshot(self) -> dict[str, int]:
+        """All per-point fire counters (points that fired at least
+        once), for the /debug/chaos ledger."""
+        with self._lock:
+            return dict(sorted(self._fired.items()))
 
     # ------------------------------------------------------------- firing
 

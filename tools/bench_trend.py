@@ -46,6 +46,11 @@ _LOWER_BETTER = {
     "warm_first_audit_s", "cold_first_audit_s", "mesh_audit_s",
     "whatif_preview_s", "first_audit_s", "first_call_s",
     "violation_detection_p99_ms", "violation_detection_p50_ms",
+    # chaos MTTR matrix (ISSUE 19): worst recovery wall across the
+    # six-fault matrix, and the verifier's violation count (always 0
+    # in a passing round — the bench asserts it — so the trend gate
+    # only ever sees zeros; kept here to pin the direction)
+    "chaos_mttr_p99_s", "chaos_invariant_violations",
 }
 _HIGHER_BETTER = {
     "audit_cross_product_evals_per_sec_per_chip", "evals_per_sec_per_chip",
@@ -102,7 +107,8 @@ _CONFIG_MIRRORS = {
     "whatif_preview_s", "mesh_audit_s", "mesh_audit_vs_single_device",
     "compile_widening_speedup", "general_library_compiled_fraction",
     "warm_first_audit_s", "sharded_objects_per_sec",
-    "sharded_sweep_wall_s",
+    "sharded_sweep_wall_s", "chaos_mttr_p99_s",
+    "chaos_invariant_violations",
 }
 
 def _ungated(name: str) -> bool:

@@ -1,0 +1,520 @@
+"""Seeded chaos run + crash-consistency verification, end to end.
+
+    JAX_PLATFORMS=cpu python -m tools.chaos_verify [--seed N]
+
+Boots the REAL multi-process plane (pre-forked frontends + engine
+children + audit shard children + FakeKube), generates a fault schedule
+deterministically from one integer seed (printed first — any failure
+replays with `--seed N`), executes it under closed-loop admission load,
+and then asserts the five crash-consistency invariants:
+
+  1. zero unanswered admissions, every verdict matching the stance
+     contract (a stance answer carries allowed == not fail_closed);
+  2. the post-convergence audit round is bit-equal to a clean
+     single-process oracle over an identical cluster;
+  3. at most one lease holder ever writes status (fencing);
+  4. no leaked child processes, fds, or /dev/shm segments;
+  5. no stale lifecycle gauge series after teardown (the gklint
+     gauge-teardown families, checked at runtime).
+
+Three phases, each chaosed from the same seed (+0 / +1 / +2):
+  serve — frontends/engines under kill/pause/wire/apiserver faults;
+  audit — shard children killed/paused between bit-equal rounds;
+  fence — two lease candidates + status writers under steal/expire.
+
+Exit code 0 iff zero invariant violations. `--ledger PATH` writes the
+full machine-readable run (schedule, ledger, verifier report) for CI
+artifact upload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from gatekeeper_tpu.control import chaos  # noqa: E402
+from gatekeeper_tpu.control.chaos import (  # noqa: E402
+    ChaosOrchestrator,
+    ChaosSchedule,
+    LeakBaseline,
+    PlaneHandles,
+    RecordingKube,
+    Verifier,
+)
+from gatekeeper_tpu.utils.faults import FAULTS  # noqa: E402
+
+TARGET = "admission.k8s.gatekeeper.sh"
+
+# the serve phase's fault surface: everything that can hit the
+# admission path. state.* is excluded (this Runtime runs without
+# --state-dir); shard.* belongs to the audit phase.
+SERVE_SURFACE = (
+    "engine.kill", "engine.pause",
+    "frontend.kill", "frontend.pause",
+    "wire.reset", "wire.truncate", "wire.slow",
+    "backplane.error",
+    "kube.flap", "kube.stall",
+    "shm.corrupt", "shm.unlink",
+)
+AUDIT_SURFACE = ("shard.kill", "shard.pause")
+FENCE_SURFACE = ("lease.steal", "lease.expire")
+
+
+def _review(uid: str) -> bytes:
+    return json.dumps({
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {
+            "uid": uid, "operation": "CREATE",
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "object": {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"p-{uid}", "namespace": "default",
+                             "labels": {"owner": "chaos"}}},
+        },
+    }).encode()
+
+
+# ------------------------------------------------------------ serve phase
+
+
+def _load_worker(port: int, ids: list, answered: dict, errors: list,
+                 lock: threading.Lock, retries: int = 8) -> None:
+    """Closed-loop admission client: each uid is retried across
+    reconnects until a 200 envelope lands (the API server re-calls a
+    webhook whose connection died), recording the terminal outcome."""
+    conn = None
+    for uid in ids:
+        last_err = "no attempt"
+        for attempt in range(retries):
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=10)
+                body = _review(uid)
+                conn.request("POST", "/v1/admit?timeout=8s", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status == 200:
+                    with lock:
+                        answered[uid] = (resp.status, json.loads(data))
+                    break
+                last_err = f"http {resp.status}: {data[:80]!r}"
+            except Exception as e:
+                last_err = repr(e)
+                try:
+                    if conn is not None:
+                        conn.close()
+                except Exception:
+                    pass
+                conn = None
+            time.sleep(min(0.05 * (attempt + 1), 0.5))
+        else:
+            with lock:
+                errors.append((uid, last_err))
+    if conn is not None:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+def phase_serve(verifier: Verifier, seed: int, n_actions: int,
+                horizon_s: float, n_requests: int = 160) -> dict:
+    from gatekeeper_tpu.control.main import Runtime, build_parser
+
+    args = build_parser().parse_args([
+        "--fake-kube", "--port", "0", "--prometheus-port", "0",
+        "--disable-cert-rotation", "--health-addr", ":0",
+        "--operation", "webhook", "--admission-workers", "2",
+        "--admission-engines", "2"])
+    rt = Runtime(args)
+    rt.args.metrics_backend = "none"
+
+    plane = PlaneHandles(kube=rt.kube)
+    baseline = LeakBaseline(plane).capture()
+    rt.start()
+    plane.frontends = rt.frontends
+    plane.engines = rt.engines
+    # tight deadlines so a SIGSTOP'd child is detected within the run,
+    # not the production 10s
+    rt.frontends.heartbeat_deadline_s = 3.0
+    if rt.engines is not None:
+        rt.engines.heartbeat_deadline_s = 3.0
+    try:
+        deadline = time.monotonic() + 30
+        while rt.backplane.connected < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        baseline.track_children()
+
+        schedule = ChaosSchedule.generate(
+            seed, surface=SERVE_SURFACE, n_actions=n_actions,
+            horizon_s=horizon_s)
+        orch = ChaosOrchestrator(plane, schedule)
+
+        ids = [f"c{i}" for i in range(n_requests)]
+        answered: dict = {}
+        errors: list = []
+        lock = threading.Lock()
+        workers = [threading.Thread(
+            target=_load_worker,
+            args=(rt.frontends.port, ids[k::4], answered, errors, lock),
+            daemon=True) for k in range(4)]
+        for w in workers:
+            w.start()
+        orch.run()
+
+        # convergence: clear remaining armed faults, then wait for the
+        # supervisors to detect/kill/respawn/resync everything. A child
+        # paused by the schedule's LAST action is only detectable once
+        # its heartbeat deadline lapses — wait that out first so the
+        # recovery happens under supervision, not in stop()'s sweep.
+        FAULTS.reset()
+        time.sleep(rt.frontends.heartbeat_deadline_s + 1.0)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if rt.frontends.alive() and rt.backplane.connected >= 2 \
+                    and (rt.engines is None
+                         or rt.engines.alive_count()
+                         == len(rt.engines.engine_ids)):
+                break
+            time.sleep(0.2)
+        for w in workers:
+            w.join(timeout=120)
+        baseline.track_children()
+
+        verifier.check_admissions(n_requests, answered, errors,
+                                  fail_closed=bool(args.fail_closed))
+    finally:
+        rt.stop()
+    verifier.check_leaks(baseline)
+    return orch.snapshot()
+
+
+# ------------------------------------------------------------ audit phase
+
+
+def _cluster_objects(n_pods: int = 12) -> list:
+    objs = [{"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": f"ns{i}", "uid": f"u-ns-{i}",
+                          "resourceVersion": "1"}} for i in range(4)]
+    for i in range(n_pods):
+        labels = {"team": "core"} if i % 3 else {"app": "x"}
+        objs.append({"apiVersion": "v1", "kind": "Pod",
+                     "metadata": {"name": f"p-{i}",
+                                  "namespace": f"ns{i % 4}",
+                                  "uid": f"u-p-{i}",
+                                  "resourceVersion": "1",
+                                  "labels": labels}})
+    objs += [
+        {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+         "metadata": {"name": n, "namespace": ns, "uid": f"u-ing-{n}",
+                      "resourceVersion": "1"},
+         "spec": {"rules": [{"host": h} for h in hosts]}}
+        for n, ns, hosts in (("ing-a", "ns0", ["x.com", "y.com"]),
+                             ("ing-b", "ns1", ["x.com"]),
+                             ("ing-c", "ns2", ["solo.com"]))]
+    return objs
+
+
+def _cluster_kube(objs):
+    from gatekeeper_tpu.control.kube import FakeKube
+
+    kube = FakeKube()
+    kube.register_kind(("", "v1", "Namespace"), namespaced=False)
+    kube.register_kind(("", "v1", "Pod"), namespaced=True)
+    kube.register_kind(("networking.k8s.io", "v1", "Ingress"),
+                       namespaced=True)
+    for o in objs:
+        kube.apply(dict(o))
+    for c in (
+        {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+         "kind": "K8sRequiredLabels",
+         "metadata": {"name": "pods-need-team", "uid": "c-team"},
+         "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                       "kinds": ["Pod"]}]},
+                  "parameters": {"labels": [{"key": "team"}]}}},
+        {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+         "kind": "K8sUniqueIngressHost",
+         "metadata": {"name": "unique-hosts", "uid": "c-hosts"},
+         "spec": {}},
+    ):
+        kube.apply(dict(c))
+    return kube
+
+
+def _library(client):
+    from gatekeeper_tpu import policies
+    from gatekeeper_tpu.parallel.workload import REQUIRED_LABELS_TEMPLATE
+
+    client.add_template(REQUIRED_LABELS_TEMPLATE)
+    client.add_template(policies.load("general/uniqueingresshost"))
+    client.add_constraint(
+        {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+         "kind": "K8sRequiredLabels",
+         "metadata": {"name": "pods-need-team", "uid": "c-team"},
+         "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                       "kinds": ["Pod"]}]},
+                  "parameters": {"labels": [{"key": "team"}]}}})
+    client.add_constraint(
+        {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+         "kind": "K8sUniqueIngressHost",
+         "metadata": {"name": "unique-hosts", "uid": "c-hosts"},
+         "spec": {}})
+
+
+def _result_key(r):
+    return (r.msg,
+            json.dumps(r.metadata, sort_keys=True, default=str),
+            json.dumps(r.constraint, sort_keys=True, default=str),
+            json.dumps(r.review, sort_keys=True, default=str),
+            json.dumps(r.resource, sort_keys=True, default=str),
+            r.enforcement_action)
+
+
+def phase_audit(verifier: Verifier, seed: int) -> dict:
+    from gatekeeper_tpu.client import Backend
+    from gatekeeper_tpu.control.audit import (AuditManager,
+                                              ShardedAuditPlane)
+    from gatekeeper_tpu.control.backplane import AuditShardSupervisor
+    from gatekeeper_tpu.ir import TpuDriver
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    objs = _cluster_objects()
+    # rv-identical oracle cluster: an unsharded single-process audit is
+    # the bit-equality reference for both results and status writes
+    okube = _cluster_kube(objs)
+    oracle_client = Backend(TpuDriver()).new_client(
+        [K8sValidationTarget()])
+    _library(oracle_client)
+    oracle = AuditManager(okube, oracle_client, interval=3600,
+                          incremental=True)
+    oracle_results = [_result_key(r) for r in oracle.audit_once()]
+
+    kube = _cluster_kube(objs)
+    leader = Backend(TpuDriver()).new_client([K8sValidationTarget()])
+    tmp = tempfile.mkdtemp(prefix="chaos-audit-")
+    sock = os.path.join(tmp, "audit.sock")
+    plane_box: list = []
+    sup = AuditShardSupervisor(
+        2, socket_for=lambda k: f"{sock}.{k}",
+        spawn_args=["--log-level", "WARNING"],
+        snapshot_provider=lambda k: plane_box[0].sync_snapshot(k),
+        heartbeat_deadline_s=3.0)
+    splane = ShardedAuditPlane(kube, leader, sup, 2)
+    plane_box.append(splane)
+    splane.attach()
+    _library(leader)
+    mgr = AuditManager(kube, leader, interval=3600, shard_plane=splane)
+
+    handles = PlaneHandles(audit_shards=sup, kube=kube)
+    baseline = LeakBaseline(handles).capture()
+    sup.start()
+    schedule = ChaosSchedule.generate(seed, surface=AUDIT_SURFACE,
+                                      n_actions=2, horizon_s=0.5,
+                                      max_target=2)
+    orch = ChaosOrchestrator(handles, schedule)
+    try:
+        baseline.track_children()
+        round1 = [_result_key(r) for r in mgr.audit_once()]
+        r = chaos.CheckResult("audit_round1_clean")
+        if round1 != oracle_results:
+            r.violations.append(
+                "pre-chaos sharded round already differs from oracle")
+        verifier.results.append(r)
+
+        orch.run()  # SIGKILL / SIGSTOP the shard children
+        # convergence: wedge detection (<= heartbeat deadline) + respawn
+        # + slice resync, all supervisor-internal. A paused child still
+        # counts alive until the deadline trips, so wait that out first.
+        time.sleep(sup.heartbeat_deadline_s + 1.0)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sup.alive_count() == 2 and \
+                    not any(sup._dirty.values()):
+                break
+            time.sleep(0.2)
+        baseline.track_children()
+        round2 = [_result_key(r) for r in mgr.audit_once()]
+        verifier.check_audit_bitequal(round2, oracle_results)
+
+        # status parity, kind by kind, against the oracle cluster
+        r = chaos.CheckResult("audit_status_parity")
+        for kind, name in (("K8sRequiredLabels", "pods-need-team"),
+                           ("K8sUniqueIngressHost", "unique-hosts")):
+            gvk = ("constraints.gatekeeper.sh", "v1beta1", kind)
+            want = (okube.get(gvk, name).get("status") or {})
+            got = (kube.get(gvk, name).get("status") or {})
+            if got.get("totalViolations") != want.get("totalViolations"):
+                r.violations.append(
+                    f"{kind}/{name}: totalViolations "
+                    f"{got.get('totalViolations')} != oracle "
+                    f"{want.get('totalViolations')}")
+        verifier.results.append(r)
+    finally:
+        sup.stop()
+        splane.stop()
+    verifier.check_leaks(baseline)
+    return orch.snapshot()
+
+
+# ------------------------------------------------------------ fence phase
+
+
+def phase_fence(verifier: Verifier, seed: int,
+                run_s: float = 4.0) -> dict:
+    """Two lease candidates + per-candidate status writers gated on
+    `is_leader` (the GuardedKube fence), under seeded steal/expire
+    faults. Every successful status write records the lease holder at
+    write time; a write by one candidate while ANOTHER candidate held
+    the lease is a fencing violation."""
+    import random as _random
+
+    from gatekeeper_tpu.control.kube import (FakeKube, LEASE_GVK,
+                                             LeaseElector)
+    from gatekeeper_tpu.control.resilience import GuardedKube, NotLeader
+
+    kube = FakeKube()
+    kube.register_kind(LEASE_GVK)
+    kube.register_kind(("constraints.gatekeeper.sh", "v1beta1",
+                        "K8sRequiredLabels"))
+    kube.apply({"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+                "kind": "K8sRequiredLabels",
+                "metadata": {"name": "fence-target", "uid": "c-fence"},
+                "spec": {}})
+
+    writes: list = []
+    identities = ("pod-a", "pod-b")
+    electors = [LeaseElector(kube, identity=i, lease_duration=0.6,
+                             namespace="gk") for i in identities]
+    stop = threading.Event()
+
+    def writer(elector, identity):
+        gvk = ("constraints.gatekeeper.sh", "v1beta1",
+               "K8sRequiredLabels")
+        rec = RecordingKube(kube, identity, writes,
+                            lease_name=elector.lease_name,
+                            lease_namespace="gk")
+        guard = GuardedKube(rec, write_gate=lambda: elector.is_leader)
+        while not stop.is_set():
+            try:
+                obj = kube.get(gvk, "fence-target")
+                obj["status"] = {"by": identity,
+                                 "seq": len(writes)}
+                guard.update(obj, subresource="status")
+            except NotLeader:
+                pass
+            except Exception:
+                pass  # conflicts / injected API errors: retry
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=writer, args=(e, i), daemon=True)
+               for e, i in zip(electors, identities)]
+    rng = _random.Random(seed)
+    schedule = ChaosSchedule.generate(seed, surface=FENCE_SURFACE,
+                                      n_actions=3, horizon_s=run_s * 0.7)
+    for e in electors:
+        e.start()
+    for t in threads:
+        t.start()
+    t0 = time.monotonic()
+    ledger = []
+    try:
+        for action in schedule.actions:
+            delay = (t0 + action.t) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            verb = action.kind.split(".", 1)[1]
+            FAULTS.inject("kube.lease", mode=verb, count=1,
+                          match={"identity":
+                                 identities[rng.randrange(2)]})
+            ledger.append({**action.to_dict(),
+                           "at_s": round(time.monotonic() - t0, 3)})
+        remaining = run_s - (time.monotonic() - t0)
+        if remaining > 0:
+            time.sleep(remaining)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5)
+        for e in electors:
+            e.stop()
+        FAULTS.reset()
+    verifier.check_fencing(writes, writers=set(identities))
+    return {"seed": seed, "schedule": schedule.to_dict()["actions"],
+            "ledger": ledger, "status_writes": len(writes)}
+
+
+# -------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="seeded chaos run + crash-consistency verification")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="schedule seed (default: random; always "
+                         "printed for replay)")
+    ap.add_argument("--actions", type=int, default=8,
+                    help="serve-phase schedule length")
+    ap.add_argument("--horizon", type=float, default=6.0,
+                    help="serve-phase schedule horizon (seconds)")
+    ap.add_argument("--phases", default="serve,audit,fence",
+                    help="comma list of phases to run")
+    ap.add_argument("--ledger", default="",
+                    help="write the machine-readable run (schedules, "
+                         "ledgers, verifier report) to this JSON file")
+    args = ap.parse_args(argv)
+
+    seed = args.seed if args.seed is not None \
+        else int.from_bytes(os.urandom(4), "big")
+    print(f"chaos seed: {seed}  "
+          f"(replay: python -m tools.chaos_verify --seed {seed})",
+          flush=True)
+
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+    verifier = Verifier()
+    run: dict = {"seed": seed, "phases": {}}
+    t0 = time.monotonic()
+    for name in phases:
+        print(f"--- phase: {name}", flush=True)
+        if name == "serve":
+            run["phases"]["serve"] = phase_serve(
+                verifier, seed, args.actions, args.horizon)
+        elif name == "audit":
+            run["phases"]["audit"] = phase_audit(verifier, seed + 1)
+        elif name == "fence":
+            run["phases"]["fence"] = phase_fence(verifier, seed + 2)
+        else:
+            print(f"unknown phase {name!r}", file=sys.stderr)
+            return 2
+        FAULTS.reset()
+    # invariant 5 runs once, after every phase tore its plane down
+    verifier.check_stale_gauges()
+    run["report"] = verifier.report()
+    run["wall_s"] = round(time.monotonic() - t0, 2)
+
+    for check in run["report"]["checks"]:
+        mark = "ok" if check["ok"] else "VIOLATED"
+        print(f"[{mark}] {check['name']} {check['detail']}")
+        for v in check["violations"]:
+            print(f"       - {v}")
+    n = run["report"]["invariant_violations"]
+    print(f"chaos seed {seed}: {n} invariant violation(s) in "
+          f"{run['wall_s']}s", flush=True)
+    if args.ledger:
+        with open(args.ledger, "w") as f:
+            json.dump(run, f, indent=1, default=str)
+        print(f"ledger written to {args.ledger}")
+    return 0 if n == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,9 +26,15 @@ LIFECYCLE_REPORTERS = {
     "report_backplane_inflight",
     "report_ring_fill",
     "report_stream_pending",
+    "report_respawn_backoff",
+    "report_crashloop_breaker",
 }
 
 # direct gauge_set(...) first-arg name literals that are lifecycle-bound
+# the chaos verifier imports this set at RUNTIME (tools.gklint is on
+# the path in CI and the bench): after a schedule tears the plane
+# down, every series of every family below must read zero — the
+# stale-gauge invariant is the dynamic twin of this static check
 LIFECYCLE_GAUGE_NAMES = {
     "gatekeeper_tpu_queue_depth",
     "gatekeeper_tpu_device_duty_cycle",
@@ -36,6 +42,8 @@ LIFECYCLE_GAUGE_NAMES = {
     "gatekeeper_tpu_backplane_ring_fill_ratio",
     "gatekeeper_tpu_audit_stream_pending_events",
     "gatekeeper_tpu_slo_burn_rate",
+    "gatekeeper_tpu_respawn_backoff_seconds",
+    "gatekeeper_tpu_crashloop_breaker",
 }
 
 _TEARDOWN_PAT = ("stop", "close", "shutdown", "abort", "teardown",
