@@ -227,7 +227,7 @@ def main() -> int:
 
     configs = {}
     want_configs = ["1", "2", "3", "5", "6", "7", "9", "10", "11", "12",
-                    "13", "14", "15"]
+                    "13", "14", "15", "16"]
     try:
         # FULL scale by default: BENCH_r0N.json must carry the
         # 10k-object and 50k-pod numbers, not reduced-scale stand-ins
@@ -292,8 +292,10 @@ def main() -> int:
         "materialize_s": round(mat_s, 3),
         # ROADMAP item 3's gate: <= 1.0 means the steady audit is
         # sweep-bound (message materialization no longer dominates)
+        # 3 decimals: at the post-PR 11 ratio scale (~0.03) two-decimal
+        # rounding turns one ULP of noise into a >25% trend-gate trip
         "materialize_vs_sweep":
-            round(mat_s / sweep_s, 2) if sweep_s > 0 else None,
+            round(mat_s / sweep_s, 3) if sweep_s > 0 else None,
         "evals_per_sec_per_chip": round(evals_per_sec),
         "first_audit_s": round(first_audit_s, 2),
         # cold restart (no cache volume) vs warm restart (populated XLA
@@ -361,6 +363,11 @@ def main() -> int:
         "chaos_mttr_p99_s": (configs.get("15") or {}).get("value"),
         "chaos_invariant_violations":
             (configs.get("15") or {}).get("chaos_invariant_violations"),
+        # fleet-scan headline (config 16): offline clusterless
+        # manifests/s through the loader/dedupe/bulk-feed pipeline,
+        # best warm tier
+        "fleet_scan_manifests_per_sec":
+            (configs.get("16") or {}).get("value"),
         # multichip headline (config 10): default mesh-sharded audit at
         # 1M+ objects vs the forced single-device path
         "mesh_audit_s": (configs.get("10") or {}).get("value"),

@@ -1386,6 +1386,17 @@ class BackplaneClient:
         one enqueue pass — the bulk-caller path for CI scanners and
         service-mesh authorizers. Raises BackplaneError on loss or
         timeout."""
+        return self.review_bulk_finish(
+            self.review_bulk_begin(payloads, timeout_s))
+
+    def review_bulk_begin(self, payloads: list,
+                          timeout_s: float = 30.0) -> tuple:
+        """Send one B frame and return immediately with a ticket for
+        `review_bulk_finish` — the pipelining half of `review_bulk`.
+        Bulk callers (the fleet scanner) keep K frames in flight so
+        the next batch encodes host-side while this one evaluates in
+        the engine; the frame-id/waiter plumbing already multiplexes
+        replies, so depth costs no thread per in-flight frame."""
         sock = self._ensure_connected()
         waiter = _Waiter()
         with self._pending_lock:
@@ -1404,6 +1415,12 @@ class BackplaneClient:
             self._drop(sock)
             raise BackplaneError(
                 f"bulk ingest connection lost: {e}") from e
+        return (rid, waiter, timeout_s)
+
+    def review_bulk_finish(self, ticket: tuple) -> list[bytes]:
+        """Wait out one `review_bulk_begin` ticket and parse its
+        reply. Raises BackplaneError on loss or timeout."""
+        rid, waiter, timeout_s = ticket
         if not waiter.event.wait((timeout_s or 30.0) + 5.0):
             with self._pending_lock:
                 self._pending.pop(rid, None)

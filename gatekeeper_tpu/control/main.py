@@ -1559,6 +1559,10 @@ def main(argv=None) -> int:
         return warm_cache_main(argv[1:])
     if argv[:1] == ["preview"]:
         return preview_main(argv[1:])
+    if argv[:1] == ["scan"]:
+        from .scan import scan_main
+
+        return scan_main(argv[1:])
     args = build_parser().parse_args(argv)
     glog.setup(args.log_level)
     runtime = Runtime(args)

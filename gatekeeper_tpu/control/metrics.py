@@ -713,6 +713,8 @@ CACHE_OUTCOMES = ("hit", "miss")
 STREAM_FLUSH_OUTCOMES = ("ok", "error", "skipped")
 PREVIEW_OUTCOMES = ("ok", "error", "invalid")
 SNAPSHOT_OUTCOMES = ("ok", "error", "missing", "fallback")
+SCAN_OUTCOMES = ("allow", "deny", "error", "dedup", "skip")
+SCAN_TIERS = ("inproc", "backplane", "grpc")
 
 LABEL_FOLD = "other"
 
@@ -1352,6 +1354,33 @@ def report_stage_bucketed(plane: str, stage: str, bucket_counts: list,
                               _STAGE_HELP, STAGE_BUCKETS, bucket_counts,
                               sum_, count, plane=plane, stage=stage,
                               engine=_stage_engine(plane, engine))
+
+
+def report_scan_manifests(outcome: str, n: int = 1) -> None:
+    """Fleet-scan manifest accounting by outcome: allow/deny verdicts,
+    error records (malformed or unevaluated manifests — never silently
+    dropped), dedup rejoins (verdict served by content hash without a
+    wire trip), and skipped non-k8s documents."""
+    if outcome not in SCAN_OUTCOMES:
+        outcome = LABEL_FOLD
+    REGISTRY.counter_add("gatekeeper_tpu_scan_manifests_total",
+                         "Fleet-scan manifests by outcome",
+                         n, outcome=outcome)
+
+
+def report_scan_batch(tier: str, seconds: float) -> None:
+    """One fleet-scan bulk batch round trip (begin to verdict receipt)
+    on one wire tier — the feed-side read of whether the loader
+    pipeline keeps the engine saturated (compare against the engine's
+    own evaluate stage)."""
+    if tier not in SCAN_TIERS:
+        tier = LABEL_FOLD
+    REGISTRY.counter_add("gatekeeper_tpu_scan_batches_total",
+                         "Fleet-scan bulk batches completed per wire "
+                         "tier", tier=tier)
+    REGISTRY.observe("gatekeeper_tpu_scan_batch_duration_seconds",
+                     "Fleet-scan bulk batch round-trip latency",
+                     seconds, buckets=STAGE_BUCKETS, tier=tier)
 
 
 def report_audit_shard(stage: str, shard: int, seconds: float) -> None:

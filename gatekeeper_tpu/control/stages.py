@@ -82,6 +82,19 @@ STAGES: dict[str, tuple[str, str]] = {
     "shard_sweeps": (
         "audit", "sharded plane: per-shard slice sweep dispatch + "
         "composition into one audit round (leader side)"),
+    # fleet-scan plane -----------------------------------------------
+    "scan_load": (
+        "scan", "fleet scan: feeder wait on the loader-process queue "
+        "(parse + envelope synthesis off the hot path)"),
+    "scan_dedupe": (
+        "scan", "fleet scan: content-hash dedupe pass over one loader "
+        "chunk"),
+    "scan_feed": (
+        "scan", "fleet scan: bulk-batch round trip — begin to verdict "
+        "receipt on the wire tier"),
+    "scan_report": (
+        "scan", "fleet scan: verdict rejoin + streaming JSONL record "
+        "emission for one bulk batch"),
 }
 
 STAGE_NAMES = frozenset(STAGES)

@@ -606,6 +606,31 @@ def _envelope_prefix(envelope: dict) -> bytes:
     return prefix
 
 
+def verdict_response(pairs) -> dict:
+    """The single authority for mapping (enforcement_action, msg)
+    violation pairs to an AdmissionReview response body. Every
+    consumer of evaluation results — `/v1/admit`, the bulk paths, the
+    offline fleet scan — builds its verdict here, so a scan verdict is
+    bit-equal to what admission would have answered for the same
+    object."""
+    denies = []
+    warns = []
+    for action, msg in pairs:
+        if action == "deny":
+            denies.append(msg)
+        elif action == "warn":
+            warns.append(msg)
+    if denies:
+        response = {"allowed": False,
+                    "status": {"code": 403,
+                               "reason": "; ".join(sorted(denies))}}
+    else:
+        response = {"allowed": True}
+    if warns:
+        response["warnings"] = sorted(warns)
+    return response
+
+
 # ----------------------------------------------------- decision cache
 
 
@@ -971,10 +996,8 @@ class ValidationHandler:
     def _finish(self, request: dict, pre: "_Prelim",
                 results: list) -> dict:
         username = (request.get("userInfo") or {}).get("username")
-        denies = []
-        warns = []
-        for r in results:
-            if self.log_denies:
+        if self.log_denies:
+            for r in results:
                 log.info(
                     "violation",
                     event_type="violation",
@@ -987,22 +1010,13 @@ class ValidationHandler:
                     request_username=username,
                     details=r.msg,
                 )
-            if r.enforcement_action == "deny":
-                denies.append(r.msg)
-            elif r.enforcement_action == "warn":
-                # enforcementAction: warn (reference policy.go:194-217
-                # line): the verdict stays allowed and the violation
-                # rides the AdmissionReview warnings field, which
-                # kubectl surfaces as a client-side Warning header
-                warns.append(r.msg)
-        if denies:
-            response = {"allowed": False,
-                        "status": {"code": 403,
-                                   "reason": "; ".join(sorted(denies))}}
-        else:
-            response = {"allowed": True}
-        if warns:
-            response["warnings"] = sorted(warns)
+        # enforcementAction: warn (reference policy.go:194-217 line):
+        # the verdict stays allowed and the violation rides the
+        # AdmissionReview warnings field, which kubectl surfaces as a
+        # client-side Warning header — verdict_response owns the
+        # mapping
+        response = verdict_response(
+            (r.enforcement_action, r.msg) for r in results)
         if pre.cache_key is not None and (not self.log_denies
                                           or not results):
             # under --log-denies a cached answer must not swallow audit

@@ -178,14 +178,20 @@ class RemoteClient:
         return [_responses_from_wire(r)
                 for r in self._rpc("ReviewBatch", req)["responses"]]
 
-    def review_stream(self, batches, tracing: bool = False):
+    def review_stream(self, batches, tracing: bool = False,
+                      raw: bool = False):
         """STREAMING ingest: iterate over batches (each a list of
         review objects) and yield one list[Responses] per batch, in
         order, over a single pipelined HTTP/2 stream — no per-RPC
         round trip between batches. A per-batch server error raises
         the mapped ClientError for THAT batch when its result is
         consumed; the stream itself stays usable only up to the raise
-        (iterate defensively for scan workloads)."""
+        (iterate defensively for scan workloads).
+
+        raw=True yields the wire response dicts untranslated (one
+        list[dict] per batch, each `{"byTarget": ...}`): bulk scans
+        flattening a million reviews to verdict pairs have no use for
+        a million intermediate Result objects."""
         call = self._call.get("ReviewStream")
         if call is None:
             call = self._channel.stream_stream(
@@ -210,8 +216,11 @@ class RemoteClient:
                     if cls is UnrecognizedConstraintError:
                         raise cls(err.get("kind") or "?")
                     raise cls(err.get("message") or "stream batch failed")
-                yield [_responses_from_wire(r)
-                       for r in resp.get("responses") or []]
+                if raw:
+                    yield resp.get("responses") or []
+                else:
+                    yield [_responses_from_wire(r)
+                           for r in resp.get("responses") or []]
         except grpc.RpcError as e:
             _raise_remote(e)
 

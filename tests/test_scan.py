@@ -1,0 +1,498 @@
+"""Offline fleet scan (control/scan.py): loader formats, dedupe
+rejoin, the streaming reporter's exit-code contract, and the verdict
+oracle — a scan verdict must be bit-equal to what a per-manifest
+Client.review would have answered for the same object, dedupe path
+included."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+
+import pytest
+import yaml
+
+from gatekeeper_tpu.control import scan as scan_mod
+from gatekeeper_tpu.control.scan import (
+    DedupeTier,
+    LoaderPool,
+    Reporter,
+    ScanFatal,
+    build_inproc_tier,
+    content_key,
+    exit_code,
+    is_k8s_manifest,
+    parse_file,
+    parse_jsonl,
+    run_scan,
+    scan_main,
+    synthesize_request,
+    walk_tree,
+)
+from gatekeeper_tpu.control.webhook import verdict_response
+from gatekeeper_tpu.target import AugmentedReview
+
+FIXTURE_TREE = os.path.join(os.path.dirname(__file__), "fixtures",
+                            "fleet_scan")
+
+
+def _pod(name, ns="a", labels=None):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         **({"labels": labels} if labels else {})},
+            "spec": {"containers": [
+                {"name": "c", "image": "registry.corp.example/app"}]}}
+
+
+def _die_loader(*args, **kwargs):  # spawn target must be picklable
+    os._exit(3)
+
+
+class EchoTier:
+    """Engine stand-in: denies pods whose name contains 'bad',
+    synchronously. Counts what crossed the 'wire'."""
+
+    name = "inproc"
+    wants_bytes = False
+
+    def __init__(self, fail_names=()):
+        self.sent: list = []
+        self.batches = 0
+        self.fail_names = set(fail_names)
+
+    def begin(self, batch):
+        self.batches += 1
+        self.sent.extend(r[3]["name"] for r in batch)
+        out = []
+        for rec in batch:
+            name = rec[3]["name"]
+            if name in self.fail_names:
+                out.append({"error": f"engine failed on {name}"})
+            elif "bad" in name:
+                out.append({"allowed": False, "reason": "denied"})
+            else:
+                out.append({"allowed": True})
+        return out
+
+    def finish(self, token):
+        return token
+
+    def close(self):
+        pass
+
+
+# ------------------------------------------------------- loader formats
+
+
+def test_tree_walk_skips_non_manifests(tmp_path):
+    (tmp_path / "a.yaml").write_text("apiVersion: v1\nkind: Pod\n")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "b.json").write_text("{}")
+    (tmp_path / "README.md").write_text("docs")
+    (tmp_path / ".hidden.yaml").write_text("x: 1")
+    (tmp_path / ".git").mkdir()
+    (tmp_path / ".git" / "c.yaml").write_text("x: 1")
+    files, skipped = walk_tree(str(tmp_path))
+    names = [os.path.relpath(f, tmp_path) for f in files]
+    assert names == ["a.yaml", os.path.join("sub", "b.json")]
+    assert skipped == 1  # README.md; dotfiles/dirs pruned silently
+
+
+def test_multidoc_yaml_separators_and_skips(tmp_path):
+    p = tmp_path / "m.yaml"
+    with open(p, "w") as f:
+        yaml.safe_dump_all(
+            [_pod("one"), None, {"values": 1},  # blank + non-k8s doc
+             {"kind": "List", "apiVersion": "v1",
+              "items": [_pod("two"), _pod("three")]}], f)
+    entries = list(parse_file(str(p)))
+    states = [s for s, _ in entries]
+    assert states == ["ok", "skip", "ok", "ok"]
+    origins = [payload[0] for s, payload in entries if s == "ok"]
+    # one origin per document, stable across re-parses
+    assert origins == [f"{p}#0", f"{p}#2", f"{p}#3"]
+    names = [payload[1]["metadata"]["name"]
+             for s, payload in entries if s == "ok"]
+    assert names == ["one", "two", "three"]
+
+
+def test_jsonl_shards_partition_exactly(tmp_path):
+    p = tmp_path / "inv.jsonl"
+    with open(p, "w") as f:
+        for i in range(17):
+            f.write(json.dumps(_pod(f"p{i}")) + "\n")
+        f.write("\n")           # blank line: ignored
+        f.write("{broken\n")    # malformed line: one error record
+    seen: list = []
+    errs = 0
+    for shard in range(3):
+        for state, payload in parse_jsonl(str(p), shard, 3):
+            if state == "ok":
+                seen.append(payload[1]["metadata"]["name"])
+            elif state == "err":
+                errs += 1
+    assert sorted(seen) == sorted(f"p{i}" for i in range(17))
+    assert len(set(seen)) == 17  # no line claimed by two shards
+    assert errs == 1
+
+
+def test_malformed_files_error_but_never_abort(tmp_path):
+    with open(tmp_path / "good.yaml", "w") as f:
+        yaml.safe_dump_all([_pod("ok-one")], f)
+    (tmp_path / "broken.yaml").write_text(
+        "apiVersion: v1\nkind: Pod\n  bad: [\n")
+    (tmp_path / "broken.json").write_text("{not json")
+    tier = EchoTier()
+    out = io.StringIO()
+    files, _ = walk_tree(str(tmp_path))
+    summary = run_scan(tier, LoaderPool("tree", files, 0, False), out)
+    assert summary["errors"] == 2
+    assert summary["allowed"] == 1  # the scan still evaluated the rest
+    assert exit_code(summary) == 2  # errors take precedence
+    recs = [json.loads(line) for line in out.getvalue().splitlines()]
+    assert sum(1 for r in recs if r["outcome"] == "error") == 2
+    assert all("error" in r for r in recs if r["outcome"] == "error")
+
+
+def test_is_k8s_manifest():
+    assert is_k8s_manifest(_pod("x"))
+    assert not is_k8s_manifest({"values": {"x": 1}})
+    assert not is_k8s_manifest({"apiVersion": "v1"})
+    assert not is_k8s_manifest({"apiVersion": 3, "kind": "Pod"})
+    assert not is_k8s_manifest(["apiVersion", "kind"])
+
+
+# ---------------------------------------------------------------- dedupe
+
+
+def test_dedupe_rejoins_and_counts(tmp_path):
+    docs = [_pod("good"), _pod("bad-pod"), _pod("good"),
+            _pod("bad-pod"), _pod("other", labels={"x": "y"})]
+    with open(tmp_path / "m.yaml", "w") as f:
+        yaml.safe_dump_all(docs, f)
+    tier = EchoTier()
+    out = io.StringIO()
+    summary = run_scan(tier, LoaderPool(
+        "tree", [str(tmp_path / "m.yaml")], 0, False), out)
+    # only the 3 unique shapes crossed the wire
+    assert sorted(tier.sent) == ["bad-pod", "good", "other"]
+    assert summary["unique_evaluated"] == 3
+    assert summary["deduped"] == 2
+    assert summary["manifests"] == 5
+    # every duplicate still gets its own record, with the SAME verdict
+    recs = {r["origin"]: r
+            for r in map(json.loads, out.getvalue().splitlines())}
+    assert len(recs) == 5
+    dedups = [r for r in recs.values() if r["outcome"] == "dedup"]
+    assert len(dedups) == 2
+    denied = [r for r in recs.values() if not r["allowed"]]
+    assert len(denied) == 2  # bad-pod twice: one deny + one dedup
+    assert summary["denied"] == 2
+    assert exit_code(summary) == 1
+
+
+def test_dedupe_never_replays_error_verdicts():
+    d = DedupeTier(size=8)
+    key = "k" * 32
+    assert d.check(key, "o1") is None  # first: caller sends
+    assert d.resolve(key, {"error": "shed"}) == []
+    # the error was NOT cached: the next duplicate re-evaluates
+    assert d.check(key, "o2") is None
+    assert d.resolve(key, {"allowed": True}) == []
+    assert d.check(key, "o3") == {"allowed": True}
+
+
+def test_dedupe_lru_bounded():
+    d = DedupeTier(size=2)
+    for i in range(4):
+        k = f"key{i}"
+        assert d.check(k, f"o{i}") is None
+        d.resolve(k, {"allowed": True})
+    assert len(d._verdicts) == 2
+    assert d.check("key0", "again") is None  # evicted: re-evaluates
+
+
+def test_content_key_matches_decision_cache_recipe():
+    from gatekeeper_tpu.control.webhook import DecisionCache
+
+    req = synthesize_request(_pod("x"))
+    req["uid"] = "ignored"
+    req["timeoutSeconds"] = 5
+    assert content_key(req) == DecisionCache.request_key(req).hex()
+
+
+# ----------------------------------------------- engine failure honesty
+
+
+def test_engine_failures_become_error_records(tmp_path):
+    docs = [_pod("good"), _pod("flaky")]
+    with open(tmp_path / "m.yaml", "w") as f:
+        yaml.safe_dump_all(docs, f)
+    tier = EchoTier(fail_names={"flaky"})
+    out = io.StringIO()
+    summary = run_scan(tier, LoaderPool(
+        "tree", [str(tmp_path / "m.yaml")], 0, False), out)
+    assert summary["errors"] == 1 and summary["allowed"] == 1
+    assert exit_code(summary) == 2
+
+
+def test_loader_death_is_error_records_not_a_hang(tmp_path,
+                                                 monkeypatch):
+    # a loader process that dies without its sentinel must surface as
+    # an error record; the scan completes instead of blocking forever
+    monkeypatch.setattr(scan_mod, "_loader_main", _die_loader)
+    with open(tmp_path / "m.yaml", "w") as f:
+        yaml.safe_dump_all([_pod("good")], f)
+    tier = EchoTier()
+    out = io.StringIO()
+    summary = run_scan(tier, LoaderPool(
+        "tree", [str(tmp_path / "m.yaml")], 1, False), out)
+    assert summary["errors"] == 1
+    assert "died" in out.getvalue()
+
+
+def test_parallel_loaders_match_inline(tmp_path):
+    """loaders=2 (spawned processes) and loaders=0 (inline) must produce
+    the same records for the same source, origin for origin."""
+    p = tmp_path / "inv.jsonl"
+    with open(p, "w") as f:
+        for i in range(40):
+            f.write(json.dumps(_pod(f"p{i}" if i % 7 else f"bad{i}"))
+                    + "\n")
+    outs = []
+    for loaders in (0, 2):
+        out = io.StringIO()
+        summary = run_scan(EchoTier(), LoaderPool(
+            "jsonl", [str(p)], loaders, False), out, batch_size=16)
+        assert summary["errors"] == 0
+        outs.append(sorted(out.getvalue().splitlines()))
+    assert outs[0] == outs[1]
+
+
+# ------------------------------------------------------- verdict oracle
+
+
+@pytest.fixture(scope="module")
+def library_client():
+    from gatekeeper_tpu import policies
+    from gatekeeper_tpu.client import Backend
+    from gatekeeper_tpu.ir import TpuDriver
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    client = Backend(TpuDriver()).new_client([K8sValidationTarget()])
+    client.add_template(policies.load("general/requiredlabels"))
+    client.add_template(policies.load("general/allowedrepos"))
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sRequiredLabels", "metadata": {"name": "must-own"},
+        "spec": {"parameters": {"labels": [
+            {"key": "owner",
+             "allowedRegex": "^[a-z]+.corp.example$"}]}}})
+    client.add_constraint({
+        "apiVersion": "constraints.gatekeeper.sh/v1beta1",
+        "kind": "K8sAllowedRepos", "metadata": {"name": "repos"},
+        "spec": {"parameters": {"repos": [
+            "registry.corp.example/", "gcr.io/corp/"]}}})
+    return client
+
+
+def _oracle_verdict(client, request):
+    pairs = [(r.enforcement_action, r.msg)
+             for r in client.review(AugmentedReview(request)).results()]
+    return scan_mod._verdict_from_response(verdict_response(pairs))
+
+
+def test_scan_verdicts_bit_equal_review_oracle(library_client):
+    """Acceptance: scan verdicts == per-manifest Client.review on
+    fixture-tree files, including the dedupe path (the fixture carries
+    exact duplicates)."""
+    files = [os.path.join(FIXTURE_TREE, f)
+             for f in ("manifests_00.yaml", "manifests_01.yaml")]
+    tier = build_inproc_tier([], client=library_client,
+                             decision_cache=64, timeout_s=120.0)
+    out = io.StringIO()
+    try:
+        summary = run_scan(tier, LoaderPool("tree", files, 0, False),
+                           out, batch_size=64, dedupe_size=1024)
+    finally:
+        tier.close()
+    assert summary["errors"] == 0
+    recs = [json.loads(line) for line in out.getvalue().splitlines()]
+    assert len(recs) == summary["manifests"] > 0
+    assert summary["deduped"] > 0, \
+        "fixture files must exercise the dedupe path"
+    by_origin = {}
+    for state, payload in (e for f in files for e in parse_file(f)):
+        assert state == "ok"
+        by_origin[payload[0]] = synthesize_request(payload[1])
+    assert set(by_origin) == {r["origin"] for r in recs}
+    for rec in recs:
+        expected = _oracle_verdict(library_client,
+                                   by_origin[rec["origin"]])
+        got = {k: v for k, v in rec.items()
+               if k not in ("origin", "outcome")}
+        assert got == expected, rec["origin"]
+
+
+def test_dedup_verdict_identical_to_first_occurrence(library_client):
+    pod = _pod("same", labels={"owner": "team.corp.example"})
+    with_dupes = [pod, _pod("bad"), pod, _pod("bad")]
+    tier = build_inproc_tier([], client=library_client,
+                             decision_cache=0, timeout_s=120.0)
+    out = io.StringIO()
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "m.yaml")
+        with open(p, "w") as f:
+            yaml.safe_dump_all(with_dupes, f)
+        try:
+            run_scan(tier, LoaderPool("tree", [p], 0, False), out)
+        finally:
+            tier.close()
+    recs = [json.loads(line) for line in out.getvalue().splitlines()]
+    assert {r["outcome"] for r in recs} == {"allow", "deny", "dedup"}
+    for outcome_pair in (("allow", 0), ("deny", 1)):
+        first = next(r for r in recs if r["outcome"] == outcome_pair[0])
+        twin = next(r for r in recs
+                    if r["outcome"] == "dedup"
+                    and r.get("allowed") == first["allowed"]
+                    and r.get("reason") == first.get("reason"))
+        assert {k: v for k, v in twin.items()
+                if k not in ("origin", "outcome")} \
+            == {k: v for k, v in first.items()
+                if k not in ("origin", "outcome")}
+
+
+# --------------------------------------------------- CLI + exit contract
+
+
+def test_scan_main_exit_codes(tmp_path):
+    pol = tmp_path / "policies"
+    pol.mkdir()
+    from gatekeeper_tpu import policies
+
+    with open(pol / "req.yaml", "w") as f:
+        yaml.safe_dump_all([
+            policies.load("general/requiredlabels"),
+            {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+             "kind": "K8sRequiredLabels",
+             "metadata": {"name": "must-own"},
+             "spec": {"parameters": {"labels": [{"key": "owner"}]}}},
+        ], f)
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    with open(clean / "m.yaml", "w") as f:
+        yaml.safe_dump_all([_pod("ok", labels={"owner": "me"})], f)
+    out = tmp_path / "out.jsonl"
+    assert scan_main([str(clean), "--policies", str(pol),
+                      "--loaders", "0",
+                      "--output", str(out)]) == 0
+    denials = tmp_path / "denials"
+    denials.mkdir()
+    with open(denials / "m.yaml", "w") as f:
+        yaml.safe_dump_all([_pod("no-labels")], f)
+    assert scan_main([str(denials), "--policies", str(pol),
+                      "--loaders", "0",
+                      "--output", str(out)]) == 1
+    (denials / "broken.yaml").write_text("a: [\n")
+    assert scan_main([str(denials), "--policies", str(pol),
+                      "--loaders", "0",
+                      "--output", str(out)]) == 2
+    # fatal: no policies for the in-process tier
+    assert scan_main([str(clean), "--loaders", "0",
+                      "--output", str(out)]) == 3
+    # fatal: nonexistent source
+    assert scan_main([str(tmp_path / "missing"), "--policies",
+                      str(pol), "--loaders", "0"]) == 3
+
+
+def test_scan_main_summary_file(tmp_path):
+    pol = tmp_path / "pol.yaml"
+    from gatekeeper_tpu import policies
+
+    with open(pol, "w") as f:
+        yaml.safe_dump_all([
+            policies.load("general/requiredlabels"),
+            {"apiVersion": "constraints.gatekeeper.sh/v1beta1",
+             "kind": "K8sRequiredLabels", "metadata": {"name": "o"},
+             "spec": {"parameters": {"labels": [{"key": "owner"}]}}},
+        ], f)
+    src = tmp_path / "src"
+    src.mkdir()
+    with open(src / "m.yaml", "w") as f:
+        yaml.safe_dump_all([_pod("a"), _pod("a"),
+                            _pod("b", labels={"owner": "me"})], f)
+    summary_path = tmp_path / "s.json"
+    rc = scan_main([str(src), "--policies", str(pol), "--loaders", "0",
+                    "--output", os.devnull,
+                    "--summary", str(summary_path)])
+    s = json.loads(summary_path.read_text())
+    assert rc == 1
+    assert s["manifests"] == 3
+    assert s["deduped"] == 1
+    assert s["unique_evaluated"] == 2
+    assert s["denied"] == 2  # the deny and its dedup twin
+
+
+# ---------------------------------------------------------------- preview
+
+
+def test_preview_candidate_alias(library_client):
+    """--preview ingests the candidate under the PR 9 content-hashed
+    alias kind, isolated from any serving library."""
+    from gatekeeper_tpu.client import Backend
+    from gatekeeper_tpu.ir import TpuDriver
+    from gatekeeper_tpu.target import K8sValidationTarget
+
+    from tests.test_client import REQUIRED_LABELS_TEMPLATE
+
+    client = Backend(TpuDriver()).new_client([K8sValidationTarget()])
+    alias = scan_mod.ingest_candidate(
+        client, REQUIRED_LABELS_TEMPLATE,
+        {"kind": "K8sRequiredLabelsTest",
+         "spec": {"parameters": {"labels": ["owner"]}}})
+    assert alias.startswith("K8sRequiredLabelsTestPV")
+    assert len(alias) == len("K8sRequiredLabelsTest") + 2 + 12
+    assert client.knows_kind(alias)
+    tier2 = build_inproc_tier([], client=client, timeout_s=120.0)
+    out = io.StringIO()
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "m.yaml")
+        with open(p, "w") as f:
+            yaml.safe_dump_all([_pod("nolabel"),
+                                _pod("ok", labels={"owner": "x"})], f)
+        try:
+            summary = run_scan(tier2, LoaderPool("tree", [p], 0, False),
+                               out)
+        finally:
+            tier2.close()
+    assert summary["denied"] == 1
+    assert summary["allowed"] == 1
+
+
+def test_preview_fatal_without_kind():
+    with pytest.raises(ScanFatal):
+        scan_mod.ingest_candidate(object(), None, {"spec": {}})
+
+
+# ---------------------------------------------------------------- stages
+
+
+def test_scan_stages_registered():
+    from gatekeeper_tpu.control.stages import STAGE_NAMES
+
+    for s in ("scan_load", "scan_dedupe", "scan_feed", "scan_report"):
+        assert s in STAGE_NAMES
+
+
+def test_reporter_streams_not_accumulates():
+    rep = Reporter(io.StringIO())
+    for i in range(1000):
+        rep.emit(f"o{i}", {"allowed": True}, "allow")
+    assert rep.counts["allow"] == 1000
+    # the reporter holds counters, never the verdict records
+    assert not hasattr(rep, "records")

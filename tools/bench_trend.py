@@ -65,7 +65,16 @@ _HIGHER_BETTER = {
     "grpc_batched_reviews_per_sec",
     "grpc_stream_reviews_per_sec",
     "backplane_bulk_reviews_per_sec",
+    # the evaluation-honest bulk tier (ISSUE 20): same B frames, a
+    # --no-decision-cache engine, so a cache-hit speedup can't mask an
+    # evaluation regression in the gated series
+    "backplane_bulk_reviews_per_sec_nocache",
     "edge_vs_engine_ratio",
+    # offline fleet scan (ISSUE 20): manifests/s through the
+    # loader/dedupe/bulk-feed pipeline, best warm tier
+    "fleet_scan_manifests_per_sec",
+    "scan_warm_manifests_per_sec",
+    "scan_backplane_manifests_per_sec",
     # sharded inventory plane (ISSUE 16): one composed audit round's
     # throughput over the process-sharded plane
     "sharded_audit_objects_per_sec", "sharded_objects_per_sec",
@@ -94,8 +103,11 @@ _CONFIG_EXTRA_FIELDS = (
     "grpc_batched_reviews_per_sec",
     "grpc_stream_reviews_per_sec",
     "backplane_bulk_reviews_per_sec",
+    "backplane_bulk_reviews_per_sec_nocache",
     "engine_batched_reviews_per_sec",
     "edge_vs_engine_ratio",
+    "scan_warm_manifests_per_sec",
+    "scan_backplane_manifests_per_sec",
 )
 
 # top-level headline fields bench.py COPIES out of the side configs —
@@ -108,7 +120,7 @@ _CONFIG_MIRRORS = {
     "compile_widening_speedup", "general_library_compiled_fraction",
     "warm_first_audit_s", "sharded_objects_per_sec",
     "sharded_sweep_wall_s", "chaos_mttr_p99_s",
-    "chaos_invariant_violations",
+    "chaos_invariant_violations", "fleet_scan_manifests_per_sec",
 }
 
 def _ungated(name: str) -> bool:

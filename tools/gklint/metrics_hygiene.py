@@ -37,7 +37,7 @@ _RECORDERS = {"counter_add": "counter", "observe": "histogram",
               "observe_bucketed": "histogram", "gauge_set": "gauge"}
 
 _BOUNDED_LABELS = {"reason", "outcome", "path", "status",
-                   "knob", "direction", "rung"}
+                   "knob", "direction", "rung", "tier"}
 
 
 def _interpolated(node: ast.AST) -> bool:
